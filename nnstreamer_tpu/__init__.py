@@ -1,0 +1,33 @@
+"""nnstreamer_tpu — a TPU-native stream-AI pipeline framework.
+
+A brand-new framework with the capabilities of NNStreamer (GStreamer
+neural-network plugins; see SURVEY.md): tensor-typed streaming graphs
+(converter → transform → filter → decoder plus mux/demux/merge/split/
+aggregator/crop/if/rate/loop elements), a runtime registry of NN backends
+with a first-class ``xla-tpu`` backend, and a distributed query/offload
+layer — designed TPU-first on JAX/XLA: device-resident buffers, fused jitted
+transform chains, pjit/mesh sharding for pod-scale offload.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (  # noqa: F401 — primary public types
+    Buffer,
+    Caps,
+    TensorDType,
+    TensorFormat,
+    TensorInfo,
+    TensorMemory,
+    TensorsConfig,
+    TensorsInfo,
+)
+
+
+def _register_builtins() -> None:
+    """Import built-in element/filter/decoder/converter registrations
+    (the reference's gst_nnstreamer_init, registerer/nnstreamer.c:88-114)."""
+    from . import elements  # noqa: F401
+    from . import filters  # noqa: F401
+    from . import decoders  # noqa: F401
+    from . import converters  # noqa: F401

@@ -1,0 +1,56 @@
+"""Typed scalar/statistics helpers used by tensor_if and transform 'stand'.
+
+Equivalent of ``tensor_data.c/.h`` (gst/nnstreamer/tensor_data.h:30-108):
+typed single-element get/set/typecast and per-tensor / per-channel average &
+standard deviation. The reference hand-rolls a union + switch over 10 dtypes;
+numpy gives us the same semantics directly, so this module is thin — it exists
+to centralize the *saturating typecast* rule (C-style cast behavior the
+reference inherits) and the statistics entry points so tensor_if/transform
+share one implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np
+
+from .types import TensorDType
+
+Number = Union[int, float]
+
+
+def typecast_value(value: Number, dtype: TensorDType) -> Number:
+    """Cast a scalar with C conversion semantics (modular wrap for ints,
+    precision loss for floats) — mirrors gst_tensor_data_typecast."""
+    arr = np.asarray(value).astype(dtype.np_dtype)
+    return arr.item()
+
+
+def typecast_array(arr: np.ndarray, dtype: TensorDType) -> np.ndarray:
+    return arr.astype(dtype.np_dtype)
+
+
+def tensor_average(arr: np.ndarray) -> float:
+    """Whole-tensor mean in float64 (gst_tensor_data_raw_average)."""
+    return float(np.mean(arr, dtype=np.float64))
+
+
+def tensor_std(arr: np.ndarray) -> float:
+    """Whole-tensor population std-dev (gst_tensor_data_raw_std)."""
+    return float(np.std(np.asarray(arr, dtype=np.float64)))
+
+
+def per_channel_average(arr: np.ndarray, channel_axis: int = -1) -> np.ndarray:
+    """Per-channel mean (gst_tensor_data_raw_average_per_channel).
+
+    The reference's channel axis is dim[0] (innermost) which is the *last*
+    axis in our row-major layout.
+    """
+    axes = tuple(i for i in range(arr.ndim) if i != channel_axis % arr.ndim)
+    return np.mean(arr, axis=axes, dtype=np.float64)
+
+
+def per_channel_std(arr: np.ndarray, channel_axis: int = -1) -> np.ndarray:
+    axes = tuple(i for i in range(arr.ndim) if i != channel_axis % arr.ndim)
+    return np.std(np.asarray(arr, dtype=np.float64), axis=axes)

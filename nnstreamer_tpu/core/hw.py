@@ -1,0 +1,88 @@
+"""Accelerator detection (hw_accel.c:42-64 equivalent, TPU-first).
+
+The reference probes NEON via getauxval; ours probes the PJRT platform set
+through JAX. Results cached process-wide; safe to call before/without TPU.
+Also hosts the accelerator-string parser (parse_accl_hw,
+nnstreamer_plugin_api_filter.h:547-568): strings like
+"true:tpu", "false", "true:cpu,tpu" pick execution devices.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+@functools.lru_cache(maxsize=None)
+def available_platforms() -> Tuple[str, ...]:
+    import jax
+
+    plats = []
+    for name in ("tpu", "gpu", "cpu"):
+        try:
+            if jax.devices(name):
+                plats.append(name)
+        except RuntimeError:
+            continue
+    if not plats:  # whatever the default backend exposes (e.g. axon tunnel)
+        try:
+            plats.append(jax.default_backend())
+        except Exception:  # noqa: BLE001
+            pass
+    return tuple(plats)
+
+
+def tpu_available() -> bool:
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # noqa: BLE001
+        return False
+    return "tpu" in dev.platform.lower() or "TPU" in str(dev.device_kind)
+
+
+def default_device():
+    import jax
+
+    return jax.devices()[0]
+
+
+@dataclass(frozen=True)
+class AcceleratorSpec:
+    """Parsed ``accelerator=`` property value."""
+
+    enabled: bool = True
+    preference: Tuple[str, ...] = ()  # ordered platform names, e.g. ("tpu","cpu")
+
+    @classmethod
+    def parse(cls, value: Optional[str]) -> "AcceleratorSpec":
+        if not value:
+            return cls(True, ())
+        s = str(value).strip().lower()
+        if ":" in s:
+            flag, prefs = s.split(":", 1)
+        else:
+            flag, prefs = s, ""
+        enabled = flag in ("true", "1", "yes", "on", "auto", "")
+        preference = tuple(p.strip() for p in prefs.split(",") if p.strip())
+        return cls(enabled, preference)
+
+    def pick_device(self):
+        """Resolve to a concrete jax.Device honoring preference order."""
+        import jax
+
+        if not self.enabled:
+            try:
+                return jax.devices("cpu")[0]
+            except RuntimeError:
+                return jax.devices()[0]
+        for plat in self.preference:
+            try:
+                devs = jax.devices(plat)
+                if devs:
+                    return devs[0]
+            except RuntimeError:
+                continue
+        return jax.devices()[0]

@@ -1,0 +1,110 @@
+"""Self-describing tensor headers for flexible/sparse streams and wire links.
+
+Equivalent of ``GstTensorMetaInfo`` (tensor_typedef.h:282-297) and its
+pack/parse helpers (``gst_tensor_meta_info_*`` in tensor_common.c, consumed by
+tensor_filter at tensor_filter.c:598-604 to strip headers before invoke).
+
+Wire layout (little-endian, 128 bytes fixed — like the reference's fixed
+header so mid-stream peers can parse without negotiation):
+
+    offset  size  field
+    0       4     magic 0x544E5354 ("TSNT")
+    4       4     version (1)
+    8       4     dtype code (index into DTYPE_CODES)
+    12      4     format code (0 static, 1 flexible, 2 sparse)
+    16      4     media type code
+    20      4     rank
+    24      4*16  dims (uint32, innermost-first, up to 16 like the reference)
+    88      8     extra (sparse: nnz)
+    96..128       zero pad
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from .types import TensorDType, TensorFormat, TensorInfo
+
+META_MAGIC = 0x544E5354
+META_VERSION = 1
+META_SIZE = 128
+_MAX_META_DIMS = 16
+
+DTYPE_CODES = [
+    TensorDType.INT32, TensorDType.UINT32, TensorDType.INT16, TensorDType.UINT16,
+    TensorDType.INT8, TensorDType.UINT8, TensorDType.FLOAT64, TensorDType.FLOAT32,
+    TensorDType.INT64, TensorDType.UINT64, TensorDType.FLOAT16, TensorDType.BFLOAT16,
+]
+_DTYPE_TO_CODE = {d: i for i, d in enumerate(DTYPE_CODES)}
+
+FORMAT_CODES = [TensorFormat.STATIC, TensorFormat.FLEXIBLE, TensorFormat.SPARSE]
+_FORMAT_TO_CODE = {f: i for i, f in enumerate(FORMAT_CODES)}
+
+MEDIA_CODES = ["other/tensors", "video/x-raw", "audio/x-raw", "text/x-raw",
+               "application/octet-stream"]
+_MEDIA_TO_CODE = {m: i for i, m in enumerate(MEDIA_CODES)}
+
+_HEADER_FMT = "<IIIIII16Iq"  # + trailing pad to 128
+_HEADER_STRUCT = struct.Struct(_HEADER_FMT)
+assert _HEADER_STRUCT.size <= META_SIZE
+
+
+@dataclass(frozen=True)
+class TensorMetaInfo:
+    """Self-describing header for one tensor payload."""
+
+    info: TensorInfo
+    format: TensorFormat = TensorFormat.FLEXIBLE
+    media_type: str = "other/tensors"
+    extra: int = 0  # sparse: nnz; otherwise 0
+
+    def pack(self) -> bytes:
+        dims = list(self.info.dims)[:_MAX_META_DIMS]
+        dims += [0] * (_MAX_META_DIMS - len(dims))
+        raw = _HEADER_STRUCT.pack(
+            META_MAGIC, META_VERSION,
+            _DTYPE_TO_CODE[self.info.dtype],
+            _FORMAT_TO_CODE[self.format],
+            _MEDIA_TO_CODE.get(self.media_type, 0),
+            len(self.info.dims),
+            *dims,
+            self.extra,
+        )
+        return raw + b"\x00" * (META_SIZE - len(raw))
+
+    @classmethod
+    def parse(cls, data: bytes) -> "TensorMetaInfo":
+        if len(data) < META_SIZE:
+            raise ValueError(f"meta header truncated: {len(data)} < {META_SIZE}")
+        fields = _HEADER_STRUCT.unpack_from(data)
+        magic, version, dtype_c, fmt_c, media_c, rank = fields[:6]
+        if magic != META_MAGIC:
+            raise ValueError(f"bad meta magic 0x{magic:08x}")
+        if version != META_VERSION:
+            raise ValueError(f"unsupported meta version {version}")
+        dims = fields[6:6 + rank]
+        extra = fields[6 + _MAX_META_DIMS]
+        info = TensorInfo(tuple(int(d) for d in dims), DTYPE_CODES[dtype_c])
+        return cls(info, FORMAT_CODES[fmt_c], MEDIA_CODES[media_c], extra)
+
+    @property
+    def payload_size(self) -> int:
+        return self.info.size_bytes
+
+
+def wrap_flex(payload: bytes, info: TensorInfo,
+              media_type: str = "other/tensors") -> bytes:
+    """Prefix a raw tensor payload with a flexible-format header."""
+    return TensorMetaInfo(info, TensorFormat.FLEXIBLE, media_type).pack() + payload
+
+
+def unwrap_flex(data: bytes) -> Tuple[TensorMetaInfo, bytes]:
+    """Split a flex-format blob into (meta, payload); validates size."""
+    meta = TensorMetaInfo.parse(data)
+    payload = data[META_SIZE:]
+    if meta.format is not TensorFormat.SPARSE and len(payload) < meta.payload_size:
+        raise ValueError(
+            f"flex payload truncated: {len(payload)} < {meta.payload_size}")
+    return meta, payload

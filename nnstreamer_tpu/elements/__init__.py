@@ -1,0 +1,5 @@
+"""Built-in pipeline elements. Importing this package registers all element
+classes (the reference's registerer/nnstreamer.c:88-114 equivalent)."""
+
+from . import sources  # noqa: F401
+from . import sinks  # noqa: F401

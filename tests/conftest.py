@@ -1,0 +1,23 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must run before any jax import (pytest imports conftest first), mirroring the
+driver's multi-chip dry-run environment.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(42)

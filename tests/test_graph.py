@@ -1,0 +1,255 @@
+"""Pipeline graph runtime tests: linking, dataflow, queue/tee/join, EOS,
+errors, sync policies (mirrors reference unittest_sink + join + common)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import (
+    CollectPads,
+    Element,
+    FlowReturn,
+    Pipeline,
+    PipelineError,
+    SyncPolicy,
+)
+from nnstreamer_tpu.elements.sources import AppSrc, VideoTestSrc
+from nnstreamer_tpu.elements.sinks import AppSink, FakeSink, TensorSink
+
+
+def tensor_caps(dims, types, rate=30):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+def make_arrays(n, shape=(4,), dtype=np.float32):
+    return [np.full(shape, i, dtype) for i in range(n)]
+
+
+class TestBasicFlow:
+    def test_appsrc_to_sink(self):
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(5))
+        sink = TensorSink(store=True)
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        assert sink.num_buffers == 5
+        np.testing.assert_array_equal(sink.buffers[2].memories[0].host(),
+                                      np.full((4,), 2, np.float32))
+
+    def test_pts_synthesis(self):
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(3),
+                     framerate=30)
+        sink = TensorSink(store=True)
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        pts = [b.pts for b in sink.buffers]
+        assert pts[0] == 0 and pts[1] == pytest.approx(1e9 / 30, rel=1e-3)
+
+    def test_num_buffers_prop(self):
+        p = Pipeline()
+        src = VideoTestSrc(width=8, height=8, num_buffers=4)
+        sink = FakeSink()
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        assert sink.num_buffers == 4
+
+    def test_caps_event_reaches_sink(self):
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("2:2", "uint8"), data=[np.zeros((2, 2), np.uint8)])
+        sink = TensorSink()
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        assert sink.sink_pad.caps is not None
+        assert sink.sink_pad.caps.media_type == "other/tensors"
+
+    def test_new_data_callback(self):
+        seen = []
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(3))
+        sink = TensorSink(new_data=lambda b: seen.append(b.offset))
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        assert seen == [0, 1, 2]
+
+    def test_unlinked_pad_fails(self):
+        p = Pipeline()
+        p.add(AppSrc(caps=tensor_caps("4", "float32"), data=[]))
+        with pytest.raises(ValueError, match="unlinked"):
+            p.start()
+
+    def test_unknown_property_fails(self):
+        with pytest.raises(ValueError, match="unknown property"):
+            FakeSink(bogus_prop=1)
+
+
+class TestQueueTeeJoin:
+    def test_queue_decouples(self):
+        from nnstreamer_tpu.graph import Queue
+
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(20))
+        q = Queue(max_size_buffers=4)
+        sink = TensorSink(store=True)
+        p.add_linked(src, q, sink)
+        p.run(timeout=10)
+        assert sink.num_buffers == 20
+        assert [b.offset for b in sink.buffers] == list(range(20))
+
+    def test_tee_fanout(self):
+        from nnstreamer_tpu.graph import Queue, Tee
+
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(6))
+        tee = Tee()
+        q1, q2 = Queue(), Queue()
+        s1, s2 = TensorSink(store=True), TensorSink(store=True)
+        p.add(src, tee, q1, q2, s1, s2)
+        Pipeline.link(src, tee)
+        Pipeline.link(tee, q1, s1)
+        Pipeline.link(tee, q2, s2)
+        p.run(timeout=10)
+        assert s1.num_buffers == 6 and s2.num_buffers == 6
+
+    def test_join_first_come(self):
+        from nnstreamer_tpu.graph import Join
+
+        p = Pipeline()
+        a = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(3))
+        b = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(2))
+        j = Join()
+        sink = TensorSink(store=True)
+        p.add(a, b, j, sink)
+        Pipeline.link(a, j)
+        Pipeline.link(b, j)
+        Pipeline.link(j, sink)
+        p.run(timeout=10)
+        assert sink.num_buffers == 5
+
+
+class TestErrors:
+    def test_chain_error_posts_bus_error(self):
+        class Boom(Element):
+            ELEMENT_NAME = "boom"
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_sink_pad()
+                self.add_src_pad()
+
+            def chain(self, pad, buf):
+                raise RuntimeError("kaboom")
+
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(3))
+        boom = Boom()
+        sink = FakeSink()
+        p.add_linked(src, boom, sink)
+        with pytest.raises(PipelineError, match="kaboom"):
+            p.run(timeout=10)
+
+
+class TestAppSink:
+    def test_pull(self):
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(3))
+        sink = AppSink()
+        p.add_linked(src, sink)
+        p.start()
+        got = []
+        while True:
+            b = sink.pull(timeout=5)
+            if b is None:
+                break
+            got.append(b)
+        p.stop()
+        assert len(got) == 3
+
+
+class TestCollectPads:
+    def B(self, pts, v=0):
+        return Buffer.of(np.full((2,), v, np.float32), pts=pts, duration=10)
+
+    def test_nosync(self):
+        c = CollectPads(["a", "b"], SyncPolicy.NOSYNC)
+        assert c.push("a", self.B(0)) == []
+        sets = c.push("b", self.B(100))
+        assert len(sets) == 1
+        s, pts = sets[0]
+        assert set(s) == {"a", "b"}
+
+    def test_slowest_drops_stale(self):
+        c = CollectPads(["a", "b"], SyncPolicy.SLOWEST)
+        c.push("a", self.B(0, v=1))
+        c.push("a", self.B(100, v=2))
+        sets = c.push("b", self.B(100, v=3))
+        assert len(sets) == 1
+        s, pts = sets[0]
+        assert pts == 100
+        # pad a's stale pts=0 buffer was dropped in favor of pts=100
+        np.testing.assert_array_equal(s["a"].memories[0].host(),
+                                      np.full((2,), 2, np.float32))
+
+    def test_basepad(self):
+        c = CollectPads(["a", "b"], SyncPolicy.BASEPAD, base_key="a",
+                        base_duration_ns=50)
+        c.push("b", self.B(0))
+        c.push("b", self.B(40))
+        sets = c.push("a", self.B(35))
+        assert len(sets) == 1
+        _, pts = sets[0]
+        assert pts == 35
+
+    def test_refresh_reuses_last(self):
+        c = CollectPads(["a", "b"], SyncPolicy.REFRESH)
+        c.push("a", self.B(0, v=1))
+        s1 = c.push("b", self.B(5, v=2))
+        assert len(s1) == 1
+        s2 = c.push("b", self.B(10, v=3))  # 'a' not updated: reuse last
+        assert len(s2) == 1
+        np.testing.assert_array_equal(s2[0][0]["a"].memories[0].host(),
+                                      np.full((2,), 1, np.float32))
+
+    def test_exhausted_on_eos(self):
+        c = CollectPads(["a", "b"], SyncPolicy.SLOWEST)
+        c.push("a", self.B(0))
+        c.set_eos("b")
+        assert c.exhausted
+
+
+class TestLeakyQueue:
+    def test_leaky_upstream_never_drops_eos(self):
+        import time
+        from nnstreamer_tpu.graph import Queue
+
+        class SlowSink(TensorSink):
+            ELEMENT_NAME = "slowsink"
+
+            def chain(self, pad, buf):
+                time.sleep(0.01)
+                return super().chain(pad, buf)
+
+        p = Pipeline()
+        src = AppSrc(caps=tensor_caps("4", "float32"), data=make_arrays(30))
+        q = Queue(max_size_buffers=2, leaky="upstream")
+        sink = SlowSink(store=True)
+        p.add_linked(src, q, sink)
+        p.run(timeout=10)  # must reach EOS even though buffers are dropped
+        assert 0 < sink.num_buffers <= 30
+
+
+class TestAudioSrc:
+    def test_unsigned_offset_sine(self):
+        from nnstreamer_tpu.elements.sources import AudioTestSrc
+
+        p = Pipeline()
+        src = AudioTestSrc(format="U8", num_buffers=2, samplesperbuffer=256)
+        sink = TensorSink(store=True)
+        p.add_linked(src, sink)
+        p.run(timeout=10)
+        samples = sink.buffers[0].memories[0].host()
+        # offset sine: mean near midpoint, no wraparound clustering at extremes
+        assert 100 < samples.astype(np.float64).mean() < 155

@@ -1,0 +1,160 @@
+"""Tensor type system tests (mirrors reference unittest_common coverage:
+dim/type string parse & print, size calc, config compare, caps intersect)."""
+
+import numpy as np
+import pytest
+from fractions import Fraction
+
+from nnstreamer_tpu.core import (
+    ANY,
+    Caps,
+    TensorDType,
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+    TensorsInfo,
+    dimension_string,
+    dims_to_shape,
+    parse_dimension,
+    shape_to_dims,
+)
+
+
+class TestDimensions:
+    def test_parse_basic(self):
+        assert parse_dimension("3:224:224:1") == (3, 224, 224, 1)
+
+    def test_parse_single(self):
+        assert parse_dimension("1001") == (1001,)
+
+    def test_roundtrip(self):
+        s = "3:224:224:1"
+        assert dimension_string(parse_dimension(s)) == s
+
+    def test_row_major_conversion(self):
+        # reference dims are innermost-first: "3:224:224:1" ↔ numpy (1,224,224,3)
+        assert dims_to_shape((3, 224, 224, 1)) == (1, 224, 224, 3)
+        assert shape_to_dims((1, 224, 224, 3)) == (3, 224, 224, 1)
+
+    @pytest.mark.parametrize("bad", ["", "0:3", "-1", "a:b", "3::4", "1:2:3:4:5:6:7:8:9"])
+    def test_parse_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_dimension(bad)
+
+
+class TestDType:
+    def test_parse_all_names(self):
+        for name in ["int8", "uint8", "int16", "uint16", "int32", "uint32",
+                     "int64", "uint64", "float32", "float64", "float16", "bfloat16"]:
+            assert str(TensorDType.parse(name)) == name
+
+    def test_aliases(self):
+        assert TensorDType.parse("float") is TensorDType.FLOAT32
+        assert TensorDType.parse("double") is TensorDType.FLOAT64
+
+    def test_from_numpy(self):
+        assert TensorDType.parse(np.dtype("uint8")) is TensorDType.UINT8
+        assert TensorDType.parse(np.float32) is TensorDType.FLOAT32
+
+    def test_itemsize(self):
+        assert TensorDType.UINT8.itemsize == 1
+        assert TensorDType.BFLOAT16.itemsize == 2
+        assert TensorDType.FLOAT64.itemsize == 8
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            TensorDType.parse("complex64")
+
+
+class TestTensorInfo:
+    def test_size_bytes(self):
+        ti = TensorInfo.from_strings("3:224:224:1", "uint8")
+        assert ti.size_bytes == 3 * 224 * 224
+        assert ti.num_elements == 3 * 224 * 224
+
+    def test_shape_view(self):
+        ti = TensorInfo.from_strings("3:224:224:1", "float32")
+        assert ti.shape == (1, 224, 224, 3)
+
+    def test_compat_trailing_ones(self):
+        a = TensorInfo.from_strings("3:224:224:1", "uint8")
+        b = TensorInfo.from_strings("3:224:224", "uint8")
+        assert a.is_compatible(b)
+
+    def test_incompat_dtype(self):
+        a = TensorInfo.from_strings("3:4", "uint8")
+        b = TensorInfo.from_strings("3:4", "int8")
+        assert not a.is_compatible(b)
+
+    def test_from_array(self):
+        ti = TensorInfo.from_array(np.zeros((2, 3, 4), np.int16))
+        assert ti.shape == (2, 3, 4)
+        assert ti.dtype is TensorDType.INT16
+
+
+class TestTensorsInfo:
+    def test_multi_parse(self):
+        info = TensorsInfo.from_strings("3:224:224:1,1001:1", "uint8,float32")
+        assert info.num_tensors == 2
+        assert info[0].dtype is TensorDType.UINT8
+        assert info[1].dims == (1001, 1)
+        assert info.dim_string == "3:224:224:1,1001:1"
+        assert info.type_string == "uint8,float32"
+
+    def test_single_type_broadcast(self):
+        info = TensorsInfo.from_strings("2:2,3:3", "float32")
+        assert all(i.dtype is TensorDType.FLOAT32 for i in info)
+
+    def test_count_limit(self):
+        with pytest.raises(ValueError):
+            TensorsInfo.from_strings(",".join(["2"] * 17), "uint8")
+
+    def test_mismatch(self):
+        with pytest.raises(ValueError):
+            TensorsInfo.from_strings("2:2,3:3", "uint8,int8,int8")
+
+    def test_total_size(self):
+        info = TensorsInfo.from_strings("10,20", "float32,uint8")
+        assert info.total_size_bytes == 40 + 20
+
+
+class TestConfigAndCaps:
+    def test_rate(self):
+        cfg = TensorsConfig(TensorsInfo.from_strings("4", "uint8"), Fraction(30, 1))
+        assert cfg.rate_n == 30
+        assert cfg.frame_duration_ns == 33_333_333
+
+    def test_rate_unknown(self):
+        cfg = TensorsConfig(TensorsInfo.from_strings("4", "uint8"))
+        assert cfg.frame_duration_ns is None
+
+    def test_caps_roundtrip(self):
+        cfg = TensorsConfig(
+            TensorsInfo.from_strings("3:224:224:1,1001", "uint8,float32"),
+            Fraction(25, 1))
+        caps = Caps.tensors(cfg)
+        cfg2 = caps.to_config()
+        assert cfg2.info.is_compatible(cfg.info)
+        assert cfg2.rate == cfg.rate
+
+    def test_caps_intersect_fixes_any(self):
+        a = Caps("other/tensors", {"format": TensorFormat.STATIC, "dims": ANY})
+        b = Caps("other/tensors", {"dims": "3:4", "types": "uint8"})
+        c = a.intersect(b)
+        assert c is not None
+        assert c.get("dims") == "3:4"
+
+    def test_caps_disjoint(self):
+        a = Caps("other/tensors", {"dims": "3:4"})
+        b = Caps("other/tensors", {"dims": "5:6"})
+        assert a.intersect(b) is None
+        assert Caps("video/x-raw").intersect(Caps("other/tensors")) is None
+
+
+class TestFormats:
+    def test_parse(self):
+        assert TensorFormat.parse("flexible") is TensorFormat.FLEXIBLE
+
+    def test_flexible_info_no_count_requirement(self):
+        info = TensorsInfo((), TensorFormat.FLEXIBLE)
+        assert info.num_tensors == 0
