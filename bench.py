@@ -1,0 +1,102 @@
+"""Benchmark: MobileNet-v2 224×224 streaming classification pipeline.
+
+Mirrors BASELINE.md's headline config (videotestsrc ! tensor_converter !
+tensor_filter framework=xla-tpu model=mobilenet_v2 ! tensor_decoder
+mode=image_labeling ! sink) end-to-end on the real TPU chip, measuring
+steady-state pipeline FPS and p50 per-invoke latency.
+
+``vs_baseline``: the reference publishes no absolute numbers (BASELINE.md —
+its golden pipeline is correctness-only on CPU tflite); we normalize against
+the 30 FPS real-time camera rate the reference pipelines are built around,
+so vs_baseline = FPS / 30 (≥1.0 ⇒ faster than real-time streaming).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_pipeline(frames, labels_path, sync: bool):
+    from nnstreamer_tpu.graph import Pipeline
+
+    p = Pipeline("bench")
+    src = p.add_new("appsrc", caps=_video_caps(), data=frames)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model="zoo://mobilenet_v2?width=1.0&size=224",
+                     custom="sync=true" if sync else "")
+    dec = p.add_new("tensor_decoder", mode="image_labeling", option1=labels_path)
+    sink = p.add_new("tensor_sink")
+    Pipeline.link(src, conv, filt, dec, sink)
+    return p, filt, sink
+
+
+def _video_caps():
+    from fractions import Fraction
+
+    from nnstreamer_tpu.core import Caps
+
+    return Caps("video/x-raw", {"format": "RGB", "width": 224, "height": 224,
+                                "framerate": Fraction(0, 1)})
+
+
+def main() -> None:
+    n_warmup, n_frames = 16, int(os.environ.get("BENCH_FRAMES", "256"))
+    rng = np.random.default_rng(0)
+    frames = [rng.integers(0, 255, (224, 224, 3)).astype(np.uint8)
+              for _ in range(8)]
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("\n".join(f"label{i}" for i in range(1001)))
+        labels_path = f.name
+
+    # -- latency run (synchronous invokes, per-frame timing) ----------------- #
+    lat_frames = [frames[i % len(frames)] for i in range(n_warmup + 64)]
+    p, filt, _ = build_pipeline(lat_frames, labels_path, sync=True)
+    lats = []
+    orig_record = filt.stats.record
+    filt.stats.record = lambda ns: (orig_record(ns), lats.append(ns))[0]
+    p.run(timeout=600)
+    p50_us = float(np.percentile(np.asarray(lats[n_warmup:]) / 1000.0, 50))
+
+    # -- throughput run (async dispatch, end-to-end pipeline FPS) ------------ #
+    tp_frames = [frames[i % len(frames)] for i in range(n_warmup + n_frames)]
+    p2, filt2, sink2 = build_pipeline(tp_frames, labels_path, sync=False)
+    t_marks = {}
+
+    def on_data(buf):
+        n = sink2.num_buffers
+        if n == n_warmup:
+            t_marks["start"] = time.monotonic()
+        t_marks["end"] = time.monotonic()
+
+    sink2.new_data = on_data
+    p2.run(timeout=600)
+    elapsed = t_marks["end"] - t_marks["start"]
+    fps = n_frames / elapsed if elapsed > 0 else float("nan")
+
+    import jax
+
+    result = {
+        "metric": "mobilenet_v2_224_pipeline_fps",
+        "value": round(fps, 2),
+        "unit": "frames/sec",
+        "vs_baseline": round(fps / 30.0, 3),
+        "p50_invoke_us": round(p50_us, 1),
+        "frames": n_frames,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Converter subplugins (media → tensor): register custom converters under
+SubpluginType.CONVERTER; the built-in media handlers live in
+elements/converter.py.
+
+A custom converter is ``fn(buf, props) -> (arrays, TensorsConfig)`` registered
+via ``register_converter`` (reference NNStreamerExternalConverter,
+nnstreamer_plugin_api_converter.h:41-85).
+"""
+
+from ..core.registry import SubpluginType, register_subplugin, unregister_subplugin
+
+
+def register_converter(name: str, fn, *, replace: bool = True) -> None:
+    register_subplugin(SubpluginType.CONVERTER, name, fn, replace=replace)
+
+
+def unregister_converter(name: str) -> None:
+    unregister_subplugin(SubpluginType.CONVERTER, name)
+
+
+__all__ = ["register_converter", "unregister_converter"]

@@ -1,0 +1,55 @@
+"""tensor_decoder subplugin API.
+
+Reference: ``GstTensorDecoderDef`` (nnstreamer_plugin_api_decoder.h:38-97):
+subplugins keyed by ``mode=`` with ``option1..optionN`` strings, an output
+caps query, and a decode callback. Registered under
+``SubpluginType.DECODER``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.registry import SubpluginType, get_subplugin, register_subplugin
+from ..core.types import Caps, TensorsConfig
+
+
+class Decoder:
+    """Base decoder. Subclasses set MODE and implement out_caps/decode."""
+
+    MODE = "base"
+
+    def __init__(self) -> None:
+        self.options: Dict[int, str] = {}
+
+    def init(self, options: Dict[int, str]) -> None:
+        """option1..optionN strings (reference optionN props)."""
+        self.options = options
+
+    def option(self, n: int, default: str = "") -> str:
+        return self.options.get(n, default)
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        raise NotImplementedError
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        """Return a new Buffer whose memories hold the decoded media
+        (video frame array / utf-8 text bytes / serialized blob)."""
+        raise NotImplementedError
+
+
+def register_decoder(cls: type) -> type:
+    register_subplugin(SubpluginType.DECODER, cls.MODE, cls, replace=True)
+    for alias in getattr(cls, "ALIASES", ()):
+        register_subplugin(SubpluginType.DECODER, alias, cls, replace=True)
+    return cls
+
+
+def find_decoder(mode: str) -> Optional[type]:
+    from . import _ensure_builtin_decoders
+
+    _ensure_builtin_decoders()
+    return get_subplugin(SubpluginType.DECODER, mode)

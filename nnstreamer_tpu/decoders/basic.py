@@ -1,0 +1,83 @@
+"""Basic decoders: direct_video, image_labeling, flexbuf.
+
+References: tensordec-directvideo.c, tensordec-imagelabel.c,
+tensordec-flexbuf.cc.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.meta import wrap_flex
+from ..core.types import Caps, TensorsConfig
+from .base import Decoder, register_decoder
+from .util import load_labels
+
+
+@register_decoder
+class DirectVideo(Decoder):
+    """tensor [C:W:H:1] (C∈{1,3,4}) → video/x-raw frame (passthrough view)."""
+
+    MODE = "direct_video"
+
+    _FMT = {1: "GRAY8", 3: "RGB", 4: "RGBA"}
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        shape = config.info[0].shape  # (N,H,W,C)
+        if len(shape) != 4 or shape[-1] not in self._FMT:
+            raise ValueError(f"direct_video: bad tensor shape {shape}")
+        return Caps("video/x-raw", {"format": self._FMT[shape[-1]],
+                                    "width": shape[2], "height": shape[1],
+                                    "framerate": config.rate})
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        arr = buf.memories[0].host()
+        if arr.ndim == 4:
+            arr = arr[0]
+        return buf.with_memories([TensorMemory(np.ascontiguousarray(arr, np.uint8))])
+
+
+@register_decoder
+class ImageLabeling(Decoder):
+    """scores tensor → text/x-raw best label (tensordec-imagelabel.c):
+    option1 = label file."""
+
+    MODE = "image_labeling"
+
+    def init(self, options) -> None:
+        super().init(options)
+        self.labels = load_labels(self.option(1))
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("text/x-raw", {"format": "utf8"})
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        scores = buf.memories[0].host().reshape(-1)
+        idx = int(np.argmax(scores))
+        label = self.labels[idx] if idx < len(self.labels) else str(idx)
+        out = buf.with_memories(
+            [TensorMemory(np.frombuffer(label.encode("utf-8"), np.uint8).copy())])
+        out.meta["label"] = label
+        out.meta["label_index"] = idx
+        out.meta["label_score"] = float(scores[idx])
+        return out
+
+
+@register_decoder
+class FlexBuf(Decoder):
+    """tensors → self-describing flex blobs (tensordec-flexbuf.cc analog,
+    using our 128-byte meta header wire format)."""
+
+    MODE = "flexbuf"
+    ALIASES = ("flatbuf",)
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("application/octet-stream")
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        blobs = [np.frombuffer(wrap_flex(m.tobytes(), m.info), np.uint8).copy()
+                 for m in buf.memories]
+        return buf.with_memories([TensorMemory(b) for b in blobs])

@@ -1,0 +1,169 @@
+"""bounding_box decoder — SSD-style detection → RGBA overlay video.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c (modes
+:121-133; scales/thresholds :40-58). Supported modes (option1):
+
+  * ``mobilenet-ssd``            — raw SSD head: locations [4:N:1] + class
+    logits [L:N:1]; needs a box-priors file (option3), sigmoid scoring,
+    center-size decode with scales (Y,X,H,W)=(10,10,5,5), NMS@0.5.
+  * ``mobilenet-ssd-postprocess``— model already decoded: boxes [4:M],
+    class ids [M], scores [M], count [1] (tflite detection postprocess).
+  * ``ov-person-detection`` / ``ov-face-detection`` — OpenVINO layout
+    rows [image_id, label, conf, x0, y0, x1, y1].
+
+Options: option2=label file, option3=priors file[:threshold[:iou]],
+option4="W:H" output video size, option5="W:H" model input size.
+Output: transparent RGBA canvas with green boxes + white label text
+(compose over the source video downstream), identical contract to the
+reference decoder.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorsConfig
+from .base import Decoder, register_decoder
+from .util import draw_rect, draw_text, load_labels, new_canvas, nms
+
+# center-size decode scales (tensordec-boundingbox.c:40-47)
+Y_SCALE, X_SCALE, H_SCALE, W_SCALE = 10.0, 10.0, 5.0, 5.0
+DEFAULT_THRESHOLD = 0.5
+DEFAULT_IOU = 0.5
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def load_box_priors(path: str) -> np.ndarray:
+    """Priors file: 4 whitespace-separated float rows [ycenter,xcenter,h,w]
+    (reference box_priors.txt layout)."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"box priors file not found: {path}")
+    rows: List[List[float]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            vals = [float(v) for v in line.split()]
+            if vals:
+                rows.append(vals)
+    if len(rows) < 4:
+        raise ValueError(f"box priors file needs 4 rows, got {len(rows)}")
+    return np.asarray(rows[:4], np.float32)  # (4, N)
+
+
+@register_decoder
+class BoundingBox(Decoder):
+    MODE = "bounding_box"
+    ALIASES = ("boundingbox",)
+
+    def init(self, options) -> None:
+        super().init(options)
+        self.box_mode = self.option(1, "mobilenet-ssd").lower()
+        label_path = self.option(2)
+        self.labels = load_labels(label_path) if label_path else []
+        self.threshold = DEFAULT_THRESHOLD
+        self.iou_threshold = DEFAULT_IOU
+        self.priors: Optional[np.ndarray] = None
+        opt3 = self.option(3)
+        if opt3:
+            parts = opt3.split(":")
+            if self.box_mode == "mobilenet-ssd":
+                self.priors = load_box_priors(parts[0])
+                extra = parts[1:]
+            else:
+                extra = parts
+            if len(extra) >= 1 and extra[0]:
+                self.threshold = float(extra[0])
+            if len(extra) >= 2 and extra[1]:
+                self.iou_threshold = float(extra[1])
+        self.out_w, self.out_h = _parse_wh(self.option(4, "640:480"))
+        self.in_w, self.in_h = _parse_wh(self.option(5, "300:300"))
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("video/x-raw", {"format": "RGBA", "width": self.out_w,
+                                    "height": self.out_h,
+                                    "framerate": config.rate})
+
+    # -- decode modes -------------------------------------------------------- #
+    def _objects_mobilenet_ssd(self, buf: Buffer) -> np.ndarray:
+        if self.priors is None:
+            raise ValueError("mobilenet-ssd mode requires option3 box-priors file")
+        locs = buf.memories[0].host().reshape(-1, 4).astype(np.float32)   # (N,4)
+        raw = buf.memories[1].host()
+        scores = _sigmoid(raw.reshape(-1, raw.shape[-1] if raw.ndim > 1 else
+                                      raw.size // locs.shape[0]).astype(np.float32))
+        scores = scores.reshape(locs.shape[0], -1)                         # (N,L)
+        pr = self.priors  # (4,N): ycenter,xcenter,h,w
+        ycenter = locs[:, 0] / Y_SCALE * pr[2] + pr[0]
+        xcenter = locs[:, 1] / X_SCALE * pr[3] + pr[1]
+        hh = np.exp(locs[:, 2] / H_SCALE) * pr[2]
+        ww = np.exp(locs[:, 3] / W_SCALE) * pr[3]
+        x0, y0 = xcenter - ww / 2, ycenter - hh / 2
+        x1, y1 = xcenter + ww / 2, ycenter + hh / 2
+        out = []
+        cls = scores[:, 1:]  # class 0 = background
+        best = np.argmax(cls, axis=1)
+        best_score = cls[np.arange(len(best)), best]
+        sel = best_score >= self.threshold
+        for i in np.nonzero(sel)[0]:
+            out.append([x0[i], y0[i], x1[i], y1[i], best_score[i], best[i] + 1])
+        return np.asarray(out, np.float32).reshape(-1, 6)
+
+    def _objects_postprocess(self, buf: Buffer) -> np.ndarray:
+        boxes = buf.memories[0].host().reshape(-1, 4).astype(np.float32)
+        classes = buf.memories[1].host().reshape(-1).astype(np.float32)
+        scores = buf.memories[2].host().reshape(-1).astype(np.float32)
+        n = int(buf.memories[3].host().reshape(-1)[0]) if buf.num_tensors > 3 \
+            else len(scores)
+        out = []
+        for i in range(min(n, len(scores))):
+            if scores[i] < self.threshold:
+                continue
+            ymin, xmin, ymax, xmax = boxes[i]
+            out.append([xmin, ymin, xmax, ymax, scores[i], classes[i]])
+        return np.asarray(out, np.float32).reshape(-1, 6)
+
+    def _objects_ov(self, buf: Buffer) -> np.ndarray:
+        rows = buf.memories[0].host().reshape(-1, 7).astype(np.float32)
+        out = []
+        for r in rows:
+            if r[0] < 0 or r[2] < self.threshold:
+                continue
+            out.append([r[3], r[4], r[5], r[6], r[2], r[1]])
+        return np.asarray(out, np.float32).reshape(-1, 6)
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        if self.box_mode == "mobilenet-ssd":
+            objs = self._objects_mobilenet_ssd(buf)
+        elif self.box_mode in ("mobilenet-ssd-postprocess", "tflite-ssd-postprocess"):
+            objs = self._objects_postprocess(buf)
+        elif self.box_mode.startswith("ov-"):
+            objs = self._objects_ov(buf)
+        else:
+            raise ValueError(f"bounding_box: unknown mode {self.box_mode!r}")
+        objs = nms(objs, self.iou_threshold)
+        canvas = new_canvas(self.out_w, self.out_h)
+        detections = []
+        for x0, y0, x1, y1, score, cls in objs:
+            px0, py0 = int(x0 * self.out_w), int(y0 * self.out_h)
+            px1, py1 = int(x1 * self.out_w), int(y1 * self.out_h)
+            draw_rect(canvas, px0, py0, px1, py1)
+            cls_i = int(cls)
+            label = self.labels[cls_i] if cls_i < len(self.labels) else str(cls_i)
+            draw_text(canvas, px0 + 2, py0 + 2, label)
+            detections.append({"box": (float(x0), float(y0), float(x1), float(y1)),
+                               "score": float(score), "class": cls_i,
+                               "label": label})
+        out = buf.with_memories([TensorMemory(canvas)])
+        out.meta["detections"] = detections
+        return out
+
+
+def _parse_wh(s: str) -> Tuple[int, int]:
+    w, h = s.split(":")
+    return int(w), int(h)

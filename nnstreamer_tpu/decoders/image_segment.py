@@ -1,0 +1,72 @@
+"""image_segment decoder — per-pixel class masks → RGBA overlay.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-imagesegment.c (schemes
+:105-126: tflite-deeplab, snpe-deeplab, snpe-depth). option1 = scheme.
+
+tflite-deeplab: input [classes:W:H:1] float → argmax over classes → per-class
+color. snpe-deeplab: input already argmaxed [W:H:1]. snpe-depth: depth map
+[1:W:H] → grayscale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorsConfig
+from .base import Decoder, register_decoder
+
+# 21-class PASCAL VOC palette (RGBA), class 0 = background transparent
+_PALETTE = np.zeros((256, 4), np.uint8)
+for i in range(1, 256):
+    c = np.zeros(3, np.uint8)
+    cid, shift = i, 7
+    while cid:
+        c[0] |= ((cid >> 0) & 1) << shift
+        c[1] |= ((cid >> 1) & 1) << shift
+        c[2] |= ((cid >> 2) & 1) << shift
+        cid >>= 3
+        shift -= 1
+    _PALETTE[i, :3] = c
+    _PALETTE[i, 3] = 160
+
+
+@register_decoder
+class ImageSegment(Decoder):
+    MODE = "image_segment"
+
+    def init(self, options) -> None:
+        super().init(options)
+        self.scheme = self.option(1, "tflite-deeplab").lower()
+
+    def _hw(self, config: TensorsConfig):
+        shape = config.info[0].shape  # row-major
+        if self.scheme == "tflite-deeplab":
+            # dims [classes:W:H:1] → shape (1,H,W,classes)
+            return shape[-3], shape[-2]
+        return shape[-3], shape[-2] if len(shape) >= 3 else shape
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        h, w = self._hw(config)
+        return Caps("video/x-raw", {"format": "RGBA", "width": w, "height": h,
+                                    "framerate": config.rate})
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        arr = buf.memories[0].host()
+        if self.scheme == "tflite-deeplab":
+            if arr.ndim == 4:
+                arr = arr[0]
+            classes = np.argmax(arr, axis=-1).astype(np.uint8)  # (H,W)
+            canvas = _PALETTE[classes]
+        elif self.scheme == "snpe-deeplab":
+            classes = np.squeeze(arr).astype(np.uint8)
+            canvas = _PALETTE[classes]
+        elif self.scheme == "snpe-depth":
+            depth = np.squeeze(arr).astype(np.float32)
+            lo, hi = float(depth.min()), float(depth.max())
+            g = ((depth - lo) / (hi - lo + 1e-9) * 255).astype(np.uint8)
+            canvas = np.stack([g, g, g, np.full_like(g, 255)], axis=-1)
+        else:
+            raise ValueError(f"image_segment: unknown scheme {self.scheme!r}")
+        out = buf.with_memories([TensorMemory(np.ascontiguousarray(canvas))])
+        return out

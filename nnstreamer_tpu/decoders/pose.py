@@ -1,0 +1,102 @@
+"""pose_estimation decoder — keypoint heatmaps → skeleton overlay.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-pose.c (:93-149).
+option1 = "W:H" output size; option2 = "W:H" model input size;
+option3 = keypoint label file (optional); option4 = "heatmap-offset" mode
+(posenet displacement decode) or default plain-argmax heatmaps.
+
+Input (default mode): heatmaps dims [K:W:H:1] → shape (1,H,W,K); per
+keypoint the argmax cell is the joint location, value (sigmoided) the score.
+heatmap-offset mode additionally reads offsets [2K:W:H:1] refining each
+location (posenet convention).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorsConfig
+from .base import Decoder, register_decoder
+from .util import draw_disc, draw_line, load_labels
+
+# COCO-ish default skeleton over 17 keypoints (pairs of keypoint indices)
+_DEFAULT_EDGES: Tuple[Tuple[int, int], ...] = (
+    (0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8), (8, 10),
+    (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14), (14, 16))
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+@register_decoder
+class PoseEstimation(Decoder):
+    MODE = "pose_estimation"
+    ALIASES = ("pose",)
+
+    def init(self, options) -> None:
+        super().init(options)
+        ow, oh = (self.option(1, "640:480")).split(":")
+        self.out_w, self.out_h = int(ow), int(oh)
+        iw, ih = (self.option(2, "257:257")).split(":")
+        self.in_w, self.in_h = int(iw), int(ih)
+        label_path = self.option(3)
+        self.labels = load_labels(label_path) if label_path else []
+        self.offset_mode = self.option(4, "").lower() == "heatmap-offset"
+        self.score_threshold = 0.3
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("video/x-raw", {"format": "RGBA", "width": self.out_w,
+                                    "height": self.out_h,
+                                    "framerate": config.rate})
+
+    def keypoints(self, buf: Buffer) -> List[Tuple[float, float, float]]:
+        hm = buf.memories[0].host()
+        if hm.ndim == 4:
+            hm = hm[0]  # (H,W,K)
+        H, W, K = hm.shape
+        pts: List[Tuple[float, float, float]] = []
+        offsets = None
+        if self.offset_mode and buf.num_tensors > 1:
+            offsets = buf.memories[1].host()
+            if offsets.ndim == 4:
+                offsets = offsets[0]  # (H,W,2K)
+        for k in range(K):
+            flat = int(np.argmax(hm[:, :, k]))
+            y, x = divmod(flat, W)
+            score = float(_sigmoid(hm[y, x, k]))
+            if offsets is not None:
+                # posenet: position = cell/(res-1)*stride + offset
+                oy = float(offsets[y, x, k])
+                ox = float(offsets[y, x, k + K])
+                px = (x / max(W - 1, 1)) * self.in_w + ox
+                py = (y / max(H - 1, 1)) * self.in_h + oy
+            else:
+                px = (x + 0.5) / W * self.in_w
+                py = (y + 0.5) / H * self.in_h
+            pts.append((px / self.in_w, py / self.in_h, score))
+        return pts
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        from .util import new_canvas
+
+        pts = self.keypoints(buf)
+        canvas = new_canvas(self.out_w, self.out_h)
+        coords = []
+        for nx, ny, score in pts:
+            x, y = int(nx * self.out_w), int(ny * self.out_h)
+            coords.append((x, y, score))
+            if score >= self.score_threshold:
+                draw_disc(canvas, x, y, 3)
+        for a, b in _DEFAULT_EDGES:
+            if a < len(coords) and b < len(coords) \
+                    and coords[a][2] >= self.score_threshold \
+                    and coords[b][2] >= self.score_threshold:
+                draw_line(canvas, coords[a][0], coords[a][1],
+                          coords[b][0], coords[b][1])
+        out = buf.with_memories([TensorMemory(canvas)])
+        out.meta["keypoints"] = pts
+        return out

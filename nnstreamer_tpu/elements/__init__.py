@@ -3,3 +3,15 @@ classes (the reference's registerer/nnstreamer.c:88-114 equivalent)."""
 
 from . import sources  # noqa: F401
 from . import sinks  # noqa: F401
+from . import filter  # noqa: F401
+from . import transform  # noqa: F401
+from . import converter  # noqa: F401
+from . import decoder  # noqa: F401
+from . import mux_demux  # noqa: F401
+from . import merge_split  # noqa: F401
+from . import aggregator  # noqa: F401
+from . import crop  # noqa: F401
+from . import cond  # noqa: F401
+from . import rate  # noqa: F401
+from . import repo  # noqa: F401
+from . import sparse  # noqa: F401

@@ -1,0 +1,92 @@
+"""tensor_crop — crop regions of a raw tensor stream by a coords stream.
+
+Reference: gst/nnstreamer/elements/gsttensor_crop.c (:48-109): two sink pads
+``raw`` (data) and ``info`` (crop boxes); output is **flexible**-format
+tensors (one per region — region count is dynamic per frame).
+
+info tensor rows: [x, y, w, h] (pixels in the innermost-two spatial dims of
+the raw tensor, reference convention x=dim1, y=dim2). Raw frames are assumed
+(..., H, W, C) row-major.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorFormat
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.events import Event, EventType
+from ..graph.sync import CollectPads, SyncPolicy
+
+
+@register_element
+class TensorCrop(Element):
+    ELEMENT_NAME = "tensor_crop"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.lateness_ns = 0
+        super().__init__(name, **props)
+        self.raw_pad = self.add_sink_pad("raw", template=Caps.any_tensors())
+        self.info_pad = self.add_sink_pad("info", template=Caps.any_tensors())
+        self.add_src_pad(template=Caps("other/tensors",
+                                       {"format": TensorFormat.FLEXIBLE}))
+        self._collect: Optional[CollectPads] = None
+        self._caps_sent = False
+        self._eos_sent = False
+
+    def start(self) -> None:
+        self._collect = CollectPads(["raw", "info"], SyncPolicy.SLOWEST)
+        self._caps_sent = False
+        self._eos_sent = False
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        with self._lock:
+            if not self._caps_sent:
+                self._caps_sent = True
+                self.send_caps_all(Caps.tensors(format=TensorFormat.FLEXIBLE))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        sets = self._collect.push(pad.name, buf)
+        return self._emit(sets)
+
+    def _emit(self, sets) -> FlowReturn:
+        ret = FlowReturn.OK
+        for frame, pts in sets:
+            raw = frame["raw"].memories[0].host()
+            boxes = frame["info"].memories[0].host().reshape(-1, 4).astype(np.int64)
+            img = raw[0] if raw.ndim == 4 else raw  # (H,W,C)
+            mems = []
+            for x, y, w, h in boxes:
+                x0 = int(np.clip(x, 0, img.shape[1]))
+                y0 = int(np.clip(y, 0, img.shape[0]))
+                x1 = int(np.clip(x + w, x0, img.shape[1]))
+                y1 = int(np.clip(y + h, y0, img.shape[0]))
+                if x1 <= x0 or y1 <= y0:
+                    continue
+                mems.append(TensorMemory(np.ascontiguousarray(img[y0:y1, x0:x1])))
+            if not mems:
+                continue
+            r = self.push(Buffer(mems, pts=pts))
+            if r is FlowReturn.ERROR:
+                ret = r
+        return ret
+
+    def _event_entry(self, pad: Pad, event: Event) -> None:
+        if event.type is EventType.EOS and self._collect is not None:
+            self._emit(self._collect.set_eos(pad.name))
+            with self._lock:
+                pad.eos = True
+                self._eos_pads.add(pad.name)
+                should = (self._collect.exhausted or
+                          len(self._eos_pads) >= len(self.sink_pads)) \
+                    and not self._eos_sent
+                if should:
+                    self._eos_sent = True
+            if should:
+                self.push_event_all(Event.eos())
+            return
+        super()._event_entry(pad, event)

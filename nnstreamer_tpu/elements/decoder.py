@@ -1,0 +1,58 @@
+"""tensor_decoder element — tensor→media boundary, mode-dispatched.
+
+Reference: gst/nnstreamer/elements/gsttensordec.c (subplugin dispatch by
+``mode=`` :221-235, option1..option9 props).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..core.buffer import Buffer
+from ..core.types import Caps, TensorsConfig
+from ..decoders.base import Decoder, find_decoder
+from ..graph.element import Element, FlowReturn, Pad, register_element
+
+
+@register_element
+class TensorDecoder(Element):
+    ELEMENT_NAME = "tensor_decoder"
+
+    MAX_OPTIONS = 9
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.mode: Optional[str] = None
+        for i in range(1, self.MAX_OPTIONS + 1):
+            setattr(self, f"option{i}", None)
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+        self.add_src_pad()
+        self._decoder: Optional[Decoder] = None
+        self._config: Optional[TensorsConfig] = None
+
+    def _options_dict(self) -> Dict[int, str]:
+        return {i: str(getattr(self, f"option{i}"))
+                for i in range(1, self.MAX_OPTIONS + 1)
+                if getattr(self, f"option{i}") is not None}
+
+    def start(self) -> None:
+        if not self.mode:
+            raise ValueError("tensor_decoder requires mode=")
+        cls = find_decoder(self.mode)
+        if cls is None:
+            raise ValueError(f"tensor_decoder: unknown mode {self.mode!r}")
+        self._decoder = cls()
+        self._decoder.init(self._options_dict())
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        if caps.media_type != "other/tensors":
+            raise ValueError("tensor_decoder accepts other/tensors only")
+        if self._decoder is None:
+            self.start()
+        self._config = caps.to_config()
+        pad.caps = caps
+        self.send_caps_all(self._decoder.out_caps(self._config))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        out = self._decoder.decode(buf, self._config)
+        return self.push(out)

@@ -1,0 +1,186 @@
+"""tensor_merge / tensor_split — tensor concatenation and slicing.
+
+References: gst/nnstreamer/elements/gsttensormerge.c (mode=linear,
+option=first..fourth = concat axis in reference dim order,
+gsttensormerge.h:45-58, same sync policies as mux) and gsttensorsplit.c
+(``tensorseg`` = per-output slice sizes along an axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorInfo, TensorsConfig, TensorsInfo
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.events import Event, EventType
+from ..graph.sync import CollectPads, SyncPolicy
+
+_AXIS_NAMES = {"first": 0, "second": 1, "third": 2, "fourth": 3}
+
+
+@register_element
+class TensorMerge(Element):
+    """N tensors → one bigger tensor, concatenated along a reference-order
+    dim (0=innermost). Device-resident concat via jnp when inputs are on
+    device."""
+
+    ELEMENT_NAME = "tensor_merge"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.mode = "linear"
+        self.option: str = "third"
+        self.sync_mode: str = "slowest"
+        self.sync_option: str = ""
+        super().__init__(name, **props)
+        self.add_src_pad(template=Caps.any_tensors())
+        self._collect: Optional[CollectPads] = None
+        self._pad_caps: Dict[str, Caps] = {}
+        self._caps_sent = False
+        self._eos_sent = False
+        self._out_config: Optional[TensorsConfig] = None
+
+    @property
+    def _nns_axis(self) -> int:
+        if self.option in _AXIS_NAMES:
+            return _AXIS_NAMES[self.option]
+        return int(self.option)
+
+    def start(self) -> None:
+        if self.mode != "linear":
+            raise ValueError(f"tensor_merge: unsupported mode {self.mode!r}")
+        self._collect = CollectPads([p.name for p in self.sink_pads],
+                                    SyncPolicy.parse(self.sync_mode))
+        self._pad_caps.clear()
+        self._caps_sent = False
+        self._eos_sent = False
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        with self._lock:
+            self._pad_caps[pad.name] = caps
+            if self._caps_sent or len(self._pad_caps) < len(self.sink_pads):
+                return
+            self._caps_sent = True
+            infos = [self._pad_caps[p.name].to_config().info[0]
+                     for p in self.sink_pads]
+            ax = self._nns_axis
+            base = infos[0]
+            out_dims = list(base.dims)
+            while len(out_dims) <= ax:
+                out_dims.append(1)
+            total = 0
+            for inf in infos:
+                if inf.dtype is not base.dtype:
+                    raise ValueError("tensor_merge: dtype mismatch")
+                dims = list(inf.dims) + [1] * (len(out_dims) - inf.rank)
+                for d in range(len(out_dims)):
+                    if d != ax and dims[d] != out_dims[d]:
+                        raise ValueError(
+                            f"tensor_merge: dim {d} mismatch {dims} vs {out_dims}")
+                total += dims[ax]
+            out_dims[ax] = total
+            rate = self._pad_caps[self.sink_pads[0].name].to_config().rate
+            self._out_config = TensorsConfig(
+                TensorsInfo.of(TensorInfo(tuple(out_dims), base.dtype)), rate)
+            self.send_caps_all(Caps.tensors(self._out_config))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        sets = self._collect.push(pad.name, buf)
+        return self._emit(sets)
+
+    def _emit(self, sets) -> FlowReturn:
+        import jax.numpy as jnp
+
+        ret = FlowReturn.OK
+        for frame, pts in sets:
+            arrays = [frame[p.name].memories[0] for p in self.sink_pads]
+            rank = max(m.host().ndim if not m.is_device else m.device().ndim
+                       for m in arrays)
+            np_axis = rank - 1 - self._nns_axis
+            if any(m.is_device for m in arrays):
+                out = jnp.concatenate([m.device() for m in arrays], axis=np_axis)
+            else:
+                out = np.concatenate([m.host() for m in arrays], axis=np_axis)
+            r = self.push(Buffer([TensorMemory(out)], pts=pts,
+                                 config=self._out_config))
+            if r is FlowReturn.ERROR:
+                ret = r
+        return ret
+
+    def _event_entry(self, pad: Pad, event: Event) -> None:
+        if event.type is EventType.EOS and self._collect is not None:
+            self._emit(self._collect.set_eos(pad.name))
+            with self._lock:
+                pad.eos = True
+                self._eos_pads.add(pad.name)
+                should = (self._collect.exhausted or
+                          len(self._eos_pads) >= len(self.sink_pads)) \
+                    and not self._eos_sent
+                if should:
+                    self._eos_sent = True
+            if should:
+                self.push_event_all(Event.eos())
+            return
+        super()._event_entry(pad, event)
+
+
+@register_element
+class TensorSplit(Element):
+    """One tensor → N tensors sliced along a reference dim.
+
+    ``tensorseg`` = comma-separated slice sizes (e.g. "1,2" over axis
+    ``option`` default 0=innermost). Reference gsttensorsplit.c semantics.
+    """
+
+    ELEMENT_NAME = "tensor_split"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.tensorseg: Optional[str] = None
+        self.option: str = "0"  # nns axis to slice
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+        self._sizes: Optional[List[int]] = None
+
+    @property
+    def _nns_axis(self) -> int:
+        return int(self.option)
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        cfg = caps.to_config()
+        info = cfg.info[0]
+        if not self.tensorseg:
+            raise ValueError("tensor_split requires tensorseg")
+        self._sizes = [int(s) for s in str(self.tensorseg).split(",")]
+        ax = self._nns_axis
+        if sum(self._sizes) != info.dims[ax]:
+            raise ValueError(
+                f"tensorseg {self._sizes} does not sum to dim {info.dims[ax]}")
+        if len(self.src_pads) != len(self._sizes):
+            raise ValueError(
+                f"tensor_split: {len(self._sizes)} segments but "
+                f"{len(self.src_pads)} pads linked")
+        for i, s in enumerate(self._sizes):
+            dims = list(info.dims)
+            dims[ax] = s
+            out = TensorsConfig(
+                TensorsInfo.of(TensorInfo(tuple(dims), info.dtype)), cfg.rate)
+            self.send_caps(Caps.tensors(out), i)
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        m = buf.memories[0]
+        arr = m.device() if m.is_device else m.host()
+        np_axis = arr.ndim - 1 - self._nns_axis
+        ret = FlowReturn.OK
+        off = 0
+        for i, s in enumerate(self._sizes):
+            sl = [slice(None)] * arr.ndim
+            sl[np_axis] = slice(off, off + s)
+            off += s
+            r = self.push(buf.with_memories([TensorMemory(arr[tuple(sl)])]), i)
+            if r is FlowReturn.ERROR:
+                ret = r
+        return ret

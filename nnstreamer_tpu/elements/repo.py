@@ -1,0 +1,120 @@
+"""tensor_reposink / tensor_reposrc — in-process slot table for pipeline
+loops (recurrence).
+
+Reference: gst/nnstreamer/elements/gsttensor_repo*.c + tensor_repo.h:40-60:
+a global slot table with cond-var handshake lets DAG pipelines express
+cycles (RNN/LSTM state feedback; tests/nnstreamer_repo_lstm). reposink
+writes ``slot-index``; reposrc reads it, emitting an initial dummy frame to
+break the chicken-and-egg at loop start.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorsConfig, TensorsInfo
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.pipeline import SourceElement
+
+
+class _Slot:
+    def __init__(self) -> None:
+        self.cv = threading.Condition()
+        self.buffer: Optional[Buffer] = None
+        self.eos = False
+
+
+_slots: Dict[int, _Slot] = {}
+_slots_lock = threading.Lock()
+
+
+def _slot(index: int) -> _Slot:
+    with _slots_lock:
+        if index not in _slots:
+            _slots[index] = _Slot()
+        return _slots[index]
+
+
+def reset_repo() -> None:
+    """Clear all slots (test isolation)."""
+    with _slots_lock:
+        _slots.clear()
+
+
+@register_element
+class TensorRepoSink(Element):
+    ELEMENT_NAME = "tensor_reposink"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.slot_index = 0
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        slot = _slot(int(self.slot_index))
+        with slot.cv:
+            slot.buffer = buf
+            slot.cv.notify_all()
+        return FlowReturn.OK
+
+    def on_eos(self) -> None:
+        slot = _slot(int(self.slot_index))
+        with slot.cv:
+            slot.eos = True
+            slot.cv.notify_all()
+
+
+@register_element
+class TensorRepoSrc(SourceElement):
+    """Reads a repo slot. ``caps`` (or dims/types props) declare the stream;
+    the first frame is zeros (loop bootstrap) unless ``no-initial=True``."""
+
+    ELEMENT_NAME = "tensor_reposrc"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.slot_index = 0
+        self.caps: Optional[Caps] = None
+        self.dims: Optional[str] = None
+        self.types: Optional[str] = None
+        self.no_initial = False
+        super().__init__(name, **props)
+        self._sent_initial = False
+        self._count = 0
+
+    def negotiate(self) -> Caps:
+        self._sent_initial = False
+        self._count = 0
+        if self.caps is not None:
+            return self.caps
+        if self.dims and self.types:
+            cfg = TensorsConfig(TensorsInfo.from_strings(self.dims, self.types))
+            return Caps.tensors(cfg)
+        raise ValueError("tensor_reposrc needs caps or dims/types")
+
+    def create(self) -> Optional[Buffer]:
+        slot = _slot(int(self.slot_index))
+        if not self._sent_initial and not self.no_initial:
+            self._sent_initial = True
+            cfg = (self.caps.to_config() if self.caps is not None
+                   else TensorsConfig(TensorsInfo.from_strings(self.dims, self.types)))
+            mems = [TensorMemory(np.zeros(i.shape, i.dtype.np_dtype))
+                    for i in cfg.info]
+            self._count += 1
+            return Buffer(mems, pts=0, config=cfg)
+        with slot.cv:
+            while slot.buffer is None and not slot.eos:
+                if self._stop_flag.is_set():
+                    return None
+                slot.cv.wait(0.05)
+            if slot.buffer is None and slot.eos:
+                return None
+            buf = slot.buffer
+            slot.buffer = None
+        self._count += 1
+        out = buf.with_memories(buf.memories, config=buf.config)
+        out.pts = buf.pts
+        return out

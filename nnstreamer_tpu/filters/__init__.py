@@ -1,0 +1,35 @@
+"""NN backend subplugins. Importing registers the built-ins."""
+
+from .base import (
+    FilterFramework,
+    FilterProps,
+    InvokeStats,
+    detect_framework,
+    find_filter,
+    register_filter,
+)
+from .custom import register_custom_easy, unregister_custom_easy
+
+_loaded = False
+
+
+def _ensure_builtin_filters() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import xla  # noqa: F401
+    from . import custom  # noqa: F401
+    try:
+        from . import torch_backend  # noqa: F401
+    except ImportError:  # torch genuinely absent
+        pass
+
+
+_ensure_builtin_filters()
+
+__all__ = [
+    "FilterFramework", "FilterProps", "InvokeStats", "detect_framework",
+    "find_filter", "register_filter", "register_custom_easy",
+    "unregister_custom_easy",
+]

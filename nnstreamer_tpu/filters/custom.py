@@ -1,0 +1,153 @@
+"""Custom filter backends: custom-easy (in-app callables) and python3
+(user script files).
+
+Reference equivalents:
+ * custom-easy — register a C callback + static I/O info in-app
+   (include/tensor_filter_custom_easy.h:25-74). Ours registers a Python
+   callable over numpy/jax arrays.
+ * python3 — load a user .py defining ``class CustomFilter`` with
+   getInputDimension/getOutputDimension/setInputDimension/invoke
+   (tensor_filter_python3.cc:85-135,224-273). Same class contract here,
+   numpy in/out.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorMemory
+from ..core.registry import SubpluginType, get_subplugin, register_subplugin
+from ..core.types import TensorsInfo
+from .base import FilterFramework, FilterProps, register_filter
+
+# --------------------------------------------------------------------------- #
+# custom-easy
+# --------------------------------------------------------------------------- #
+
+_easy_lock = threading.Lock()
+
+
+def register_custom_easy(name: str, fn: Callable[..., Any],
+                         in_info: Any, out_info: Any) -> None:
+    """Register an in-app model: ``fn(*arrays) -> array(s)`` with fixed I/O.
+
+    ``in_info``/``out_info`` accept TensorsInfo or ("dims", "types") tuples.
+    Use as: ``tensor_filter framework=custom-easy model=<name>``.
+    """
+    ii = in_info if isinstance(in_info, TensorsInfo) else TensorsInfo.from_strings(*in_info)
+    oi = out_info if isinstance(out_info, TensorsInfo) else TensorsInfo.from_strings(*out_info)
+    register_subplugin(SubpluginType.EASY_CUSTOM, name,
+                       {"fn": fn, "in": ii, "out": oi}, replace=True)
+
+
+def unregister_custom_easy(name: str) -> None:
+    from ..core.registry import unregister_subplugin
+
+    unregister_subplugin(SubpluginType.EASY_CUSTOM, name)
+
+
+@register_filter
+class CustomEasyFilter(FilterFramework):
+    NAME = "custom-easy"
+    ALLOCATE_IN_INVOKE = True
+    RUN_WITHOUT_MODEL = False  # model= names the registered callable
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._entry: Optional[Dict[str, Any]] = None
+
+    def open(self, props: FilterProps) -> None:
+        super().open(props)
+        name = props.model if isinstance(props.model, str) else None
+        if name is None:
+            raise ValueError("custom-easy: model= must name a registered callable")
+        entry = get_subplugin(SubpluginType.EASY_CUSTOM, name)
+        if entry is None:
+            raise ValueError(f"custom-easy: {name!r} is not registered")
+        self._entry = entry
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self._entry["in"], self._entry["out"]
+
+    def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        arrays = [m.host() for m in inputs]
+        out = self._entry["fn"](*arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return [TensorMemory(np.asarray(o) if not _is_jax(o) else o) for o in outs]
+
+
+def _is_jax(x: Any) -> bool:
+    return type(x).__module__.startswith("jax")
+
+
+# --------------------------------------------------------------------------- #
+# python3 script filter
+# --------------------------------------------------------------------------- #
+
+@register_filter
+class Python3Filter(FilterFramework):
+    """framework=python3 model=/path/to/script.py
+
+    The script defines ``class CustomFilter`` with:
+      * ``getInputDimension() -> (dims_str, types_str)`` (or TensorsInfo)
+      * ``getOutputDimension() -> (dims_str, types_str)``
+      * optional ``setInputDimension(in_info) -> out_info``
+      * ``invoke(*arrays) -> array(s)``
+    An optional module-level ``make_filter(options_dict)`` constructs it.
+    """
+
+    NAME = "python3"
+    ALIASES = ("python",)
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._obj: Any = None
+
+    def open(self, props: FilterProps) -> None:
+        super().open(props)
+        path = props.model_path
+        if not path or not os.path.isfile(path):
+            raise FileNotFoundError(f"python3 filter script not found: {path}")
+        spec = importlib.util.spec_from_file_location(
+            f"nns_tpu_pyfilter_{abs(hash(path))}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if hasattr(mod, "make_filter"):
+            self._obj = mod.make_filter(props.custom_dict())
+        elif hasattr(mod, "CustomFilter"):
+            self._obj = mod.CustomFilter()
+        else:
+            raise ValueError(f"{path}: must define CustomFilter or make_filter")
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        ii = oi = None
+        if hasattr(self._obj, "getInputDimension"):
+            ii = _coerce(self._obj.getInputDimension())
+        if hasattr(self._obj, "getOutputDimension"):
+            oi = _coerce(self._obj.getOutputDimension())
+        return ii, oi
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        if hasattr(self._obj, "setInputDimension"):
+            return _coerce(self._obj.setInputDimension(in_info))
+        return super().set_input_info(in_info)
+
+    def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        arrays = [m.host() for m in inputs]
+        out = self._obj.invoke(*arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return [TensorMemory(np.asarray(o)) for o in outs]
+
+
+def _coerce(v: Any) -> Optional[TensorsInfo]:
+    if v is None or isinstance(v, TensorsInfo):
+        return v
+    if isinstance(v, (tuple, list)) and len(v) == 2 and isinstance(v[0], str):
+        return TensorsInfo.from_strings(v[0], v[1])
+    raise ValueError(f"bad dimension spec {v!r}")

@@ -1,0 +1,82 @@
+"""framework=torch — TorchScript/nn.Module execution on CPU.
+
+Reference equivalent: tensor_filter_pytorch.cc (libtorch script modules).
+This exists for interop/parity — models whose source of truth is a
+TorchScript file; the TPU path is framework=xla-tpu. Torch here is CPU-only
+(no CUDA in the image); heavy workloads belong on the XLA backend.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorMemory
+from ..core.types import TensorsInfo
+from .base import FilterFramework, FilterProps, register_filter
+
+
+@register_filter
+class TorchFilter(FilterFramework):
+    NAME = "torch"
+    ALIASES = ("pytorch",)
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._module: Any = None
+
+    def open(self, props: FilterProps) -> None:
+        super().open(props)
+        import torch
+
+        model = props.model
+        if isinstance(model, str):
+            if not os.path.isfile(model):
+                raise FileNotFoundError(model)
+            self._module = torch.jit.load(model, map_location="cpu")
+        elif isinstance(model, torch.nn.Module):
+            self._module = model
+        else:
+            raise ValueError(f"torch: unsupported model {model!r}")
+        self._module.eval()
+        self._in_info = props.input_info
+        self._out_info = props.output_info
+
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self._in_info, self._out_info
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        import torch
+
+        self._in_info = in_info
+        with torch.no_grad():
+            dummies = [torch.zeros(*i.shape,
+                                   dtype=_torch_dtype(i.dtype.np_dtype))
+                       for i in in_info]
+            out = self._module(*dummies)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        from ..core.types import TensorInfo
+
+        self._out_info = TensorsInfo(tuple(
+            TensorInfo.from_shape(tuple(o.shape) or (1,), np.dtype(str(o.numpy().dtype)))
+            for o in outs))
+        return self._out_info
+
+    def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        import torch
+
+        with torch.no_grad():
+            tensors = [torch.from_numpy(np.ascontiguousarray(m.host()))
+                       for m in inputs]
+            out = self._module(*tensors)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return [TensorMemory(o.numpy()) for o in outs]
+
+
+def _torch_dtype(np_dtype: np.dtype):
+    import torch
+
+    return torch.from_numpy(np.zeros(1, np_dtype)).dtype

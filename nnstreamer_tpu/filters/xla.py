@@ -1,0 +1,225 @@
+"""The ``xla-tpu`` backend — first-class NN execution over JAX/XLA.
+
+This is the TPU-native replacement for the reference's device backends
+(tensor_filter_tensorrt.cc — the GPU path; tensor_filter_edgetpu.cc — the
+NPU path): one backend that compiles models with XLA and keeps all streaming
+I/O device-resident in HBM.
+
+Model forms accepted by ``model=``:
+  * ``zoo://<name>?opt=val`` — built-in model zoo (models/zoo.py);
+  * a Python file path — must export ``make_model(options) -> ModelBundle``
+    (or a dict with apply/params/in_info/out_info);
+  * an in-process callable ``fn(*arrays)`` or ``(fn, params)`` tuple or
+    ModelBundle — pipelines embedded in apps skip serialization entirely;
+  * a flax ``nn.Module`` plus ``custom="init=<H,W,C>"`` to self-initialize.
+
+Design notes (TPU-first):
+  * inputs are moved to device once (``TensorMemory.device()``); outputs stay
+    device-resident — ALLOCATE_IN_INVOKE zero-copy wrap downstream;
+  * invoke is **async**: XLA dispatch returns immediately, the pipeline
+    blocks only where a host boundary demands it (sink/decoder) — this is
+    what lets a streaming pipeline overlap host scheduling with TPU compute;
+    set ``custom="sync=true"`` for synchronous per-invoke latency accounting;
+  * optional ``custom="donate=true"`` donates input buffers (in-place reuse
+    of HBM when shapes/dtypes match);
+  * precision: ``custom="precision=bf16"`` casts float inputs to bfloat16 at
+    the XLA boundary (MXU-preferred; int inputs untouched).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.buffer import TensorMemory
+from ..core.log import logger
+from ..core.types import TensorInfo, TensorsInfo
+from ..models.zoo import ModelBundle, get_model
+from .base import FilterFramework, FilterProps, register_filter
+
+log = logger("xla")
+
+
+def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> ModelBundle:
+    """Normalize any accepted model form into a ModelBundle."""
+    options = options or {}
+    if isinstance(model, ModelBundle):
+        return model
+    if isinstance(model, (list, tuple)) and len(model) == 2 and callable(model[0]):
+        fn, params = model
+        return ModelBundle(getattr(fn, "__name__", "model"), fn, params=params)
+    if callable(model) and not isinstance(model, type):
+        # flax module instance?
+        try:
+            import flax.linen as fnn
+
+            if isinstance(model, fnn.Module):
+                return _bundle_from_flax(model, options)
+        except ImportError:
+            pass
+        return ModelBundle(getattr(model, "__name__", "model"), model)
+    if isinstance(model, str):
+        if model.startswith("zoo://") or not os.path.sep in model and not os.path.exists(model) \
+                and not model.endswith(".py"):
+            return get_model(model, **options)
+        if model.endswith(".py"):
+            return _bundle_from_pyfile(model, options)
+        raise ValueError(f"xla-tpu: unsupported model file {model!r} "
+                         "(use zoo://, a .py exporting make_model, or an "
+                         "in-process callable)")
+    raise ValueError(f"xla-tpu: cannot interpret model {model!r}")
+
+
+def _bundle_from_flax(module: Any, options: Dict[str, str]) -> ModelBundle:
+    import jax
+    import jax.numpy as jnp
+
+    init = options.get("init")
+    if not init:
+        raise ValueError("flax module models need custom=\"init=H,W,C[,B]\" "
+                         "(input shape) to self-initialize")
+    shape = tuple(int(x) for x in init.split(";" if ";" in init else ","))
+    if len(shape) == 3:
+        shape = (1,) + shape
+    dummy = jnp.zeros(shape, jnp.float32)
+    variables = module.init(jax.random.PRNGKey(int(options.get("seed", 0))), dummy)
+    return ModelBundle(type(module).__name__,
+                       lambda p, x: module.apply(p, x), params=variables)
+
+
+def _bundle_from_pyfile(path: str, options: Dict[str, str]) -> ModelBundle:
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    spec = importlib.util.spec_from_file_location(
+        f"nns_tpu_model_{os.path.basename(path).rstrip('.py')}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "make_model"):
+        raise ValueError(f"{path}: must export make_model(**options)")
+    bundle = mod.make_model(**options)
+    if isinstance(bundle, dict):
+        bundle = ModelBundle(
+            bundle.get("name", os.path.basename(path)),
+            bundle["apply"], params=bundle.get("params"),
+            in_info=_coerce_info(bundle.get("in_info")),
+            out_info=_coerce_info(bundle.get("out_info")))
+    return bundle
+
+
+def _coerce_info(v: Any) -> Optional[TensorsInfo]:
+    if v is None or isinstance(v, TensorsInfo):
+        return v
+    if isinstance(v, (tuple, list)) and len(v) == 2:
+        return TensorsInfo.from_strings(v[0], v[1])
+    raise ValueError(f"bad tensor info spec {v!r}")
+
+
+@register_filter
+class XLAFilter(FilterFramework):
+    """framework=xla-tpu (aliases: xla, jax)."""
+
+    NAME = "xla-tpu"
+    ALIASES = ("xla", "jax")
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._bundle: Optional[ModelBundle] = None
+        self._jitted: Optional[Callable] = None
+        self._device = None
+        self._sync = False
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------- #
+    def open(self, props: FilterProps) -> None:
+        super().open(props)
+        opts = props.custom_dict()
+        self._bundle = resolve_model(props.model, opts)
+        self._device = props.accelerator.pick_device()
+        self._sync = opts.get("sync", "false").lower() in ("1", "true", "yes")
+        self._precision = opts.get("precision", "")
+        self._donate = opts.get("donate", "false").lower() in ("1", "true", "yes")
+        self._build_jit()
+        self._in_info = props.input_info or self._bundle.in_info
+        self._out_info = props.output_info or self._bundle.out_info
+        if self._in_info is not None and self._out_info is None:
+            self._out_info = self._infer_out_info(self._in_info)
+        log.info("xla-tpu opened model=%s device=%s sync=%s",
+                 self._bundle.name, self._device, self._sync)
+
+    def _build_jit(self) -> None:
+        import jax
+
+        fn = self._bundle.fn()
+        precision = self._precision
+
+        def wrapped(*xs):
+            if precision in ("bf16", "bfloat16"):
+                import jax.numpy as jnp
+
+                xs = tuple(x.astype(jnp.bfloat16)
+                           if np.issubdtype(np.dtype(str(x.dtype)), np.floating) else x
+                           for x in xs)
+            out = fn(*xs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        kw: Dict[str, Any] = {}
+        if self._donate:
+            kw["donate_argnums"] = tuple(range(8))
+        self._jitted = jax.jit(wrapped, **kw)
+
+    def close(self) -> None:
+        self._jitted = None
+        self._bundle = None
+        super().close()
+
+    # -- model metadata ------------------------------------------------------ #
+    def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        return self._in_info, self._out_info
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        self._in_info = in_info
+        self._out_info = self._infer_out_info(in_info)
+        return self._out_info
+
+    def _infer_out_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        """Shape-infer outputs via jax.eval_shape (no FLOPs, no transfer)."""
+        import jax
+
+        specs = [jax.ShapeDtypeStruct(i.shape, i.dtype.np_dtype) for i in in_info]
+        out = jax.eval_shape(self._jitted, *specs)
+        infos = tuple(TensorInfo.from_shape(o.shape if o.shape else (1,), o.dtype)
+                      for o in out)
+        return TensorsInfo(infos)
+
+    # -- execution ----------------------------------------------------------- #
+    def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        arrays = [m.device(self._device) for m in inputs]
+        with self._lock:
+            outs = self._jitted(*arrays)
+        if self._sync:
+            for o in outs:
+                o.block_until_ready()
+        return [TensorMemory(o) for o in outs]
+
+    # -- events -------------------------------------------------------------- #
+    def reload_model(self, model: Any) -> None:
+        """Hot swap: same I/O contract required (reference RELOAD semantics)."""
+        opts = self.props.custom_dict() if self.props else {}
+        new_bundle = resolve_model(model, opts)
+        old_in, old_out = self._in_info, self._out_info
+        self._bundle = new_bundle
+        self._build_jit()
+        if old_in is not None:
+            new_out = self._infer_out_info(old_in)
+            if old_out is not None and not new_out.is_compatible(old_out):
+                raise ValueError(
+                    f"reload rejected: output info changed {old_out} -> {new_out}")
+            self._out_info = new_out
+        log.info("xla-tpu reloaded model=%s", new_bundle.name)

@@ -1,0 +1,5 @@
+"""Model zoo: native JAX/flax models + the ModelBundle contract."""
+
+from .zoo import ModelBundle, get_model, model_names, register_model
+
+__all__ = ["ModelBundle", "get_model", "model_names", "register_model"]

@@ -1,0 +1,139 @@
+"""MobileNet-v2 in flax — the flagship streaming-classification model.
+
+Fills the role of the reference's mobilenet tflite models
+(tests/test_models/models/mobilenet_v*; BASELINE config "MobileNet-v2
+image_labeling") as a native JAX/flax implementation designed for the MXU:
+NHWC layout, channels padded to hardware-friendly multiples via the width
+multiplier, bf16 compute with f32 params by default.
+
+Output is 1001-way logits (background class + 1000 ImageNet classes), the
+tflite convention the reference's image_labeling decoder expects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+# (expansion t, out channels c, repeats n, stride s) — MobileNet-v2 paper table 2
+_INVERTED_RESIDUAL_SETTINGS: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    groups: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME",
+                    feature_group_count=self.groups, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         momentum=0.97, epsilon=1e-3)(x)
+        return jnp.minimum(jnp.maximum(x, 0.0), 6.0)  # ReLU6
+
+
+class InvertedResidual(nn.Module):
+    features: int
+    stride: int
+    expand_ratio: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand_ratio
+        use_res = self.stride == 1 and in_ch == self.features
+        y = x
+        if self.expand_ratio != 1:
+            y = ConvBNReLU(hidden, kernel=1, dtype=self.dtype)(y, train)
+        # depthwise
+        y = ConvBNReLU(hidden, kernel=3, stride=self.stride, groups=hidden,
+                       dtype=self.dtype)(y, train)
+        # linear projection
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         momentum=0.97, epsilon=1e-3)(y)
+        return x + y if use_res else y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1001
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        ch = _make_divisible(32 * self.width)
+        x = ConvBNReLU(ch, stride=2, dtype=self.dtype)(x, train)
+        for t, c, n, s in _INVERTED_RESIDUAL_SETTINGS:
+            out_ch = _make_divisible(c * self.width)
+            for i in range(n):
+                x = InvertedResidual(out_ch, s if i == 0 else 1, t,
+                                     dtype=self.dtype)(x, train)
+        last = _make_divisible(1280 * max(1.0, self.width))
+        x = ConvBNReLU(last, kernel=1, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def preprocess_uint8(x: jax.Array) -> jax.Array:
+    """uint8 RGB [0,255] → float [-1,1] (tflite mobilenet convention)."""
+    return x.astype(jnp.float32) / 127.5 - 1.0
+
+
+def make_mobilenet_v2(width: str = "1.0", size: str = "224",
+                      num_classes: str = "1001", checkpoint: Optional[str] = None,
+                      dtype: str = "bfloat16", seed: str = "0",
+                      batch: str = "1", **_: Any) -> ModelBundle:
+    w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
+    model = MobileNetV2(num_classes=nc, width=w,
+                        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    dummy = jnp.zeros((b, hw, hw, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    if checkpoint:
+        from ..utils import checkpoints
+
+        variables = checkpoints.load_variables(checkpoint, variables)
+
+    def apply(params, x):
+        if x.dtype == jnp.uint8:
+            x = preprocess_uint8(x)
+        return model.apply(params, x, train=False)
+
+    in_info = TensorsInfo.from_strings(f"3:{hw}:{hw}:{b}", "uint8")
+    out_info = TensorsInfo.from_strings(f"{nc}:{b}", "float32")
+    return ModelBundle("mobilenet_v2", apply, params=variables,
+                       in_info=in_info, out_info=out_info,
+                       preprocess=preprocess_uint8,
+                       metadata={"width": w, "size": hw, "classes": nc})
+
+
+register_model("mobilenet_v2", make_mobilenet_v2)

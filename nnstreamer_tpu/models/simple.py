@@ -1,0 +1,63 @@
+"""Tiny built-in models used by tests and examples.
+
+Mirrors the reference's custom test filters
+(tests/nnstreamer_example/custom_example_{passthrough,scaler,average,...}) —
+scaffolding models standing in for real networks — implemented as jax
+functions registered in the zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+
+def _info_from(dims: str, types: str) -> TensorsInfo:
+    return TensorsInfo.from_strings(dims, types)
+
+
+def make_passthrough(dims: str = "3:224:224:1", types: str = "uint8", **_: Any) -> ModelBundle:
+    info = _info_from(dims, types)
+    return ModelBundle("passthrough", lambda *xs: xs if len(xs) > 1 else xs[0],
+                       in_info=info, out_info=info)
+
+
+def make_scaler(dims: str = "3:224:224:1", types: str = "float32",
+                scale: str = "2.0", **_: Any) -> ModelBundle:
+    info = _info_from(dims, types)
+    s = float(scale)
+    return ModelBundle("scaler", lambda x: x * s, in_info=info, out_info=info)
+
+
+def make_average(dims: str = "3:224:224:1", types: str = "float32", **_: Any) -> ModelBundle:
+    """Per-frame global average → one scalar per frame (custom_example_average)."""
+    in_info = _info_from(dims, types)
+    out_info = TensorsInfo.from_strings("1:1", types)
+    return ModelBundle(
+        "average",
+        lambda x: jnp.mean(x.astype(jnp.float32), axis=tuple(range(1, x.ndim)),
+                           keepdims=False).reshape(-1, 1).astype(x.dtype),
+        in_info=in_info, out_info=out_info)
+
+
+def make_matmul(n: str = "256", batch: str = "1", seed: str = "0", **_: Any) -> ModelBundle:
+    """Dense layer stand-in: x @ W with a fixed random W (MXU exerciser)."""
+    import jax
+
+    dim, b = int(n), int(batch)
+    key = jax.random.PRNGKey(int(seed))
+    w = jax.random.normal(key, (dim, dim), jnp.float32) / np.sqrt(dim)
+    info = TensorsInfo.from_strings(f"{dim}:{b}", "float32")
+    return ModelBundle("matmul", lambda p, x: x @ p, params=w,
+                       in_info=info, out_info=info)
+
+
+register_model("passthrough", make_passthrough)
+register_model("scaler", make_scaler)
+register_model("average", make_average)
+register_model("matmul", make_matmul)

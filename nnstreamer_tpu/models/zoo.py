@@ -1,0 +1,96 @@
+"""Model zoo registry + the ModelBundle contract used by the xla-tpu backend.
+
+The reference loads opaque model files (.tflite/.pb/.pt) through per-backend
+C++ runtimes; the TPU-native equivalent is a *pure function + params* pair
+compiled by XLA. ``ModelBundle`` is that contract. Sources:
+
+ * zoo models registered here ("zoo://mobilenet_v2?width=0.25"),
+ * user .py files exporting ``make_model(options) -> ModelBundle`` (or dict),
+ * in-process callables / flax modules handed directly to ``model=``.
+
+Params checkpointing uses orbax/flax serialization; a bundle may lazily
+initialize random params when no checkpoint is given (streaming smoke tests
+and benchmarks exercise compute, not trained weights — like the reference's
+tests use tiny stand-in models, component-description.md:126).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.types import TensorsInfo
+
+_lock = threading.Lock()
+_factories: Dict[str, Callable[..., "ModelBundle"]] = {}
+
+
+@dataclass
+class ModelBundle:
+    """A jax-callable model: ``apply(params, *inputs) -> output(s)``.
+
+    ``in_info``/``out_info`` describe per-frame I/O (batch dim included).
+    ``preprocess``/``postprocess`` are optional jax-traceable stages the
+    pipeline may fuse into the same XLA program as the model.
+    """
+
+    name: str
+    apply: Callable[..., Any]
+    params: Any = None
+    in_info: Optional[TensorsInfo] = None
+    out_info: Optional[TensorsInfo] = None
+    preprocess: Optional[Callable[..., Any]] = None
+    postprocess: Optional[Callable[..., Any]] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def fn(self) -> Callable[..., Any]:
+        """Params-closed pure function over input arrays."""
+        params = self.params
+        apply = self.apply
+        if params is None:
+            return apply
+        return lambda *xs: apply(params, *xs)
+
+
+def register_model(name: str, factory: Callable[..., ModelBundle]) -> None:
+    with _lock:
+        _factories[name.lower()] = factory
+
+
+def model_names() -> List[str]:
+    _ensure_builtin_models()
+    with _lock:
+        return sorted(_factories)
+
+
+def get_model(spec: str, **overrides: Any) -> ModelBundle:
+    """Resolve "zoo://name?opt=val" or bare "name"."""
+    _ensure_builtin_models()
+    s = spec
+    if s.startswith("zoo://"):
+        s = s[len("zoo://"):]
+    if "?" in s:
+        s, qs = s.split("?", 1)
+        opts = {k: v[0] for k, v in urllib.parse.parse_qs(qs).items()}
+    else:
+        opts = {}
+    opts.update(overrides)
+    with _lock:
+        factory = _factories.get(s.lower())
+    if factory is None:
+        raise ValueError(f"unknown zoo model {spec!r}; known: {model_names()}")
+    return factory(**opts)
+
+
+_builtins_loaded = False
+
+
+def _ensure_builtin_models() -> None:
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    from . import mobilenet_v2  # noqa: F401
+    from . import simple  # noqa: F401

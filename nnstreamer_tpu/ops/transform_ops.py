@@ -1,0 +1,239 @@
+"""tensor_transform operator library, lowered to XLA.
+
+Reference: gst/nnstreamer/elements/gsttensortransform.c (modes
+dimchg/typecast/arithmetic/transpose/stand/clamp, tensor_transform.h:57-84).
+The reference hand-vectorizes with Orc codegen (transform-orc.orc) when
+``acceleration=true``; here every mode builds a pure jax function and XLA
+fuses the whole chain into one kernel — on TPU these ride the VPU and fuse
+into neighboring MXU ops, which is the point of lowering the pipeline's
+elementwise stages instead of running them on host.
+
+Option-string grammar matches the reference:
+  * typecast:   "float32"
+  * arithmetic: "typecast:float32,add:-127.5,div:127.5" (chained ops; values
+                may be per-channel lists "add:1;2;3")
+  * transpose:  "1:0:2:3" — permutation in reference dim order (innermost
+                first); output dim i takes input dim perm[i]
+  * dimchg:     "0:2" — move dim position a to position b (reference dim idx)
+  * stand:      "default" | "dc-average" [":per-channel"]
+  * clamp:      "min:max"
+
+All dims in options use the reference's innermost-first convention and are
+translated to row-major numpy axes internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.types import TensorDType, TensorInfo
+
+
+def _np_axis(rank: int, nns_dim_index: int) -> int:
+    """Reference dim index (0 = innermost) → numpy axis."""
+    return rank - 1 - nns_dim_index
+
+
+def _parse_value(s: str):
+    """Scalar or ';'-separated per-channel vector."""
+    if ";" in s:
+        return np.array([float(v) for v in s.split(";")], np.float32)
+    return float(s)
+
+
+class Transform:
+    """One parsed transform stage: jax-traceable ``fn`` + static out-info."""
+
+    def __init__(self, fn: Callable[[Any], Any],
+                 out_info_fn: Callable[[TensorInfo], TensorInfo],
+                 descr: str):
+        self.fn = fn
+        self.out_info = out_info_fn
+        self.descr = descr
+
+    def __repr__(self) -> str:
+        return f"Transform({self.descr})"
+
+
+def build(mode: str, option: str) -> Transform:
+    mode = mode.strip().lower()
+    if mode == "typecast":
+        return _typecast(option)
+    if mode == "arithmetic":
+        return _arithmetic(option)
+    if mode == "transpose":
+        return _transpose(option)
+    if mode == "dimchg":
+        return _dimchg(option)
+    if mode == "stand":
+        return _stand(option)
+    if mode == "clamp":
+        return _clamp(option)
+    raise ValueError(f"unknown transform mode {mode!r}")
+
+
+# --------------------------------------------------------------------------- #
+
+def _typecast(option: str) -> Transform:
+    dtype = TensorDType.parse(option)
+    import jax.numpy as jnp
+
+    target = jnp.dtype(dtype.np_dtype)
+
+    def fn(x):
+        return x.astype(target)
+
+    return Transform(fn, lambda i: TensorInfo(i.dims, dtype, i.name),
+                     f"typecast:{dtype}")
+
+
+_ARITH_OPS = {"add", "sub", "mul", "div"}
+
+
+def _arithmetic(option: str) -> Transform:
+    """Chained "typecast:T,add:V,mul:V,div:V" ops, evaluated in order
+    (reference gst_tensor_transform arithmetic chain)."""
+    import jax.numpy as jnp
+
+    steps: List[Tuple[str, Any]] = []
+    out_dtype: Optional[TensorDType] = None
+    for part in option.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError(f"arithmetic op needs value: {part!r}")
+        op, val = part.split(":", 1)
+        op = op.strip().lower()
+        if op == "typecast":
+            dt = TensorDType.parse(val)
+            steps.append(("typecast", jnp.dtype(dt.np_dtype)))
+            out_dtype = dt
+        elif op in _ARITH_OPS:
+            steps.append((op, _parse_value(val)))
+        else:
+            raise ValueError(f"unknown arithmetic op {op!r}")
+    if not steps:
+        raise ValueError("empty arithmetic option")
+
+    def fn(x):
+        for op, val in steps:
+            if op == "typecast":
+                x = x.astype(val)
+            elif op == "add":
+                x = x + val
+            elif op == "sub":
+                x = x - val
+            elif op == "mul":
+                x = x * val
+            elif op == "div":
+                x = x / val
+        return x
+
+    def out_info(i: TensorInfo) -> TensorInfo:
+        return TensorInfo(i.dims, out_dtype or i.dtype, i.name)
+
+    return Transform(fn, out_info, f"arithmetic:{option}")
+
+
+def _transpose(option: str) -> Transform:
+    perm_nns = [int(x) for x in option.split(":")]
+    rank = len(perm_nns)
+    if sorted(perm_nns) != list(range(rank)):
+        raise ValueError(f"transpose option must be a permutation: {option!r}")
+    import jax.numpy as jnp
+
+    # output nns-dim i = input nns-dim perm[i]  →  row-major axes:
+    # out axis (rank-1-i) takes input axis (rank-1-perm[i])
+    np_perm = [0] * rank
+    for i, p in enumerate(perm_nns):
+        np_perm[rank - 1 - i] = rank - 1 - p
+
+    def fn(x):
+        if x.ndim != rank:
+            raise ValueError(
+                f"transpose rank mismatch: option rank {rank}, tensor rank {x.ndim}")
+        return jnp.transpose(x, np_perm)
+
+    def out_info(i: TensorInfo) -> TensorInfo:
+        if i.rank != rank:
+            raise ValueError(
+                f"transpose rank mismatch: option rank {rank} vs {i.rank}")
+        dims = tuple(i.dims[p] for p in perm_nns)
+        return TensorInfo(dims, i.dtype, i.name)
+
+    return Transform(fn, out_info, f"transpose:{option}")
+
+
+def _dimchg(option: str) -> Transform:
+    a_str, b_str = option.split(":")
+    a, b = int(a_str), int(b_str)
+    import jax.numpy as jnp
+
+    def fn(x):
+        rank = x.ndim
+        return jnp.moveaxis(x, _np_axis(rank, a), _np_axis(rank, b))
+
+    def out_info(i: TensorInfo) -> TensorInfo:
+        dims = list(i.dims)
+        dims.insert(b, dims.pop(a))
+        return TensorInfo(tuple(dims), i.dtype, i.name)
+
+    return Transform(fn, out_info, f"dimchg:{option}")
+
+
+def _stand(option: str) -> Transform:
+    import jax.numpy as jnp
+
+    parts = [p.strip().lower() for p in option.split(":")] if option else ["default"]
+    scheme = parts[0] or "default"
+    per_channel = len(parts) > 1 and parts[1] == "per-channel"
+    if scheme not in ("default", "dc-average"):
+        raise ValueError(f"unknown stand scheme {scheme!r}")
+
+    def fn(x):
+        xf = x.astype(jnp.float32)
+        # channel axis = innermost (reference dim[0]) = last row-major axis
+        axes = tuple(range(xf.ndim - 1)) if per_channel else None
+        mean = jnp.mean(xf, axis=axes, keepdims=per_channel)
+        if scheme == "dc-average":
+            return xf - mean
+        std = jnp.std(xf, axis=axes, keepdims=per_channel)
+        return (xf - mean) / (std + 1e-10)
+
+    return Transform(fn,
+                     lambda i: TensorInfo(i.dims, TensorDType.FLOAT32, i.name),
+                     f"stand:{option}")
+
+
+def _clamp(option: str) -> Transform:
+    lo_s, hi_s = option.split(":")
+    lo, hi = float(lo_s), float(hi_s)
+    if lo > hi:
+        raise ValueError(f"clamp min > max: {option!r}")
+    import jax.numpy as jnp
+
+    def fn(x):
+        return jnp.clip(x, lo, hi)
+
+    return Transform(fn, lambda i: i, f"clamp:{option}")
+
+
+def compose(transforms: Sequence[Transform]) -> Transform:
+    """Fuse a chain of transforms into one (XLA compiles it as one kernel)."""
+    if len(transforms) == 1:
+        return transforms[0]
+
+    def fn(x):
+        for t in transforms:
+            x = t.fn(x)
+        return x
+
+    def out_info(i: TensorInfo) -> TensorInfo:
+        for t in transforms:
+            i = t.out_info(i)
+        return i
+
+    return Transform(fn, out_info, "+".join(t.descr for t in transforms))

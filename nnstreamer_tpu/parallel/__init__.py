@@ -1,0 +1,15 @@
+"""SPMD parallel layer: device meshes, GSPMD shardings, sharded steps."""
+
+from .mesh import auto_mesh_2d, batch_sharding, make_mesh, replicated
+from .sharding import param_shardings, param_spec, shard_params
+from .train import (
+    cross_entropy_loss,
+    make_sharded_infer_step,
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "auto_mesh_2d", "batch_sharding", "make_mesh", "replicated",
+    "param_shardings", "param_spec", "shard_params",
+    "cross_entropy_loss", "make_sharded_infer_step", "make_sharded_train_step",
+]

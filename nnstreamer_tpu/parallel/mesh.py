@@ -1,0 +1,68 @@
+"""Device mesh construction + sharding helpers.
+
+The reference's distribution story is pipeline offload over sockets (§2.5);
+the TPU-native upgrade is SPMD sharding over a ``jax.sharding.Mesh`` with XLA
+collectives riding ICI. This module owns mesh/axis conventions for the whole
+framework:
+
+  axes: ``data`` (batch/data parallel) × ``model`` (tensor parallel).
+  Streaming inference shards the frame batch over ``data`` and the channel/
+  classifier dimensions over ``model``; the training step (utils for
+  fine-tuning deployed models) uses the same mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh. ``axes`` maps axis name → size; total must equal device
+    count. Default: all devices on ``data`` (pure DP)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"data": len(devs)}
+    sizes = tuple(axes.values())
+    if int(np.prod(sizes)) != len(devs):
+        raise ValueError(f"mesh axes {axes} need {np.prod(sizes)} devices, "
+                         f"have {len(devs)}")
+    arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def auto_mesh_2d(n_devices: Optional[int] = None,
+                 model_parallel: Optional[int] = None) -> Mesh:
+    """data×model mesh: pick the largest model axis ≤ sqrt(n) that divides n
+    (or honor an explicit ``model_parallel``)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    if model_parallel is None:
+        model_parallel = 1
+        for m in range(int(np.sqrt(n)), 0, -1):
+            if n % m == 0:
+                model_parallel = m
+                break
+    if n % model_parallel:
+        raise ValueError(f"{model_parallel=} does not divide {n=}")
+    return make_mesh({"data": n // model_parallel, "model": model_parallel},
+                     devices=devs)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: shard the leading (batch) axis over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_multiple(mesh: Mesh) -> int:
+    """Global batch must be a multiple of the data-axis size."""
+    return mesh.shape.get("data", 1)

@@ -1,0 +1,39 @@
+"""Model parameter (de)serialization.
+
+The reference treats models as opaque backend files; our native format is a
+flax param pytree serialized with msgpack (``.msgpack``) or an orbax
+checkpoint directory. This also backs model hot-reload
+(``is-updatable`` + RELOAD_MODEL): swap in new params without pipeline
+restart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def save_variables(path: str, variables: Any) -> None:
+    if path.endswith(".msgpack"):
+        from flax import serialization
+
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(variables))
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), variables)
+        ckptr.wait_until_finished()
+
+
+def load_variables(path: str, template: Any) -> Any:
+    if path.endswith(".msgpack"):
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            return serialization.from_bytes(template, f.read())
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), target=template)

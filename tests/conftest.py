@@ -6,12 +6,18 @@ driver's multi-chip dry-run environment.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the ambient axon/TPU tunnel
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-registers the TPU tunnel regardless of
+# JAX_PLATFORMS; the config update below wins over it
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

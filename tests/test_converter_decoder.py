@@ -1,0 +1,307 @@
+"""tensor_converter + tensor_decoder tests (mirrors reference
+unittest_converter/unittest_decoder + SSAT decoder groups)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import (
+    Buffer,
+    Caps,
+    TensorsConfig,
+    TensorsInfo,
+    TensorDType,
+)
+from nnstreamer_tpu.graph import Pipeline
+
+
+def run_simple(elements_factory, timeout=30):
+    p = Pipeline()
+    els = elements_factory(p)
+    Pipeline.link(*els)
+    p.run(timeout=timeout)
+    return els
+
+
+class TestVideoConverter:
+    def test_rgb_to_tensor(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=16, height=8, num_buffers=2,
+                        pattern="gradient")
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        assert b.memories[0].host().shape == (1, 8, 16, 3)
+        cfg = b.config
+        assert cfg.info[0].dims == (3, 16, 8, 1)  # C:W:H:N reference order
+        assert cfg.info[0].dtype is TensorDType.UINT8
+
+    def test_frames_per_tensor(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=8, height=8, num_buffers=4)
+        conv = p.add_new("tensor_converter", frames_per_tensor=2)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers == 2
+        assert sink.buffers[0].memories[0].host().shape == (2, 8, 8, 3)
+
+    def test_gray8(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=8, height=4, num_buffers=1,
+                        format="GRAY8")
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        assert sink.buffers[0].memories[0].host().shape == (1, 4, 8, 1)
+
+
+class TestAudioTextOctet:
+    def test_audio(self):
+        p = Pipeline()
+        src = p.add_new("audiotestsrc", num_buffers=2, samplesperbuffer=128,
+                        channels=2)
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        assert sink.buffers[0].memories[0].host().shape == (128, 2)
+
+    def test_octet_reinterpret(self, tmp_path):
+        path = tmp_path / "data.bin"
+        arr = np.arange(12, dtype=np.float32)
+        path.write_bytes(arr.tobytes())
+        p = Pipeline()
+        src = p.add_new("filesrc", location=str(path), blocksize=48)
+        conv = p.add_new("tensor_converter", input_dim="4:3", input_type="float32")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        out = sink.buffers[0].memories[0].host()
+        assert out.shape == (3, 4)
+        np.testing.assert_array_equal(out.reshape(-1), arr)
+
+    def test_octet_missing_props_fails(self):
+        from nnstreamer_tpu.graph import PipelineError
+
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=Caps("application/octet-stream"),
+                        data=[np.zeros(8, np.uint8)])
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, sink)
+        with pytest.raises(PipelineError):
+            p.run(timeout=30)
+
+
+class TestCustomConverter:
+    def test_registered_callable(self):
+        from nnstreamer_tpu.converters import register_converter, unregister_converter
+        from nnstreamer_tpu.core import TensorsConfig, TensorsInfo
+
+        def conv_fn(buf, props):
+            arr = buf.memories[0].host().astype(np.float32) / 255.0
+            cfg = TensorsConfig(TensorsInfo.of(
+                __import__("nnstreamer_tpu").core.TensorInfo.from_array(arr)))
+            return [arr], cfg
+
+        register_converter("halver", conv_fn)
+        try:
+            p = Pipeline()
+            src = p.add_new("videotestsrc", width=4, height=4, num_buffers=1)
+            conv = p.add_new("tensor_converter", mode="custom:halver")
+            sink = p.add_new("tensor_sink", store=True)
+            Pipeline.link(src, conv, sink)
+            p.run(timeout=30)
+            assert sink.buffers[0].memories[0].host().dtype == np.float32
+        finally:
+            unregister_converter("halver")
+
+
+class TestImageLabeling:
+    def test_label_decode(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("cat\ndog\norange\n")
+        p = Pipeline()
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("3:1", "float32"), 0)),
+                        data=[np.array([[0.1, 0.2, 0.9]], np.float32)])
+        dec = p.add_new("tensor_decoder", mode="image_labeling",
+                        option1=str(labels))
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        assert b.meta["label"] == "orange"
+        assert bytes(b.memories[0].host().tobytes()) == b"orange"
+        assert sink.sink_pad.caps.media_type == "text/x-raw"
+
+    def test_missing_label_file_fails(self):
+        from nnstreamer_tpu.graph import PipelineError
+
+        p = Pipeline()
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("3:1", "float32"), 0)),
+                        data=[np.zeros((1, 3), np.float32)])
+        dec = p.add_new("tensor_decoder", mode="image_labeling",
+                        option1="/nonexistent/labels.txt")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, dec, sink)
+        with pytest.raises((PipelineError, FileNotFoundError)):
+            p.run(timeout=30)
+
+
+class TestDirectVideo:
+    def test_tensor_to_video(self):
+        p = Pipeline()
+        frame = np.random.default_rng(0).integers(0, 255, (1, 6, 8, 3)).astype(np.uint8)
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("3:8:6:1", "uint8"), 30)),
+                        data=[frame])
+        dec = p.add_new("tensor_decoder", mode="direct_video")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        caps = sink.sink_pad.caps
+        assert caps.media_type == "video/x-raw"
+        assert caps.get("format") == "RGB"
+        assert caps.get("width") == 8 and caps.get("height") == 6
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(), frame[0])
+
+
+class TestBoundingBox:
+    def _ssd_postprocess_buffers(self):
+        boxes = np.array([[[0.1, 0.1, 0.5, 0.5],
+                           [0.6, 0.6, 0.9, 0.9]]], np.float32)  # (1,2,4) ymin,xmin,ymax,xmax
+        classes = np.array([[0, 1]], np.float32)
+        scores = np.array([[0.9, 0.8]], np.float32)
+        count = np.array([2], np.float32)
+        return (boxes, classes, scores, count)
+
+    def test_postprocess_mode(self, tmp_path):
+        labels = tmp_path / "coco.txt"
+        labels.write_text("person\ncar\n")
+        p = Pipeline()
+        info = TensorsInfo.from_strings("4:2:1,2:1,2:1,1", "float32")
+        src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(info, 0)),
+                        data=[self._ssd_postprocess_buffers()])
+        dec = p.add_new("tensor_decoder", mode="bounding_box",
+                        option1="mobilenet-ssd-postprocess",
+                        option2=str(labels), option4="160:120", option5="300:300")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        canvas = b.memories[0].host()
+        assert canvas.shape == (120, 160, 4)
+        dets = b.meta["detections"]
+        assert len(dets) == 2
+        assert dets[0]["label"] == "person"
+        # box pixels drawn: check a corner of the first box
+        x0, y0 = int(0.1 * 160), int(0.1 * 120)
+        assert canvas[y0, x0, 3] == 255  # green box alpha
+
+    def test_mobilenet_ssd_priors(self, tmp_path):
+        # 2 priors, centered boxes; zero locations decode to the priors
+        priors = tmp_path / "box_priors.txt"
+        pr_y = [0.3, 0.7]
+        pr_x = [0.3, 0.7]
+        pr_h = [0.2, 0.2]
+        pr_w = [0.2, 0.2]
+        priors.write_text("\n".join(" ".join(str(v) for v in row)
+                                    for row in [pr_y, pr_x, pr_h, pr_w]))
+        locs = np.zeros((1, 2, 4), np.float32)
+        # logits: background, classA → prior 0 scores high on class A
+        scores = np.array([[[-10.0, 5.0], [-10.0, -10.0]]], np.float32)
+        labels = tmp_path / "l.txt"
+        labels.write_text("bg\nthing\n")
+        p = Pipeline()
+        info = TensorsInfo.from_strings("4:2:1,2:2:1", "float32")
+        src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(info, 0)),
+                        data=[(locs, scores)])
+        dec = p.add_new("tensor_decoder", mode="bounding_box",
+                        option1="mobilenet-ssd", option2=str(labels),
+                        option3=str(priors), option4="100:100", option5="300:300")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        dets = sink.buffers[0].meta["detections"]
+        assert len(dets) == 1
+        x0, y0, x1, y1 = dets[0]["box"]
+        assert x0 == pytest.approx(0.2, abs=1e-5)
+        assert y1 == pytest.approx(0.4, abs=1e-5)
+        assert dets[0]["label"] == "thing"
+
+
+class TestImageSegment:
+    def test_deeplab_argmax(self):
+        h, w, classes = 5, 4, 3
+        logits = np.zeros((1, h, w, classes), np.float32)
+        logits[0, :, :, 0] = 1.0
+        logits[0, 2, 1, 2] = 5.0  # one pixel of class 2
+        p = Pipeline()
+        info = TensorsInfo.from_strings(f"{classes}:{w}:{h}:1", "float32")
+        src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(info, 0)),
+                        data=[logits])
+        dec = p.add_new("tensor_decoder", mode="image_segment",
+                        option1="tflite-deeplab")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        canvas = sink.buffers[0].memories[0].host()
+        assert canvas.shape == (h, w, 4)
+        assert canvas[2, 1, 3] == 160  # class pixel colored
+        assert canvas[0, 0, 3] == 0    # background transparent
+
+
+class TestPose:
+    def test_keypoint_decode(self):
+        H = W = 9
+        K = 17
+        hm = np.full((1, H, W, K), -5.0, np.float32)
+        for k in range(K):
+            hm[0, k % H, (k * 2) % W, k] = 5.0
+        p = Pipeline()
+        info = TensorsInfo.from_strings(f"{K}:{W}:{H}:1", "float32")
+        src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(info, 0)),
+                        data=[hm])
+        dec = p.add_new("tensor_decoder", mode="pose_estimation",
+                        option1="90:90", option2="9:9")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        pts = b.meta["keypoints"]
+        assert len(pts) == K
+        # keypoint 3 peak at (x=6,y=3) → normalized center of that cell
+        nx, ny, score = pts[3]
+        assert nx == pytest.approx((6 + 0.5) / 9, abs=1e-6)
+        assert ny == pytest.approx((3 + 0.5) / 9, abs=1e-6)
+        assert score > 0.99
+        assert b.memories[0].host().shape == (90, 90, 4)
+
+
+class TestFlexBuf:
+    def test_roundtrip_via_flex_decoder_and_converter(self):
+        from nnstreamer_tpu.core.meta import unwrap_flex
+
+        arr = np.arange(6, dtype=np.int16).reshape(2, 3)
+        p = Pipeline()
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("3:2", "int16"), 0)),
+                        data=[arr])
+        dec = p.add_new("tensor_decoder", mode="flexbuf")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        blob = sink.buffers[0].memories[0].host().tobytes()
+        meta, payload = unwrap_flex(blob)
+        out = np.frombuffer(payload[:meta.info.size_bytes],
+                            np.int16).reshape(2, 3)
+        np.testing.assert_array_equal(out, arr)

@@ -1,0 +1,82 @@
+"""End-to-end slice (SURVEY §7 step 6): the reference's golden pipeline shape
+``videotestsrc ! tensor_converter ! tensor_transform ! tensor_filter !
+tensor_decoder ! sink`` running a real flax model through the xla backend."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.models.zoo import get_model
+
+
+@pytest.fixture(scope="module")
+def tiny_mobilenet():
+    return get_model("zoo://mobilenet_v2?width=0.1&size=32&num_classes=5")
+
+
+def test_classification_pipeline(tmp_path, tiny_mobilenet):
+    labels = tmp_path / "labels.txt"
+    labels.write_text("\n".join(f"class{i}" for i in range(5)))
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=32, height=32, num_buffers=3,
+                    pattern="random", seed=7)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=tiny_mobilenet)
+    dec = p.add_new("tensor_decoder", mode="image_labeling", option1=str(labels))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=120)
+    assert sink.num_buffers == 3
+    for b in sink.buffers:
+        assert b.meta["label"].startswith("class")
+        assert 0 <= b.meta["label_index"] < 5
+    assert filt.latency >= 0 or filt.stats.total_invoke_num == 3
+
+
+def test_detection_pipeline(tmp_path):
+    """SSD-style: model emits postprocessed boxes; bounding_box decodes."""
+    import jax.numpy as jnp
+
+    labels = tmp_path / "labels.txt"
+    labels.write_text("thing\nother\n")
+
+    def fake_ssd(x):
+        b = x.shape[0]
+        boxes = jnp.tile(jnp.array([[0.25, 0.25, 0.75, 0.75]], jnp.float32), (b, 1))
+        boxes = boxes.reshape(b, 1, 4)
+        classes = jnp.zeros((b, 1), jnp.float32)
+        scores = jnp.full((b, 1), 0.95, jnp.float32)
+        count = jnp.ones((b,), jnp.float32)
+        return boxes, classes, scores, count
+
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=32, height=32, num_buffers=2)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", model=fake_ssd)
+    dec = p.add_new("tensor_decoder", mode="bounding_box",
+                    option1="mobilenet-ssd-postprocess", option2=str(labels),
+                    option4="64:64", option5="32:32")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=120)
+    assert sink.num_buffers == 2
+    dets = sink.buffers[0].meta["detections"]
+    assert len(dets) == 1 and dets[0]["label"] == "thing"
+    canvas = sink.buffers[0].memories[0].host()
+    assert canvas.shape == (64, 64, 4)
+    assert canvas[16, 16, 1] == 255  # green box corner at (0.25*64, 0.25*64)
+
+
+def test_transform_filter_fused_chain_device_resident(tiny_mobilenet):
+    """converter → transform(normalize) → filter stays on device end-to-end."""
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=32, height=32, num_buffers=2)
+    conv = p.add_new("tensor_converter")
+    tr = p.add_new("tensor_transform", mode="arithmetic",
+                   option="typecast:float32,add:-127.5,div:127.5")
+    filt = p.add_new("tensor_filter", model=lambda x: x.mean(axis=(1, 2, 3),
+                                                            keepdims=False))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, tr, filt, sink)
+    p.run(timeout=120)
+    assert sink.buffers[0].memories[0].is_device

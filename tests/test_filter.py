@@ -1,0 +1,240 @@
+"""tensor_filter + backend tests (mirrors reference unittest_filter_* and
+tensor_filter SSAT groups: auto-detect, props, stats, combinations, reload,
+shared key, custom-easy, python3)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.filters import register_custom_easy, unregister_custom_easy
+from nnstreamer_tpu.graph import Pipeline, PipelineError
+from nnstreamer_tpu.models.zoo import get_model
+
+
+def tensor_caps(dims, types, rate=30):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+def run_filter_pipeline(data, caps, sink_store=True, **filter_props):
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps, data=data)
+    f = p.add_new("tensor_filter", **filter_props)
+    sink = p.add_new("tensor_sink", store=sink_store)
+    Pipeline.link(src, f, sink)
+    p.run(timeout=60)
+    return f, sink
+
+
+class TestXLABackend:
+    def test_zoo_scaler(self):
+        f, sink = run_filter_pipeline(
+            [np.full((1, 8), 3.0, np.float32)],
+            tensor_caps("8:1", "float32"),
+            framework="xla-tpu", model="zoo://scaler?dims=8:1&types=float32&scale=5")
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((1, 8), 15.0, np.float32))
+
+    def test_callable_model_auto_detect(self):
+        import jax.numpy as jnp
+
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"),
+            model=lambda x: jnp.sum(x, axis=1, keepdims=True))
+        assert f.resolved_framework == "xla-tpu"
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(), [[4.0]])
+
+    def test_out_caps_from_model_info(self):
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"),
+            model=lambda x: x.reshape(1, 2, 2))
+        cfg = sink.buffers[0].config
+        assert cfg.info[0].shape == (1, 2, 2)
+
+    def test_incompatible_stream_fails(self):
+        with pytest.raises(PipelineError, match="incompatible"):
+            run_filter_pipeline(
+                [np.ones((1, 7), np.float32)],
+                tensor_caps("7:1", "float32"),
+                framework="xla-tpu",
+                model="zoo://scaler?dims=8:1&types=float32")
+
+    def test_stats_recorded(self):
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)] * 5,
+            tensor_caps("4:1", "float32"),
+            model=lambda x: x * 2, custom="sync=true")
+        assert f.latency >= 0
+        assert f.stats.total_invoke_num == 5
+
+    def test_multi_output_model(self):
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"),
+            model=lambda x: (x * 2, x + 1))
+        assert sink.buffers[0].num_tensors == 2
+
+    def test_bf16_precision_option(self):
+        f, sink = run_filter_pipeline(
+            [np.full((1, 4), 2.0, np.float32)],
+            tensor_caps("4:1", "float32"),
+            model=lambda x: x * x, custom="precision=bf16")
+        out = sink.buffers[0].memories[0].host()
+        assert str(out.dtype) == "bfloat16"
+        np.testing.assert_allclose(np.asarray(out, np.float32), 4.0)
+
+
+class TestCombinations:
+    def test_input_combination(self):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=tensor_caps("4:1,2:1", "float32,float32"),
+                        data=[(np.ones((1, 4), np.float32),
+                               np.full((1, 2), 9.0, np.float32))])
+        f = p.add_new("tensor_filter", model=lambda x: x * 10,
+                      input_combination="1")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, f, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((1, 2), 90.0, np.float32))
+
+    def test_output_combination_forwards_input(self):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=tensor_caps("4:1", "float32"),
+                        data=[np.full((1, 4), 2.0, np.float32)])
+        f = p.add_new("tensor_filter", model=lambda x: x * 3,
+                      output_combination="i0,o0")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, f, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        assert b.num_tensors == 2
+        np.testing.assert_array_equal(b.memories[0].host(),
+                                      np.full((1, 4), 2.0, np.float32))
+        np.testing.assert_array_equal(b.memories[1].host(),
+                                      np.full((1, 4), 6.0, np.float32))
+        # caps reflect the combination
+        assert b.config.info.num_tensors == 2
+
+
+class TestCustomEasy:
+    def test_roundtrip(self):
+        register_custom_easy("doubler", lambda x: x * 2,
+                             ("4:1", "float32"), ("4:1", "float32"))
+        try:
+            f, sink = run_filter_pipeline(
+                [np.ones((1, 4), np.float32)],
+                tensor_caps("4:1", "float32"),
+                framework="custom-easy", model="doubler")
+            np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                          np.full((1, 4), 2.0, np.float32))
+        finally:
+            unregister_custom_easy("doubler")
+
+    def test_unregistered_fails(self):
+        with pytest.raises(ValueError, match="not registered"):
+            run_filter_pipeline([np.ones((1, 4), np.float32)],
+                                tensor_caps("4:1", "float32"),
+                                framework="custom-easy", model="nope")
+
+
+class TestPython3Backend:
+    def test_script_filter(self, tmp_path):
+        script = tmp_path / "pyfilter.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class CustomFilter:\n"
+            "    def getInputDimension(self):\n"
+            "        return ('4:1', 'float32')\n"
+            "    def getOutputDimension(self):\n"
+            "        return ('4:1', 'float32')\n"
+            "    def invoke(self, x):\n"
+            "        return x + 100\n")
+        f, sink = run_filter_pipeline(
+            [np.zeros((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"),
+            framework="python3", model=str(script))
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((1, 4), 100.0, np.float32))
+
+    def test_auto_detect_py_extension(self, tmp_path):
+        from nnstreamer_tpu.filters import detect_framework
+
+        script = tmp_path / "f.py"
+        script.write_text("x = 1\n")
+        assert detect_framework(str(script)) == "python3"
+
+
+class TestReload:
+    def test_hot_reload(self):
+        import jax.numpy as jnp
+
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=tensor_caps("4:1", "float32"),
+                        data=None)
+        f = p.add_new("tensor_filter", model=lambda x: x * 2, is_updatable=True)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, f, sink)
+        p.start()
+        src.push_buffer(np.ones((1, 4), np.float32))
+        import time
+
+        time.sleep(0.5)
+        f.update_model(lambda x: x * 5)
+        src.push_buffer(np.ones((1, 4), np.float32))
+        src.end_of_stream()
+        p.wait_eos(30)
+        p.stop()
+        assert sink.num_buffers == 2
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host()[0, 0], 2.0)
+        np.testing.assert_array_equal(sink.buffers[1].memories[0].host()[0, 0], 5.0)
+
+    def test_reload_rejects_shape_change(self):
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"),
+            model=lambda x: x * 2, is_updatable=True)
+        with pytest.raises(ValueError, match="reload rejected"):
+            f._open_fw()  # reopen after stop for direct fw access
+            f.fw.set_input_info(TensorsInfo.from_strings("4:1", "float32"))
+            f.fw.reload_model(lambda x: x.reshape(2, 2, 1))
+
+    def test_not_updatable_fails(self):
+        f, sink = run_filter_pipeline(
+            [np.ones((1, 4), np.float32)],
+            tensor_caps("4:1", "float32"), model=lambda x: x)
+        with pytest.raises(RuntimeError, match="not is-updatable"):
+            f.update_model(lambda x: x * 2)
+
+
+class TestSharedModel:
+    def test_shared_backend_instance(self):
+        p = Pipeline()
+        caps = tensor_caps("4:1", "float32")
+        src1 = p.add_new("appsrc", caps=caps, data=[np.ones((1, 4), np.float32)])
+        src2 = p.add_new("appsrc", caps=caps, data=[np.ones((1, 4), np.float32)])
+        f1 = p.add_new("tensor_filter", model="zoo://scaler?dims=4:1&types=float32",
+                       framework="xla-tpu", shared_tensor_filter_key="k1")
+        f2 = p.add_new("tensor_filter", model="zoo://scaler?dims=4:1&types=float32",
+                       framework="xla-tpu", shared_tensor_filter_key="k1")
+        s1 = p.add_new("tensor_sink")
+        s2 = p.add_new("tensor_sink")
+        Pipeline.link(src1, f1, s1)
+        Pipeline.link(src2, f2, s2)
+        p.run(timeout=60)
+        assert f1.fw is None and f2.fw is None  # both closed/released
+        assert s1.num_buffers == 1 and s2.num_buffers == 1
+
+
+class TestMobileNetV2:
+    def test_tiny_mobilenet_forward(self):
+        bundle = get_model("zoo://mobilenet_v2?width=0.1&size=32&num_classes=10")
+        f, sink = run_filter_pipeline(
+            [np.random.default_rng(0).integers(0, 255, (1, 32, 32, 3)).astype(np.uint8)],
+            tensor_caps("3:32:32:1", "uint8", 30),
+            framework="xla-tpu", model=bundle)
+        out = sink.buffers[0].memories[0].host()
+        assert out.shape == (1, 10)
+        assert out.dtype == np.float32
+        assert np.all(np.isfinite(out))

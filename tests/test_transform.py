@@ -1,0 +1,167 @@
+"""tensor_transform op tests (mirrors reference unittest_plugins transform
+coverage incl. orc kernel semantics — here XLA)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.ops import transform_ops as T
+from nnstreamer_tpu.core import TensorDType, TensorInfo
+
+
+def apply(tr, x):
+    import jax
+
+    return np.asarray(jax.jit(tr.fn)(x))
+
+
+class TestTypecast:
+    def test_u8_to_f32(self):
+        tr = T.build("typecast", "float32")
+        x = np.array([0, 128, 255], np.uint8)
+        y = apply(tr, x)
+        assert y.dtype == np.float32
+        np.testing.assert_array_equal(y, [0.0, 128.0, 255.0])
+
+    def test_out_info(self):
+        tr = T.build("typecast", "int16")
+        info = tr.out_info(TensorInfo.from_strings("4:4", "float32"))
+        assert info.dtype is TensorDType.INT16
+        assert info.dims == (4, 4)
+
+
+class TestArithmetic:
+    def test_mobilenet_normalize(self):
+        # the canonical reference chain: typecast + normalize to [-1,1]
+        tr = T.build("arithmetic", "typecast:float32,add:-127.5,div:127.5")
+        x = np.array([0, 127.5, 255], np.float32).astype(np.uint8)
+        y = apply(tr, np.array([0, 128, 255], np.uint8))
+        np.testing.assert_allclose(y, [(v - 127.5) / 127.5 for v in [0, 128, 255]],
+                                   rtol=1e-6)
+
+    def test_chain_order(self):
+        tr = T.build("arithmetic", "typecast:float32,mul:2.0,add:1.0")
+        y = apply(tr, np.array([1.0, 2.0], np.float32))
+        np.testing.assert_array_equal(y, [3.0, 5.0])
+
+    def test_per_channel_values(self):
+        tr = T.build("arithmetic", "typecast:float32,add:1;10;100")
+        x = np.zeros((2, 3), np.float32)
+        y = apply(tr, x)
+        np.testing.assert_array_equal(y[0], [1, 10, 100])
+
+    def test_bad_op(self):
+        with pytest.raises(ValueError):
+            T.build("arithmetic", "pow:2")
+
+
+class TestTranspose:
+    def test_hwc_to_chw(self):
+        # reference option "1:2:0:3" maps [C:W:H:N] -> [W:H:C:N]
+        tr = T.build("transpose", "1:2:0:3")
+        x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)  # N,H,W,C
+        y = apply(tr, x)
+        # out nns dims: (W,H,C,N) -> row-major (N,C,H,W)
+        np.testing.assert_array_equal(y, np.transpose(x, (0, 3, 1, 2)))
+
+    def test_out_info(self):
+        tr = T.build("transpose", "1:2:0:3")
+        info = tr.out_info(TensorInfo.from_strings("3:20:10:1", "uint8"))
+        assert info.dims == (20, 10, 3, 1)
+
+    def test_invalid_perm(self):
+        with pytest.raises(ValueError):
+            T.build("transpose", "0:0:1:2")
+
+
+class TestDimchg:
+    def test_chw_from_hwc(self):
+        # reference dimchg 0:2 : innermost dim (channels) → position 2
+        tr = T.build("dimchg", "0:2")
+        x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+        y = apply(tr, x)
+        assert y.shape == (1, 4, 2, 3)
+        info = tr.out_info(TensorInfo.from_strings("4:3:2:1", "float32"))
+        assert info.dims == (3, 2, 4, 1)
+
+
+class TestStand:
+    def test_default(self):
+        tr = T.build("stand", "default")
+        x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        y = apply(tr, x)
+        np.testing.assert_allclose(y.mean(), 0, atol=1e-6)
+        np.testing.assert_allclose(y.std(), 1, atol=1e-4)
+
+    def test_dc_average(self):
+        tr = T.build("stand", "dc-average")
+        x = np.array([1.0, 3.0], np.float32)
+        y = apply(tr, x)
+        np.testing.assert_allclose(y, [-1.0, 1.0])
+
+    def test_per_channel(self):
+        tr = T.build("stand", "default:per-channel")
+        x = np.random.default_rng(0).normal(5, 3, (8, 4)).astype(np.float32)
+        y = apply(tr, x)
+        np.testing.assert_allclose(y.mean(axis=0), 0, atol=1e-4)
+
+
+class TestClamp:
+    def test_clamp(self):
+        tr = T.build("clamp", "0:1")
+        y = apply(tr, np.array([-5.0, 0.5, 7.0], np.float32))
+        np.testing.assert_array_equal(y, [0.0, 0.5, 1.0])
+
+    def test_bad_range(self):
+        with pytest.raises(ValueError):
+            T.build("clamp", "1:0")
+
+
+class TestCompose:
+    def test_fused_chain(self):
+        chain = T.compose([T.build("typecast", "float32"),
+                           T.build("arithmetic", "mul:3.0"),
+                           T.build("clamp", "0:100")])
+        y = apply(chain, np.array([1, 50], np.uint8))
+        np.testing.assert_array_equal(y, [3.0, 100.0])
+
+
+class TestTransformElement:
+    def test_in_pipeline_device_resident(self):
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+
+        p = Pipeline()
+        src = p.add_new(
+            "appsrc",
+            caps=Caps.tensors(TensorsConfig(TensorsInfo.from_strings("4", "uint8"), 30)),
+            data=[np.array([0, 50, 100, 200], np.uint8)])
+        t = p.add_new("tensor_transform", mode="arithmetic",
+                      option="typecast:float32,add:-127.5,div:127.5")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, t, sink)
+        p.run(timeout=20)
+        out = sink.buffers[0]
+        assert out.memories[0].is_device  # stayed on device
+        assert out.config.info[0].dtype is TensorDType.FLOAT32
+        np.testing.assert_allclose(
+            out.memories[0].host(),
+            (np.array([0, 50, 100, 200], np.float32) - 127.5) / 127.5)
+
+    def test_transform_chain_fused(self):
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+
+        p = Pipeline()
+        src = p.add_new(
+            "appsrc",
+            caps=Caps.tensors(TensorsConfig(TensorsInfo.from_strings("2:2", "float32"), 0)),
+            data=[np.ones((2, 2), np.float32)])
+        t = p.add_new("tensor_transform",
+                      transform_chain=[("arithmetic", "mul:4.0"),
+                                       ("transpose", "1:0"),
+                                       ("clamp", "0:3")])
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, t, sink)
+        p.run(timeout=20)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((2, 2), 3.0, np.float32))
