@@ -1,0 +1,66 @@
+"""nns-launch — gst-launch-1.0 equivalent CLI.
+
+    nns-launch "videotestsrc num-buffers=30 ! tensor_converter ! \
+                tensor_filter framework=xla-tpu model=zoo://mobilenet_v2 ! \
+                tensor_decoder mode=image_labeling option1=labels.txt ! \
+                tensor_sink"
+
+Options: -t/--time limit, -v verbose bus messages, --list-elements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-launch",
+                                 description="Run a textual tensor pipeline")
+    ap.add_argument("pipeline", nargs="?", help="pipeline description")
+    ap.add_argument("-t", "--timeout", type=float, default=None,
+                    help="max seconds to run (default: until EOS)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print bus messages")
+    ap.add_argument("--list-elements", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_elements:
+        from .graph.element import all_element_names
+
+        for n in all_element_names():
+            print(n)
+        return 0
+    if not args.pipeline:
+        ap.error("pipeline description required")
+
+    from .graph.parse import parse_pipeline
+
+    p = parse_pipeline(args.pipeline)
+    t0 = time.monotonic()
+    p.start()
+    try:
+        ok = p.wait_eos(args.timeout)
+        err = p.bus.error
+        if args.verbose:
+            while True:
+                msg = p.bus.pop()
+                if msg is None:
+                    break
+                print(f"[{msg.type.value}] {msg.source}: {msg.data}",
+                      file=sys.stderr)
+        if err is not None:
+            print(f"ERROR: {err.source}: {err.data.get('text')}", file=sys.stderr)
+            return 1
+        if not ok:
+            print(f"(stopped after {args.timeout}s timeout)", file=sys.stderr)
+    finally:
+        p.stop()
+    if args.verbose:
+        print(f"ran {time.monotonic() - t0:.2f}s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""gst-launch-style textual pipeline parser.
+
+Lets reference pipelines run near-verbatim:
+
+    videotestsrc num-buffers=10 ! tensor_converter !
+    tensor_transform mode=arithmetic option=typecast:float32,div:255.0 !
+    tensor_filter framework=xla-tpu model=zoo://mobilenet_v2 !
+    tensor_decoder mode=image_labeling option1=labels.txt ! tensor_sink
+
+Supported grammar (the subset the reference's pipelines use):
+  * ``elem prop=val prop2="quoted val" ! elem2 ...``
+  * named elements + back-references: ``tee name=t ! ... t. ! queue ! ...``
+    (segments separated by whitespace after a complete branch)
+  * caps filter segments: ``video/x-raw,format=RGB,width=640,height=480`` or
+    ``other/tensors,dimensions=...,types=...`` become CapsFilter elements
+  * numbers/bools auto-typed; fractions stay strings ("30/1" → element-parsed)
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from fractions import Fraction
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.types import Caps, TensorFormat
+from .element import Element, FlowReturn, Pad, make_element, register_element
+from .pipeline import Pipeline
+
+
+@register_element
+class CapsFilter(Element):
+    """Pass-through that constrains negotiation (gst capsfilter)."""
+
+    ELEMENT_NAME = "capsfilter"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.caps: Optional[Caps] = None
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        if self.caps is not None:
+            merged = caps.intersect(self.caps)
+            if merged is None:
+                raise ValueError(
+                    f"capsfilter: stream {caps} incompatible with {self.caps}")
+            caps = merged
+        pad.caps = caps
+        self.send_caps_all(caps)
+
+
+_MEDIA_TYPES = ("video/x-raw", "audio/x-raw", "text/x-raw",
+                "application/octet-stream", "other/tensor", "other/tensors")
+
+_INT_FIELDS = {"width", "height", "channels", "rate", "num"}
+
+
+def parse_caps_string(s: str) -> Caps:
+    """"video/x-raw,format=RGB,width=640" → Caps."""
+    parts = s.split(",")
+    media = parts[0].strip()
+    if media == "other/tensor":
+        media = "other/tensors"
+    fields: Dict[str, Any] = {}
+    for kv in parts[1:]:
+        kv = kv.strip()
+        if not kv:
+            continue
+        if "=" not in kv:
+            raise ValueError(f"bad caps field {kv!r} in {s!r}")
+        k, v = kv.split("=", 1)
+        k = k.strip()
+        v = v.strip().strip('"')
+        v = re.sub(r"^\(\w+\)", "", v)  # drop gst type annotations "(int)3"
+        if k in ("dimensions", "dimension"):
+            k = "dims"
+        elif k in ("num_tensors",):
+            k = "num"
+        if k in _INT_FIELDS:
+            fields[k] = int(v)
+        elif k == "framerate":
+            n, d = (v.split("/") + ["1"])[:2]
+            fields[k] = Fraction(int(n), int(d))
+        elif k == "format" and media == "other/tensors":
+            fields[k] = TensorFormat.parse(v)
+        else:
+            fields[k] = v
+    return Caps(media, fields)
+
+
+def _auto_type(v: str) -> Any:
+    if re.fullmatch(r"-?\d+", v):
+        return int(v)
+    if re.fullmatch(r"-?\d*\.\d+([eE]-?\d+)?", v):
+        return float(v)
+    if v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return v
+
+
+def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    """Build (and return) a Pipeline from a textual description."""
+    p = pipeline or Pipeline()
+    branches = _split_branches(description)
+    named: Dict[str, Element] = {}
+
+    for branch in branches:
+        prev: Optional[Element] = None
+        for seg in branch:
+            if isinstance(seg, str):  # back-reference "name."
+                ref = seg.rstrip(".")
+                if ref not in named:
+                    raise ValueError(f"unknown element reference {seg!r}")
+                prev = named[ref]
+                continue
+            kind, props = seg
+            if kind in _MEDIA_TYPES or kind.split(",")[0] in _MEDIA_TYPES:
+                el = CapsFilter(caps=parse_caps_string(_reassemble_caps(kind, props)))
+                p.add(el)
+            else:
+                name = props.pop("name", None)
+                el = make_element(kind, element_name=name, **props)
+                p.add(el)
+                if name:
+                    named[name] = el
+            if prev is not None:
+                Pipeline.link(prev, el)
+            prev = el
+    return p
+
+
+def _reassemble_caps(kind: str, props: Dict[str, Any]) -> str:
+    fields = ",".join(f"{k}={v}" for k, v in props.items())
+    return f"{kind},{fields}" if fields else kind
+
+
+def _split_branches(description: str):
+    """Tokenize into branches of segments. Each segment is either
+    (element_kind, props) or a back-reference string "name."."""
+    tokens = shlex.split(description.replace("!", " ! "))
+    branches: List[List[Any]] = []
+    current: List[Any] = []
+    seg_tokens: List[str] = []
+
+    def flush_segment() -> None:
+        if not seg_tokens:
+            return
+        head = seg_tokens[0]
+        if head.endswith(".") and len(seg_tokens) == 1 and \
+                not any(c in head for c in "=/"):
+            current.append(head)
+        else:
+            props: Dict[str, Any] = {}
+            for t in seg_tokens[1:]:
+                if "=" not in t:
+                    raise ValueError(f"expected prop=value, got {t!r}")
+                k, v = t.split("=", 1)
+                props[k.replace("-", "_")] = _auto_type(v.strip('"'))
+            current.append((head, props))
+        seg_tokens.clear()
+
+    for tok in tokens:
+        if tok == "!":
+            flush_segment()
+            continue
+        # a segment token arriving while another segment is open (no "!"
+        # in between) ends the current branch and starts a new one
+        if seg_tokens and "=" not in tok \
+                and (tok.endswith(".") or _looks_like_element(tok)):
+            flush_segment()
+            if current:
+                branches.append(current)
+                current = []
+        seg_tokens.append(tok)
+    flush_segment()
+    if current:
+        branches.append(current)
+    return branches
+
+
+def _looks_like_element(tok: str) -> bool:
+    from .element import element_class
+
+    if "/" in tok or "," in tok or "=" in tok:
+        return False
+    return element_class(tok) is not None

@@ -1,0 +1,8 @@
+"""Distributed query/offload layer: wire protocol, client/server elements,
+hybrid broker discovery."""
+
+from .protocol import Cmd, pack_message, recv_message, send_message
+from .hybrid import DiscoveryBroker, discover, register_node, unregister_node
+
+__all__ = ["Cmd", "pack_message", "recv_message", "send_message",
+           "DiscoveryBroker", "discover", "register_node", "unregister_node"]

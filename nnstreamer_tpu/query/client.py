@@ -1,0 +1,127 @@
+"""tensor_query_client — per-buffer remote offload element.
+
+Reference: gst/nnstreamer/tensor_query/tensor_query_client.c (chain :658:
+send frame, receive result, push downstream; retry/reconnect :769-776;
+broker-based discovery via tensor_query_hybrid when ``operation`` is set).
+
+Props: host/port (direct), or ``operation=<topic>`` + broker-host/port for
+hybrid discovery; ``sparse=true`` compresses request payloads;
+``max-request-retry`` bounds reconnect attempts.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Optional
+
+from ..core.buffer import Buffer
+from ..core.log import logger
+from ..core.types import Caps, TensorFormat
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from .protocol import (
+    Cmd,
+    QueryProtocolError,
+    buffer_to_payload,
+    payload_to_buffer,
+    recv_message,
+    send_message,
+)
+
+log = logger("query")
+
+
+@register_element
+class TensorQueryClient(Element):
+    ELEMENT_NAME = "tensor_query_client"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.host = "127.0.0.1"
+        self.port = 5001
+        self.operation: Optional[str] = None  # hybrid topic
+        self.broker_host = "127.0.0.1"
+        self.broker_port = 5300
+        self.sparse = False
+        self.max_request_retry = 3
+        self.timeout_s = 10.0
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+        self.add_src_pad(template=Caps.any_tensors())
+        self._sock: Optional[socket.socket] = None
+        self._caps_out_sent = False
+
+    # -- connection ---------------------------------------------------------- #
+    def _resolve_endpoint(self) -> tuple:
+        if self.operation:
+            from .hybrid import discover
+
+            nodes = discover(self.operation, self.broker_host,
+                             int(self.broker_port))
+            if not nodes:
+                raise ConnectionError(
+                    f"hybrid discovery: no servers for {self.operation!r}")
+            return nodes[0]
+        return (self.host, int(self.port))
+
+    def _connect(self) -> socket.socket:
+        host, port = self._resolve_endpoint()
+        sock = socket.create_connection((host, port), timeout=self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_message(sock, Cmd.INFO_REQ, {"caps": str(self.sink_pad.caps or "")})
+        cmd, meta, _ = recv_message(sock)
+        if cmd is not Cmd.INFO_APPROVE:
+            sock.close()
+            raise ConnectionError(f"server denied connection: {meta}")
+        return sock
+
+    def _ensure_conn(self) -> socket.socket:
+        if self._sock is None:
+            retries = int(self.max_request_retry)
+            last: Optional[Exception] = None
+            for attempt in range(max(retries, 1)):
+                try:
+                    self._sock = self._connect()
+                    return self._sock
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    time.sleep(min(0.2 * (attempt + 1), 1.0))
+            raise ConnectionError(f"tensor_query_client: connect failed: {last}")
+        return self._sock
+
+    def start(self) -> None:
+        self._caps_out_sent = False
+
+    def stop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- negotiation --------------------------------------------------------- #
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        # result stream is shape-dynamic from the client's viewpoint: declare
+        # flexible; static caps could be fetched from the server in future
+        self.send_caps_all(Caps.tensors(format=TensorFormat.FLEXIBLE))
+
+    # -- dataflow ------------------------------------------------------------- #
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        for attempt in range(max(int(self.max_request_retry), 1)):
+            try:
+                sock = self._ensure_conn()
+                send_message(sock, Cmd.DATA, meta, payload)
+                cmd, rmeta, rpayload = recv_message(sock)
+                if cmd is Cmd.ERROR:
+                    raise QueryProtocolError(rmeta.get("error", "server error"))
+                if cmd is not Cmd.RESULT:
+                    raise QueryProtocolError(f"unexpected reply {cmd}")
+                out = payload_to_buffer(rmeta, rpayload)
+                out.pts, out.duration, out.offset = buf.pts, buf.duration, buf.offset
+                return self.push(out)
+            except (ConnectionError, OSError, QueryProtocolError) as e:
+                log.warning("query attempt %d failed: %s", attempt + 1, e)
+                self.stop()  # drop connection, retry fresh
+        raise ConnectionError("tensor_query_client: request failed after retries")

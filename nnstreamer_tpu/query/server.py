@@ -1,0 +1,193 @@
+"""tensor_query server side: serversrc / serversink elements.
+
+Reference: gst/nnstreamer/tensor_query/tensor_query_serversrc.c /
+_serversink.c — a server *pipeline* whose source is remote client frames and
+whose sink returns results, paired by ``id``. Usage:
+
+    server pipeline:  tensor_query_serversrc id=0 port=5001 !
+                      tensor_filter ... ! tensor_query_serversink id=0
+
+The listener accepts N concurrent clients; each DATA message is pushed into
+the pipeline (buffer.meta carries the connection id) and the matching
+serversink routes the RESULT back on the same connection. This is where TPU
+pod offload plugs in: the server pipeline's filter may run mesh-sharded
+(parallel.make_sharded_infer_step) so one host fans frames over its slice.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from ..core.buffer import Buffer
+from ..core.log import logger
+from ..core.types import Caps, TensorsConfig, TensorsInfo
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.pipeline import SourceElement
+from .protocol import (
+    Cmd,
+    QueryProtocolError,
+    buffer_to_payload,
+    payload_to_buffer,
+    recv_message,
+    send_message,
+)
+
+log = logger("query")
+
+_pairs_lock = threading.Lock()
+_server_pairs: Dict[int, "TensorQueryServerSrc"] = {}
+
+
+@register_element
+class TensorQueryServerSrc(SourceElement):
+    ELEMENT_NAME = "tensor_query_serversrc"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.host = "0.0.0.0"
+        self.port = 5001
+        self.id = 0
+        self.caps: Optional[Caps] = None   # declared stream type
+        self.dims: Optional[str] = None
+        self.types: Optional[str] = None
+        super().__init__(name, **props)
+        self._listener: Optional[socket.socket] = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_seq = 0
+        self._inbox: "__import__('queue').Queue" = None
+        self._threads = []
+
+    # -- lifecycle ---------------------------------------------------------- #
+    def negotiate(self) -> Caps:
+        import queue as _q
+
+        if self.caps is None:
+            if self.dims and self.types:
+                self.caps = Caps.tensors(
+                    TensorsConfig(TensorsInfo.from_strings(self.dims, self.types)))
+            else:
+                raise ValueError("tensor_query_serversrc needs caps or dims/types")
+        self._inbox = _q.Queue(maxsize=64)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, int(self.port)))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        with _pairs_lock:
+            _server_pairs[int(self.id)] = self
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"qsrv-accept:{self.name}")
+        t.start()
+        self._threads.append(t)
+        self.bound_port = self._listener.getsockname()[1]
+        return self.caps
+
+    def _accept_loop(self) -> None:
+        while not self._stop_flag.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._conn_seq += 1
+                cid = self._conn_seq
+                self._conns[cid] = conn
+            t = threading.Thread(target=self._client_loop, args=(cid, conn),
+                                 daemon=True, name=f"qsrv-conn{cid}")
+            t.start()
+            self._threads.append(t)
+
+    def _client_loop(self, cid: int, conn: socket.socket) -> None:
+        try:
+            while not self._stop_flag.is_set():
+                cmd, meta, payload = recv_message(conn)
+                if cmd is Cmd.INFO_REQ:
+                    # approve iff declared caps are compatible (REQUEST_INFO/
+                    # RESPOND_APPROVE handshake, tensor_query_common.h:42-51)
+                    send_message(conn, Cmd.INFO_APPROVE,
+                                 {"caps": str(self.caps), "client_id": cid})
+                elif cmd is Cmd.PING:
+                    send_message(conn, Cmd.PONG, {})
+                elif cmd is Cmd.DATA:
+                    buf = payload_to_buffer(meta, payload)
+                    buf.meta["query_client_id"] = cid
+                    self._inbox.put(buf)
+                else:
+                    send_message(conn, Cmd.ERROR,
+                                 {"error": f"unexpected cmd {cmd}"})
+        except (ConnectionError, QueryProtocolError, OSError) as e:
+            log.debug("server conn %d closed: %s", cid, e)
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def create(self) -> Optional[Buffer]:
+        import queue as _q
+
+        while not self._stop_flag.is_set():
+            try:
+                return self._inbox.get(timeout=0.1)
+            except _q.Empty:
+                continue
+        return None
+
+    def send_result(self, cid: int, buf: Buffer) -> bool:
+        with self._lock:
+            conn = self._conns.get(cid)
+        if conn is None:
+            return False
+        meta, payload = buffer_to_payload(buf)
+        try:
+            send_message(conn, Cmd.RESULT, meta, payload)
+            return True
+        except OSError as e:
+            log.warning("result send to client %d failed: %s", cid, e)
+            return False
+
+    def stop(self) -> None:
+        super().stop()
+        with _pairs_lock:
+            _server_pairs.pop(int(self.id), None)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+@register_element
+class TensorQueryServerSink(Element):
+    ELEMENT_NAME = "tensor_query_serversink"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.id = 0
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        with _pairs_lock:
+            src = _server_pairs.get(int(self.id))
+        if src is None:
+            raise RuntimeError(
+                f"tensor_query_serversink id={self.id}: no matching serversrc")
+        cid = buf.meta.get("query_client_id")
+        if cid is None:
+            raise RuntimeError("buffer lost its query_client_id")
+        src.send_result(cid, buf)
+        return FlowReturn.OK

@@ -1,0 +1,150 @@
+"""Pipeline parser, CLI, and single-shot API tests (mirrors reference SSAT
+gst-launch usage + unittest_filter_single)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import TensorsInfo
+from nnstreamer_tpu.graph.parse import parse_caps_string, parse_pipeline
+from nnstreamer_tpu.single import SingleShot
+
+
+class TestCapsString:
+    def test_video(self):
+        caps = parse_caps_string("video/x-raw,format=RGB,width=640,height=480,framerate=30/1")
+        assert caps.media_type == "video/x-raw"
+        assert caps.get("width") == 640
+        from fractions import Fraction
+
+        assert caps.get("framerate") == Fraction(30)
+
+    def test_tensors(self):
+        caps = parse_caps_string(
+            "other/tensors,num_tensors=1,dimensions=3:4:4:1,types=uint8,format=static")
+        cfg = caps.to_config()
+        assert cfg.info[0].dims == (3, 4, 4, 1)
+
+    def test_gst_type_annotations_stripped(self):
+        caps = parse_caps_string("video/x-raw,width=(int)320")
+        assert caps.get("width") == 320
+
+
+class TestParser:
+    def test_linear_pipeline(self):
+        p = parse_pipeline(
+            "videotestsrc num-buffers=3 width=16 height=16 ! tensor_converter "
+            "! tensor_sink name=out store=true")
+        p.run(timeout=30)
+        out = p["out"]
+        assert out.num_buffers == 3
+        assert out.buffers[0].memories[0].host().shape == (1, 16, 16, 3)
+
+    def test_quoted_and_typed_props(self):
+        p = parse_pipeline(
+            'videotestsrc num-buffers=1 width=8 height=8 pattern="solid" '
+            "color=16711680 ! tensor_converter ! tensor_sink name=s store=true")
+        p.run(timeout=30)
+        frame = p["s"].buffers[0].memories[0].host()
+        assert frame[0, 0, 0, 0] == 255  # red channel from 0xFF0000
+
+    def test_caps_filter_segment(self):
+        p = parse_pipeline(
+            "videotestsrc num-buffers=1 width=8 height=8 ! "
+            "video/x-raw,format=RGB,width=8 ! tensor_converter ! "
+            "tensor_sink name=s store=true")
+        p.run(timeout=30)
+        assert p["s"].num_buffers == 1
+
+    def test_caps_filter_mismatch_fails(self):
+        from nnstreamer_tpu.graph import PipelineError
+
+        p = parse_pipeline(
+            "videotestsrc num-buffers=1 width=8 height=8 ! "
+            "video/x-raw,width=999 ! tensor_converter ! tensor_sink")
+        with pytest.raises(PipelineError, match="incompatible"):
+            p.run(timeout=30)
+
+    def test_tee_branches_with_references(self):
+        p = parse_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! "
+            "tee name=t "
+            "t. ! queue ! tensor_sink name=a store=true "
+            "t. ! queue ! tensor_sink name=b store=true")
+        p.run(timeout=30)
+        assert p["a"].num_buffers == 2
+        assert p["b"].num_buffers == 2
+
+    def test_transform_chain_in_text(self):
+        p = parse_pipeline(
+            "videotestsrc num-buffers=1 width=4 height=4 ! tensor_converter ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 "
+            "! tensor_sink name=s store=true")
+        p.run(timeout=30)
+        out = p["s"].buffers[0].memories[0].host()
+        assert out.dtype == np.float32
+        assert out.max() <= 1.0
+
+    def test_unknown_element_fails(self):
+        with pytest.raises(ValueError, match="unknown element"):
+            parse_pipeline("videotestsrc ! floobar ! tensor_sink")
+
+    def test_unknown_reference_fails(self):
+        with pytest.raises(ValueError, match="reference"):
+            parse_pipeline("nosuch. ! tensor_sink")
+
+
+class TestCLI:
+    def test_cli_runs_pipeline(self, capsys):
+        from nnstreamer_tpu.cli import main
+
+        ret = main(["videotestsrc num-buffers=2 width=8 height=8 ! "
+                    "tensor_converter ! fakesink", "-v"])
+        assert ret == 0
+
+    def test_cli_list_elements(self, capsys):
+        from nnstreamer_tpu.cli import main
+
+        assert main(["--list-elements"]) == 0
+        out = capsys.readouterr().out
+        for name in ["tensor_filter", "tensor_converter", "tensor_mux",
+                     "tensor_query_client", "videotestsrc"]:
+            assert name in out
+
+    def test_cli_error_exit_code(self, capsys):
+        from nnstreamer_tpu.cli import main
+
+        ret = main(["videotestsrc num-buffers=1 ! video/x-raw,width=999 ! "
+                    "tensor_converter ! fakesink"])
+        assert ret == 1
+
+
+class TestSingleShot:
+    def test_invoke_zoo_model(self):
+        with SingleShot(model="zoo://scaler?dims=4:1&types=float32&scale=3",
+                        framework="xla-tpu") as single:
+            out, = single.invoke(np.ones((1, 4), np.float32))
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.full((1, 4), 3.0, np.float32))
+            assert single.input_info.num_tensors == 1
+            assert single.latency_us >= 0
+
+    def test_invoke_callable(self):
+        import jax.numpy as jnp
+
+        with SingleShot(model=lambda x: jnp.sum(x)) as single:
+            out, = single.invoke(np.ones((2, 2), np.float32))
+            assert float(np.asarray(out)) == 4.0
+
+    def test_set_input_info(self):
+        with SingleShot(model=lambda x: x * 2) as single:
+            out_info = single.set_input_info(
+                TensorsInfo.from_strings("8:2", "float32"))
+            assert out_info[0].dims == (8, 2)
+
+    def test_update_model(self):
+        with SingleShot(model=lambda x: x * 2) as single:
+            single.set_input_info(TensorsInfo.from_strings("2:1", "float32"))
+            single.update_model(lambda x: x * 7)
+            out, = single.invoke(np.ones((1, 2), np.float32))
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.full((1, 2), 7.0, np.float32))

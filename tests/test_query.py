@@ -1,0 +1,228 @@
+"""Query/offload layer tests — localhost server+client pipelines
+(reference tests/nnstreamer_query/runTest.sh pattern: both ends in one test
+host, plus protocol unit tests)."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.query import DiscoveryBroker, discover, register_node
+from nnstreamer_tpu.query.protocol import (
+    Cmd,
+    buffer_to_payload,
+    pack_message,
+    payload_to_buffer,
+)
+
+
+def caps_of(dims, types, rate=30):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestProtocol:
+    def test_buffer_payload_roundtrip(self):
+        buf = Buffer.of(np.arange(6, dtype=np.float32).reshape(2, 3),
+                        np.ones((4,), np.uint8), pts=123, duration=7)
+        meta, payload = buffer_to_payload(buf)
+        out = payload_to_buffer(meta, payload)
+        assert out.pts == 123 and out.duration == 7
+        np.testing.assert_array_equal(out.memories[0].host(),
+                                      buf.memories[0].host())
+        np.testing.assert_array_equal(out.memories[1].host(),
+                                      buf.memories[1].host())
+
+    def test_sparse_payload(self):
+        dense = np.zeros((8, 8), np.float32)
+        dense[2, 3] = 9.0
+        buf = Buffer.of(dense)
+        meta, payload = buffer_to_payload(buf, sparse=True)
+        dense_meta, dense_payload = buffer_to_payload(buf, sparse=False)
+        assert len(payload) < len(dense_payload)
+        out = payload_to_buffer(meta, payload)
+        np.testing.assert_array_equal(out.memories[0].host(), dense)
+
+    def test_bad_magic_rejected(self):
+        import struct
+        from nnstreamer_tpu.query.protocol import QueryProtocolError, recv_message
+
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack("<IBIQ", 0xDEAD, 1, 0, 0))
+            with pytest.raises(QueryProtocolError, match="magic"):
+                recv_message(b)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestQueryOffload:
+    def _server_pipeline(self, port):
+        sp = Pipeline("server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims="4:1", types="float32")
+        filt = sp.add_new("tensor_filter", model=lambda x: x * 10)
+        ssink = sp.add_new("tensor_query_serversink", id=0)
+        Pipeline.link(ssrc, filt, ssink)
+        return sp
+
+    def test_offload_roundtrip(self):
+        port = free_port()
+        sp = self._server_pipeline(port)
+        sp.start()
+        try:
+            time.sleep(0.2)
+            cp = Pipeline("client")
+            src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                             data=[np.full((1, 4), i, np.float32)
+                                   for i in range(5)])
+            qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            cp.run(timeout=60)
+            assert sink.num_buffers == 5
+            np.testing.assert_array_equal(sink.buffers[3].memories[0].host(),
+                                          np.full((1, 4), 30.0, np.float32))
+            # timestamps preserved across the wire
+            assert sink.buffers[3].offset == 3
+        finally:
+            sp.stop()
+
+    def test_sparse_link(self):
+        port = free_port()
+        sp = self._server_pipeline(port)
+        sp.start()
+        try:
+            time.sleep(0.2)
+            cp = Pipeline("client")
+            data = np.zeros((1, 4), np.float32)
+            data[0, 1] = 2.0
+            src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                             data=[data])
+            qc = cp.add_new("tensor_query_client", host="127.0.0.1",
+                            port=port, sparse=True)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            cp.run(timeout=60)
+            np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                          data * 10)
+        finally:
+            sp.stop()
+
+    def test_client_retry_then_fail(self):
+        port = free_port()  # nothing listening
+        cp = Pipeline("client")
+        src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                         data=[np.zeros((1, 4), np.float32)])
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
+                        max_request_retry=2, timeout_s=1.0)
+        sink = cp.add_new("tensor_sink")
+        Pipeline.link(src, qc, sink)
+        from nnstreamer_tpu.graph import PipelineError
+
+        with pytest.raises(PipelineError, match="failed after retries"):
+            cp.run(timeout=60)
+
+
+class TestHybridDiscovery:
+    def test_register_discover(self):
+        broker = DiscoveryBroker(port=0).start()
+        try:
+            assert register_node("object_detection", "127.0.0.1", 5001,
+                                 broker_port=broker.port)
+            nodes = discover("object_detection", broker_port=broker.port)
+            assert nodes == [("127.0.0.1", 5001)]
+            assert discover("missing", broker_port=broker.port) == []
+        finally:
+            broker.stop()
+
+    def test_client_via_broker(self):
+        broker = DiscoveryBroker(port=0).start()
+        port = free_port()
+        sp = Pipeline("server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims="2:1", types="float32")
+        filt = sp.add_new("tensor_filter", model=lambda x: x + 1)
+        ssink = sp.add_new("tensor_query_serversink", id=0)
+        Pipeline.link(ssrc, filt, ssink)
+        sp.start()
+        try:
+            time.sleep(0.2)
+            register_node("addone", "127.0.0.1", port, broker_port=broker.port)
+            cp = Pipeline("client")
+            src = cp.add_new("appsrc", caps=caps_of("2:1", "float32"),
+                             data=[np.zeros((1, 2), np.float32)])
+            qc = cp.add_new("tensor_query_client", operation="addone",
+                            broker_port=broker.port)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            cp.run(timeout=60)
+            np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                          np.ones((1, 2), np.float32))
+        finally:
+            sp.stop()
+            broker.stop()
+
+
+class TestMultiProcess:
+    def test_server_in_separate_process(self, tmp_path):
+        """True cross-process offload (reference runs server & client as
+        separate gst-launch processes)."""
+        import subprocess
+        import sys
+
+        port = free_port()
+        server_code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, {repr(str(tmp_path.parent))})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu.graph import Pipeline
+p = Pipeline()
+ssrc = p.add_new("tensor_query_serversrc", host="127.0.0.1", port={port},
+                 id=0, dims="3:1", types="float32")
+f = p.add_new("tensor_filter", model=lambda x: -x)
+ssink = p.add_new("tensor_query_serversink", id=0)
+Pipeline.link(ssrc, f, ssink)
+p.start()
+print("READY", flush=True)
+import time
+time.sleep(20)
+p.stop()
+"""
+        import os
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = "/root/repo"
+        proc = subprocess.Popen([sys.executable, "-u", "-c", server_code],
+                                stdout=subprocess.PIPE, env=env, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "READY" in line
+            cp = Pipeline("client")
+            src = cp.add_new("appsrc", caps=caps_of("3:1", "float32"),
+                             data=[np.full((1, 3), 4.0, np.float32)])
+            qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            cp.run(timeout=60)
+            np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                          np.full((1, 3), -4.0, np.float32))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
