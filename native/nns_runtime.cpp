@@ -1,0 +1,186 @@
+// nns_runtime — native runtime components for nnstreamer_tpu.
+//
+// Re-implements, C++-native, the host-side hot paths the reference keeps in
+// C (SURVEY §2.1): the aligned tensor allocator (tensor_allocator.c), the
+// sparse wire codec (tensor_sparse_util.c:31-162), wire-protocol frame
+// packing (tensor_query_common.c), and a lock-free SPSC byte ring used by
+// the pipeline queue fast path. Exposed as a plain C ABI consumed from
+// Python via ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 nns_runtime.cpp -o libnns_runtime.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+extern "C" {
+
+// --------------------------------------------------------------------------
+// Aligned allocator (tensor_allocator.c equivalent; default 64B = cacheline,
+// TPU host DMA staging prefers ≥64B alignment)
+// --------------------------------------------------------------------------
+
+void *nns_aligned_alloc(size_t size, size_t alignment) {
+  if (alignment < sizeof(void *)) alignment = sizeof(void *);
+  void *ptr = nullptr;
+  if (posix_memalign(&ptr, alignment, size) != 0) return nullptr;
+  return ptr;
+}
+
+void nns_aligned_free(void *ptr) { free(ptr); }
+
+// --------------------------------------------------------------------------
+// Sparse COO codec (tensor_sparse_util.c equivalent)
+// values scanned elementwise; index array is uint32 flat offsets.
+// Returns nnz, or -1 if out buffers are too small. elem_size ∈ {1,2,4,8}.
+// --------------------------------------------------------------------------
+
+static inline bool is_zero(const uint8_t *p, uint32_t elem_size) {
+  switch (elem_size) {
+    case 1: return *p == 0;
+    case 2: return *reinterpret_cast<const uint16_t *>(p) == 0;
+    case 4: return *reinterpret_cast<const uint32_t *>(p) == 0;
+    case 8: return *reinterpret_cast<const uint64_t *>(p) == 0;
+    default: {
+      for (uint32_t i = 0; i < elem_size; ++i)
+        if (p[i]) return false;
+      return true;
+    }
+  }
+}
+
+int64_t nns_sparse_encode(const uint8_t *dense, uint64_t num_elements,
+                          uint32_t elem_size, uint32_t *out_indices,
+                          uint8_t *out_values, uint64_t out_capacity) {
+  uint64_t nnz = 0;
+  for (uint64_t i = 0; i < num_elements; ++i) {
+    const uint8_t *p = dense + i * elem_size;
+    if (!is_zero(p, elem_size)) {
+      if (nnz >= out_capacity) return -1;
+      out_indices[nnz] = static_cast<uint32_t>(i);
+      memcpy(out_values + nnz * elem_size, p, elem_size);
+      ++nnz;
+    }
+  }
+  return static_cast<int64_t>(nnz);
+}
+
+int64_t nns_sparse_decode(const uint32_t *indices, const uint8_t *values,
+                          uint64_t nnz, uint32_t elem_size, uint8_t *out_dense,
+                          uint64_t num_elements) {
+  memset(out_dense, 0, num_elements * elem_size);
+  for (uint64_t i = 0; i < nnz; ++i) {
+    uint64_t idx = indices[i];
+    if (idx >= num_elements) return -1;
+    memcpy(out_dense + idx * elem_size, values + i * elem_size, elem_size);
+  }
+  return static_cast<int64_t>(nnz);
+}
+
+// --------------------------------------------------------------------------
+// Wire frame header (query protocol.py layout: magic u32 | cmd u8 |
+// meta_len u32 | payload_len u64, little-endian, packed = 17 bytes)
+// --------------------------------------------------------------------------
+
+static const uint32_t NNS_WIRE_MAGIC = 0x4E515250u;  // "NQRP"
+static const size_t NNS_WIRE_HEADER_SIZE = 17;
+
+void nns_wire_pack_header(uint8_t *out, uint8_t cmd, uint32_t meta_len,
+                          uint64_t payload_len) {
+  memcpy(out, &NNS_WIRE_MAGIC, 4);
+  out[4] = cmd;
+  memcpy(out + 5, &meta_len, 4);
+  memcpy(out + 9, &payload_len, 8);
+}
+
+// Returns 0 on success, -1 on bad magic.
+int nns_wire_parse_header(const uint8_t *in, uint8_t *cmd, uint32_t *meta_len,
+                          uint64_t *payload_len) {
+  uint32_t magic;
+  memcpy(&magic, in, 4);
+  if (magic != NNS_WIRE_MAGIC) return -1;
+  *cmd = in[4];
+  memcpy(meta_len, in + 5, 4);
+  memcpy(payload_len, in + 9, 8);
+  return 0;
+}
+
+size_t nns_wire_header_size() { return NNS_WIRE_HEADER_SIZE; }
+
+// --------------------------------------------------------------------------
+// Lock-free SPSC byte-slot ring (pipeline queue fast path; the reference
+// leans on GStreamer's queue — ours is a cacheline-padded ring of
+// fixed-size slots carrying opaque byte records)
+// --------------------------------------------------------------------------
+
+struct alignas(64) NnsRing {
+  uint64_t capacity;    // number of slots (power of two)
+  uint64_t slot_size;   // bytes per slot (record prefixed by u32 length)
+  uint8_t *slots;
+  alignas(64) std::atomic<uint64_t> head;  // consumer
+  alignas(64) std::atomic<uint64_t> tail;  // producer
+};
+
+void *nns_ring_create(uint64_t capacity_pow2, uint64_t slot_size) {
+  if (capacity_pow2 == 0 || (capacity_pow2 & (capacity_pow2 - 1)) != 0)
+    return nullptr;
+  auto *r = new (std::nothrow) NnsRing();
+  if (!r) return nullptr;
+  r->capacity = capacity_pow2;
+  r->slot_size = slot_size + 4;
+  r->slots = static_cast<uint8_t *>(
+      nns_aligned_alloc(r->capacity * r->slot_size, 64));
+  if (!r->slots) {
+    delete r;
+    return nullptr;
+  }
+  r->head.store(0, std::memory_order_relaxed);
+  r->tail.store(0, std::memory_order_relaxed);
+  return r;
+}
+
+void nns_ring_destroy(void *ring) {
+  auto *r = static_cast<NnsRing *>(ring);
+  if (!r) return;
+  nns_aligned_free(r->slots);
+  delete r;
+}
+
+// 1 = pushed, 0 = full, -1 = record too large.
+int nns_ring_push(void *ring, const uint8_t *data, uint32_t len) {
+  auto *r = static_cast<NnsRing *>(ring);
+  if (len + 4 > r->slot_size) return -1;
+  uint64_t tail = r->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->head.load(std::memory_order_acquire);
+  if (tail - head >= r->capacity) return 0;
+  uint8_t *slot = r->slots + (tail & (r->capacity - 1)) * r->slot_size;
+  memcpy(slot, &len, 4);
+  memcpy(slot + 4, data, len);
+  r->tail.store(tail + 1, std::memory_order_release);
+  return 1;
+}
+
+// ≥0 = record length copied into out, -1 = empty, -2 = out too small.
+int64_t nns_ring_pop(void *ring, uint8_t *out, uint64_t out_capacity) {
+  auto *r = static_cast<NnsRing *>(ring);
+  uint64_t head = r->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->tail.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint8_t *slot = r->slots + (head & (r->capacity - 1)) * r->slot_size;
+  uint32_t len;
+  memcpy(&len, slot, 4);
+  if (len > out_capacity) return -2;
+  memcpy(out, slot + 4, len);
+  r->head.store(head + 1, std::memory_order_release);
+  return len;
+}
+
+uint64_t nns_ring_size(void *ring) {
+  auto *r = static_cast<NnsRing *>(ring);
+  return r->tail.load(std::memory_order_acquire) -
+         r->head.load(std::memory_order_acquire);
+}
+
+}  // extern "C"

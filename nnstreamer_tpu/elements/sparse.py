@@ -20,14 +20,16 @@ from ..graph.element import Element, FlowReturn, Pad, register_element
 
 
 def sparse_encode(arr: np.ndarray, info: TensorInfo) -> bytes:
-    flat = arr.reshape(-1)
-    nz = np.nonzero(flat)[0].astype(np.uint32)
-    values = flat[nz]
+    from ..utils import native
+
+    nz, values = native.sparse_encode_arrays(arr)
     meta = TensorMetaInfo(info, TensorFormat.SPARSE, extra=int(nz.size))
     return meta.pack() + nz.tobytes() + values.tobytes()
 
 
 def sparse_decode(blob: bytes) -> Tuple[np.ndarray, TensorInfo]:
+    from ..utils import native
+
     meta = TensorMetaInfo.parse(blob)
     if meta.format is not TensorFormat.SPARSE:
         raise ValueError("not a sparse tensor blob")
@@ -37,8 +39,8 @@ def sparse_decode(blob: bytes) -> Tuple[np.ndarray, TensorInfo]:
     idx = np.frombuffer(blob, np.uint32, count=nnz, offset=off)
     off += nnz * 4
     values = np.frombuffer(blob, info.dtype.np_dtype, count=nnz, offset=off)
-    flat = np.zeros(info.num_elements, info.dtype.np_dtype)
-    flat[idx] = values
+    flat = native.sparse_decode_arrays(idx, values, info.num_elements,
+                                       info.dtype.np_dtype)
     return flat.reshape(info.shape), info
 
 

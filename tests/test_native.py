@@ -1,0 +1,116 @@
+"""Native C++ runtime component tests (allocator, sparse codec, SPSC ring)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.utils import native
+
+
+requires_native = pytest.mark.skipif(not native.native_available(),
+                                     reason="g++ toolchain unavailable")
+
+
+class TestAlignedAlloc:
+    @requires_native
+    def test_alignment(self):
+        arr = native.aligned_empty((100, 100), np.float32)
+        assert arr.ctypes.data % 64 == 0
+        arr[:] = 1.0
+        assert arr.sum() == 10000
+
+    def test_fallback_shape(self):
+        arr = native.aligned_empty((4, 4), np.uint8)
+        assert arr.shape == (4, 4)
+
+
+class TestSparseCodec:
+    def test_roundtrip(self):
+        dense = np.zeros(1000, np.float32)
+        dense[[3, 500, 999]] = [1.5, -2.0, 7.0]
+        idx, vals = native.sparse_encode_arrays(dense)
+        np.testing.assert_array_equal(idx, [3, 500, 999])
+        out = native.sparse_decode_arrays(idx, vals, 1000, np.float32)
+        np.testing.assert_array_equal(out, dense)
+
+    def test_all_dtypes(self):
+        for dt in [np.uint8, np.int16, np.float32, np.float64]:
+            dense = np.zeros(64, dt)
+            dense[7] = 3
+            idx, vals = native.sparse_encode_arrays(dense)
+            assert idx.tolist() == [7]
+            out = native.sparse_decode_arrays(idx, vals, 64, dt)
+            np.testing.assert_array_equal(out, dense)
+
+    @requires_native
+    def test_decode_bad_index(self):
+        with pytest.raises(ValueError):
+            native.sparse_decode_arrays(np.array([99], np.uint32),
+                                        np.array([1.0], np.float32), 10,
+                                        np.float32)
+
+    def test_matches_python_element_codec(self):
+        """Native codec and the sparse element wire format must agree."""
+        from nnstreamer_tpu.core import TensorInfo
+        from nnstreamer_tpu.elements.sparse import sparse_decode, sparse_encode
+
+        dense = np.zeros((8, 8), np.float32)
+        dense[1, 1] = 4.0
+        blob = sparse_encode(dense, TensorInfo.from_array(dense))
+        out, info = sparse_decode(blob)
+        np.testing.assert_array_equal(out, dense)
+        idx, vals = native.sparse_encode_arrays(dense)
+        assert idx.tolist() == [9]
+
+
+@requires_native
+class TestSpscRing:
+    def test_push_pop(self):
+        ring = native.SpscRing(16, 256)
+        assert ring.pop() is None
+        assert ring.push(b"hello")
+        assert ring.push(b"world")
+        assert len(ring) == 2
+        assert ring.pop() == b"hello"
+        assert ring.pop() == b"world"
+        ring.close()
+
+    def test_full(self):
+        ring = native.SpscRing(4, 64)
+        for i in range(4):
+            assert ring.push(bytes([i]))
+        assert not ring.push(b"x")  # full
+        ring.close()
+
+    def test_oversized_record(self):
+        ring = native.SpscRing(4, 8)
+        with pytest.raises(ValueError):
+            ring.push(b"x" * 100)
+        ring.close()
+
+    def test_threaded_producer_consumer(self):
+        ring = native.SpscRing(256, 64)
+        n = 10000
+        got = []
+
+        def producer():
+            for i in range(n):
+                rec = i.to_bytes(4, "little")
+                while not ring.push(rec):
+                    pass
+
+        def consumer():
+            while len(got) < n:
+                rec = ring.pop()
+                if rec is not None:
+                    got.append(int.from_bytes(rec, "little"))
+
+        t1 = threading.Thread(target=producer)
+        t2 = threading.Thread(target=consumer)
+        t1.start()
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+        assert got == list(range(n))
+        ring.close()
