@@ -1,0 +1,96 @@
+"""DeepLab-v3 semantic segmentation — BASELINE config 3.
+
+Native flax stand-in for the reference's deeplabv3_257 tflite
+(tests/test_models/models/deeplabv3_257_mv_gpu.tflite + image_segment
+decoder scheme tflite-deeplab): MobileNet-v2 backbone (output stride 16)
++ ASPP (atrous pyramid) + bilinear upsample to input size → per-pixel class
+logits [classes:W:H:1], exactly what tensordec-imagesegment.c argmaxes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .mobilenet_v2 import ConvBNReLU, InvertedResidual, _make_divisible, preprocess_uint8
+from .zoo import ModelBundle, register_model
+
+
+class ASPP(nn.Module):
+    features: int = 256
+    rates: tuple = (6, 12, 18)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        branches: List[jax.Array] = [
+            ConvBNReLU(self.features, kernel=1, dtype=self.dtype)(x, train)]
+        for r in self.rates:
+            y = nn.Conv(self.features, (3, 3), padding="SAME",
+                        kernel_dilation=(r, r), use_bias=False,
+                        dtype=self.dtype)(x)
+            y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+            branches.append(nn.relu(y))
+        # image-level pooling branch
+        g = jnp.mean(x, axis=(1, 2), keepdims=True)
+        g = ConvBNReLU(self.features, kernel=1, dtype=self.dtype)(g, train)
+        g = jnp.broadcast_to(g, branches[0].shape)
+        branches.append(g)
+        y = jnp.concatenate(branches, axis=-1)
+        return ConvBNReLU(self.features, kernel=1, dtype=self.dtype)(y, train)
+
+
+class DeepLabV3(nn.Module):
+    num_classes: int = 21
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        x = x.astype(self.dtype)
+        w = self.width
+        x = ConvBNReLU(_make_divisible(32 * w), stride=2, dtype=self.dtype)(x, train)
+        # output-stride 16: last stride-2 stage dilated instead of strided
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 1), (6, 320, 1, 1)]
+        for t, c, n, s in cfg:
+            for i in range(n):
+                x = InvertedResidual(_make_divisible(c * w), s if i == 0 else 1,
+                                     t, dtype=self.dtype)(x, train)
+        x = ASPP(dtype=self.dtype)(x, train)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(x)
+        x = jax.image.resize(x.astype(jnp.float32),
+                             (x.shape[0], size[0], size[1], self.num_classes),
+                             method="bilinear")
+        return x
+
+
+def make_deeplab_v3(width: str = "1.0", size: str = "257",
+                    num_classes: str = "21", seed: str = "0",
+                    batch: str = "1", dtype: str = "bfloat16",
+                    **_: Any) -> ModelBundle:
+    w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
+    model = DeepLabV3(num_classes=nc, width=w,
+                      dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    variables = model.init(jax.random.PRNGKey(int(seed)),
+                           jnp.zeros((b, hw, hw, 3), jnp.float32))
+
+    def apply(params, x):
+        if x.dtype == jnp.uint8:
+            x = preprocess_uint8(x)
+        return model.apply(params, x, train=False)
+
+    return ModelBundle(
+        "deeplab_v3", apply, params=variables,
+        in_info=TensorsInfo.from_strings(f"3:{hw}:{hw}:{b}", "uint8"),
+        out_info=TensorsInfo.from_strings(f"{nc}:{hw}:{hw}:{b}", "float32"),
+        preprocess=preprocess_uint8,
+        metadata={"size": hw, "classes": nc})
+
+
+register_model("deeplab_v3", make_deeplab_v3)

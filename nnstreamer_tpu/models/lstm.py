@@ -1,0 +1,44 @@
+"""Streaming LSTM cell — the tensor_repo loop workload (BASELINE config 5).
+
+Reference analog: tests/nnstreamer_example/custom_example_LSTM (a C LSTM
+cell custom filter driven through a tensor_repo cycle). Here: a flax
+LSTMCell exposed as a multi-input/multi-output ModelBundle
+``(x, h, c) -> (y, h', c')`` so the repo-loop pipeline carries recurrent
+state as ordinary stream tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+
+def make_lstm_cell(features: str = "32", input_size: str = "32",
+                   batch: str = "1", seed: str = "0", **_: Any) -> ModelBundle:
+    f, inp, b = int(features), int(input_size), int(batch)
+    cell = nn.LSTMCell(features=f)
+    key = jax.random.PRNGKey(int(seed))
+    dummy_x = jnp.zeros((b, inp), jnp.float32)
+    carry0 = cell.initialize_carry(key, dummy_x.shape)
+    params = cell.init(key, carry0, dummy_x)
+
+    def apply(p, x, h, c):
+        (new_c, new_h), y = cell.apply(p, (c, h), x)
+        return y, new_h, new_c
+
+    io = TensorsInfo.from_strings(
+        f"{inp}:{b},{f}:{b},{f}:{b}", "float32,float32,float32")
+    out = TensorsInfo.from_strings(
+        f"{f}:{b},{f}:{b},{f}:{b}", "float32,float32,float32")
+    return ModelBundle("lstm_cell", apply, params=params,
+                       in_info=io, out_info=out,
+                       metadata={"features": f, "input": inp})
+
+
+register_model("lstm_cell", make_lstm_cell)

@@ -1,0 +1,71 @@
+"""PoseNet keypoint estimation — BASELINE config 4.
+
+Native flax stand-in for the reference's posenet tflite pipeline
+(tests/nnstreamer_decoder_pose + tensordec-pose.c heatmap-offset mode):
+MobileNet-v2 backbone → heatmaps [K:W':H':1] + offsets [2K:W':H':1], the
+tensor pair the pose decoder consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .mobilenet_v2 import ConvBNReLU, InvertedResidual, _make_divisible, preprocess_uint8
+from .zoo import ModelBundle, register_model
+
+
+class PoseNet(nn.Module):
+    num_keypoints: int = 17
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        w = self.width
+        x = ConvBNReLU(_make_divisible(32 * w), stride=2, dtype=self.dtype)(x, train)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1)]
+        for t, c, n, s in cfg:
+            for i in range(n):
+                x = InvertedResidual(_make_divisible(c * w), s if i == 0 else 1,
+                                     t, dtype=self.dtype)(x, train)
+        heat = nn.Conv(self.num_keypoints, (1, 1), dtype=self.dtype,
+                       name="heatmap_head")(x)
+        offs = nn.Conv(2 * self.num_keypoints, (1, 1), dtype=self.dtype,
+                       name="offset_head")(x)
+        return heat.astype(jnp.float32), offs.astype(jnp.float32)
+
+
+def make_posenet(width: str = "1.0", size: str = "257",
+                 num_keypoints: str = "17", seed: str = "0",
+                 batch: str = "1", dtype: str = "bfloat16",
+                 **_: Any) -> ModelBundle:
+    w, hw, k, b = float(width), int(size), int(num_keypoints), int(batch)
+    model = PoseNet(num_keypoints=k, width=w,
+                    dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    variables = model.init(jax.random.PRNGKey(int(seed)),
+                           jnp.zeros((b, hw, hw, 3), jnp.float32))
+    out_hw = -(-hw // 16)  # stride-16 feature grid
+
+    def apply(params, x):
+        if x.dtype == jnp.uint8:
+            x = preprocess_uint8(x)
+        return model.apply(params, x, train=False)
+
+    return ModelBundle(
+        "posenet", apply, params=variables,
+        in_info=TensorsInfo.from_strings(f"3:{hw}:{hw}:{b}", "uint8"),
+        out_info=TensorsInfo.from_strings(
+            f"{k}:{out_hw}:{out_hw}:{b},{2 * k}:{out_hw}:{out_hw}:{b}",
+            "float32,float32"),
+        preprocess=preprocess_uint8,
+        metadata={"keypoints": k, "size": hw, "grid": out_hw})
+
+
+register_model("posenet", make_posenet)

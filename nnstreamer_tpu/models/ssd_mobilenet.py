@@ -1,0 +1,137 @@
+"""SSD-MobileNet object detection — BASELINE config 2.
+
+Native flax implementation of the SSD-MobileNet pipeline the reference runs
+via tflite (tests/nnstreamer_decoder_boundingbox; decoder mode
+mobilenet-ssd): MobileNet-v2 backbone + lightweight SSD heads emitting
+``locations [N,anchors,4]`` and ``class logits [N,anchors,classes]`` — the
+exact tensor pair tensordec-boundingbox.c decodes with a box-priors file.
+
+``generate_anchors``/``write_box_priors`` produce the matching priors
+(ycenter,xcenter,h,w rows) so the whole detection path is self-contained.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import TensorsInfo
+from .mobilenet_v2 import ConvBNReLU, InvertedResidual, _make_divisible, preprocess_uint8
+from .zoo import ModelBundle, register_model
+
+
+class SSDMobileNetV2(nn.Module):
+    """Backbone truncated at two strides + extra layers; one head per scale."""
+
+    num_classes: int = 91
+    width: float = 1.0
+    anchors_per_cell: int = 6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        w = self.width
+        feats: List[jax.Array] = []
+        x = ConvBNReLU(_make_divisible(32 * w), stride=2, dtype=self.dtype)(x, train)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1)]
+        for t, c, n, s in cfg:
+            for i in range(n):
+                x = InvertedResidual(_make_divisible(c * w), s if i == 0 else 1,
+                                     t, dtype=self.dtype)(x, train)
+        feats.append(x)  # stride 16
+        for t, c, n, s in [(6, 160, 3, 2), (6, 320, 1, 1)]:
+            for i in range(n):
+                x = InvertedResidual(_make_divisible(c * w), s if i == 0 else 1,
+                                     t, dtype=self.dtype)(x, train)
+        feats.append(x)  # stride 32
+        x = ConvBNReLU(_make_divisible(256 * w), kernel=1, dtype=self.dtype)(x, train)
+        x = ConvBNReLU(_make_divisible(512 * w), stride=2, dtype=self.dtype)(x, train)
+        feats.append(x)  # stride 64
+
+        locs, logits = [], []
+        k = self.anchors_per_cell
+        for i, f in enumerate(feats):
+            loc = nn.Conv(k * 4, (3, 3), padding="SAME", dtype=self.dtype,
+                          name=f"loc_head_{i}")(f)
+            cls = nn.Conv(k * self.num_classes, (3, 3), padding="SAME",
+                          dtype=self.dtype, name=f"cls_head_{i}")(f)
+            b = loc.shape[0]
+            locs.append(loc.reshape(b, -1, 4))
+            logits.append(cls.reshape(b, -1, self.num_classes))
+        return (jnp.concatenate(locs, axis=1).astype(jnp.float32),
+                jnp.concatenate(logits, axis=1).astype(jnp.float32))
+
+
+def feature_grid_sizes(size: int) -> List[int]:
+    return [math.ceil(size / 16), math.ceil(size / 32), math.ceil(size / 64)]
+
+
+def generate_anchors(size: int, anchors_per_cell: int = 6,
+                     min_scale: float = 0.2, max_scale: float = 0.95) -> np.ndarray:
+    """Anchor grid matching the model's head layout → rows
+    [ycenter, xcenter, h, w] (normalized), shape (4, total_anchors)."""
+    grids = feature_grid_sizes(size)
+    n_layers = len(grids)
+    scales = [min_scale + (max_scale - min_scale) * i / max(n_layers - 1, 1)
+              for i in range(n_layers)] + [1.0]
+    ratios = [1.0, 2.0, 0.5, 3.0, 1.0 / 3.0]
+    out = []
+    for li, g in enumerate(grids):
+        s = scales[li]
+        s_next = math.sqrt(s * scales[li + 1])
+        cell_anchors: List[Tuple[float, float]] = []
+        for r in ratios[:anchors_per_cell - 1]:
+            cell_anchors.append((s / math.sqrt(r), s * math.sqrt(r)))
+        cell_anchors.append((s_next, s_next))
+        for y, x in itertools.product(range(g), repeat=2):
+            cy, cx = (y + 0.5) / g, (x + 0.5) / g
+            for h, w in cell_anchors[:anchors_per_cell]:
+                out.append((cy, cx, h, w))
+    return np.asarray(out, np.float32).T  # (4, N)
+
+
+def write_box_priors(path: str, size: int = 300,
+                     anchors_per_cell: int = 6) -> int:
+    """Write a tensordec-boundingbox-compatible priors file; returns anchor
+    count."""
+    pri = generate_anchors(size, anchors_per_cell)
+    with open(path, "w", encoding="utf-8") as f:
+        for row in pri:
+            f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    return pri.shape[1]
+
+
+def make_ssd_mobilenet_v2(width: str = "1.0", size: str = "300",
+                          num_classes: str = "91", seed: str = "0",
+                          batch: str = "1", dtype: str = "bfloat16",
+                          **_: Any) -> ModelBundle:
+    w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
+    model = SSDMobileNetV2(num_classes=nc, width=w,
+                           dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    dummy = jnp.zeros((b, hw, hw, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    n_anchors = sum(g * g * 6 for g in feature_grid_sizes(hw))
+
+    def apply(params, x):
+        if x.dtype == jnp.uint8:
+            x = preprocess_uint8(x)
+        return model.apply(params, x, train=False)
+
+    return ModelBundle(
+        "ssd_mobilenet_v2", apply, params=variables,
+        in_info=TensorsInfo.from_strings(f"3:{hw}:{hw}:{b}", "uint8"),
+        out_info=TensorsInfo.from_strings(
+            f"4:{n_anchors}:{b},{nc}:{n_anchors}:{b}", "float32,float32"),
+        preprocess=preprocess_uint8,
+        metadata={"anchors": n_anchors, "size": hw, "classes": nc})
+
+
+register_model("ssd_mobilenet_v2", make_ssd_mobilenet_v2)

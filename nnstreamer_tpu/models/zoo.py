@@ -94,3 +94,7 @@ def _ensure_builtin_models() -> None:
     _builtins_loaded = True
     from . import mobilenet_v2  # noqa: F401
     from . import simple  # noqa: F401
+    from . import ssd_mobilenet  # noqa: F401
+    from . import deeplab  # noqa: F401
+    from . import posenet  # noqa: F401
+    from . import lstm  # noqa: F401

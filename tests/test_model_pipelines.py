@@ -1,0 +1,119 @@
+"""Full BASELINE-config pipelines with the native model zoo:
+SSD→bounding_box, DeepLab→image_segment, PoseNet→pose, LSTM repo loop
+(mirrors BASELINE.md's five configs on tiny shapes)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.models.zoo import get_model, model_names
+
+
+def test_zoo_catalog_complete():
+    names = model_names()
+    for required in ["mobilenet_v2", "ssd_mobilenet_v2", "deeplab_v3",
+                     "posenet", "lstm_cell", "passthrough", "scaler"]:
+        assert required in names
+
+
+def test_ssd_detection_pipeline_with_priors(tmp_path):
+    from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+
+    priors = tmp_path / "box_priors.txt"
+    n = write_box_priors(str(priors), size=96)
+    labels = tmp_path / "labels.txt"
+    labels.write_text("\n".join(f"c{i}" for i in range(6)))
+    bundle = get_model("zoo://ssd_mobilenet_v2?size=96&width=0.25"
+                       "&num_classes=6&dtype=float32")
+    assert bundle.metadata["anchors"] == n
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=96, height=96, num_buffers=2,
+                    pattern="random")
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=bundle)
+    dec = p.add_new("tensor_decoder", mode="bounding_box",
+                    option1="mobilenet-ssd", option2=str(labels),
+                    option3=str(priors), option4="96:96", option5="96:96")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=180)
+    assert sink.num_buffers == 2
+    b = sink.buffers[0]
+    assert b.memories[0].host().shape == (96, 96, 4)
+    assert isinstance(b.meta["detections"], list)  # untrained → any count
+
+
+def test_deeplab_segmentation_pipeline():
+    bundle = get_model("zoo://deeplab_v3?size=33&width=0.25&num_classes=5"
+                       "&dtype=float32")
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=33, height=33, num_buffers=2)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", model=bundle)
+    dec = p.add_new("tensor_decoder", mode="image_segment",
+                    option1="tflite-deeplab")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=180)
+    mask = sink.buffers[0].memories[0].host()
+    assert mask.shape == (33, 33, 4)
+
+
+def test_posenet_pipeline():
+    bundle = get_model("zoo://posenet?size=33&width=0.25&dtype=float32")
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=33, height=33, num_buffers=1)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", model=bundle)
+    dec = p.add_new("tensor_decoder", mode="pose_estimation",
+                    option1="66:66", option2="33:33", option4="heatmap-offset")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=180)
+    b = sink.buffers[0]
+    assert len(b.meta["keypoints"]) == 17
+    assert b.memories[0].host().shape == (66, 66, 4)
+
+
+def test_lstm_repo_loop_with_zoo_cell():
+    """Composite config: mux + repo loop driving the flax LSTM cell."""
+    from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.elements.repo import reset_repo
+
+    reset_repo()
+    bundle = get_model("zoo://lstm_cell?features=8&input_size=4")
+    p = Pipeline()
+    xs = [np.random.default_rng(i).normal(size=(1, 4)).astype(np.float32)
+          for i in range(3)]
+    src = p.add_new("appsrc",
+                    caps=Caps.tensors(TensorsConfig(
+                        TensorsInfo.from_strings("4:1", "float32"), 30)),
+                    data=xs)
+    state = p.add_new("tensor_reposrc", slot_index=9, dims="8:1,8:1",
+                      types="float32,float32")
+    mux = p.add_new("tensor_mux", sync_mode="nosync")
+    filt = p.add_new("tensor_filter", model=bundle)
+    demux = p.add_new("tensor_demux", tensorpick="0,1:2")
+    qo = p.add_new("queue")
+    qs = p.add_new("queue")
+    out_sink = p.add_new("tensor_sink", store=True)
+    repo_sink = p.add_new("tensor_reposink", slot_index=9)
+    Pipeline.link(src, mux)
+    Pipeline.link(state, mux)
+    Pipeline.link(mux, filt, demux)
+    Pipeline.link(demux, qo, out_sink)   # y
+    Pipeline.link(demux, qs, repo_sink)  # (h', c') back into the loop
+    p.start()
+    import time
+
+    deadline = time.monotonic() + 60
+    while out_sink.num_buffers < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    p.stop()
+    assert out_sink.num_buffers >= 3
+    # recurrent state actually evolved: same input at t0/t1 would give
+    # different outputs; verify outputs finite and not identical
+    y0 = out_sink.buffers[0].memories[0].host()
+    y1 = out_sink.buffers[1].memories[0].host()
+    assert np.all(np.isfinite(y0)) and np.all(np.isfinite(y1))
+    assert not np.array_equal(y0, y1)
