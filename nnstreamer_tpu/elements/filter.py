@@ -134,8 +134,9 @@ class TensorFilter(Element):
 
             self.fw = shared_model_get_or_create(key, factory)
         else:
-            self.fw = cls()
-            self.fw.open(props)
+            fw = cls()
+            fw.open(props)  # only adopt a successfully opened backend
+            self.fw = fw
         self.resolved_framework = fw_name
 
     @staticmethod
@@ -168,9 +169,13 @@ class TensorFilter(Element):
         in_info, out_info = self.fw.get_model_info()
         stream_info = in_config.info
         model_sees = self._picked_info(stream_info)
+        # with a fused preprocessing stage the wire caps describe the
+        # *transformed* stream while raw arrays reach the jit; the fused
+        # program itself validates shapes at trace time
+        fused = getattr(self.fw, "_fused_pre", None) is not None
         if in_info is None:
             out_info = self.fw.set_input_info(model_sees)
-        elif stream_info.format is TensorFormat.STATIC and \
+        elif not fused and stream_info.format is TensorFormat.STATIC and \
                 not in_info.is_compatible(model_sees):
             raise ValueError(
                 f"tensor_filter {self.name}: stream {model_sees} incompatible "

@@ -1,0 +1,114 @@
+"""tensor_src_iio — Linux Industrial-I/O sensor capture.
+
+Reference: gst/nnstreamer/elements/gsttensor_srciio.c (2758 LoC): scans
+/sys/bus/iio/devices for a device, reads enabled channels at ``frequency``,
+emits typed tensors (per-channel scan conversion tensor_src_iio.c:104-136).
+
+This implementation polls sysfs ``in_*_raw`` channel files (buffered
+/dev/iio character-device capture is a future extension), applies
+offset/scale when the matching sysfs attributes exist, and emits one
+[channels] float32 tensor per sample period. ``base_dir`` overrides the
+sysfs root so tests can fake a device tree (the reference's unittest_src_iio
+does exactly this in tmpfs).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from fractions import Fraction
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, NS_PER_SEC
+from ..core.types import Caps, TensorsConfig, TensorsInfo
+from ..graph.element import register_element
+from ..graph.pipeline import SourceElement
+
+_DEFAULT_SYSFS = "/sys/bus/iio/devices"
+
+
+@register_element
+class TensorSrcIIO(SourceElement):
+    ELEMENT_NAME = "tensor_src_iio"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.device: Optional[str] = None       # device name (e.g. "iio:device0" or its `name` file contents)
+        self.frequency = 10                     # Hz polling
+        self.channels: Optional[str] = None     # "auto" or comma list, e.g. "voltage0,voltage1"
+        self.base_dir = _DEFAULT_SYSFS
+        super().__init__(name, **props)
+        self._dev_dir: Optional[str] = None
+        self._chan_files: List[str] = []
+        self._scales: List[float] = []
+        self._offsets: List[float] = []
+        self._n = 0
+
+    def _find_device(self) -> str:
+        if not os.path.isdir(self.base_dir):
+            raise FileNotFoundError(f"IIO sysfs root missing: {self.base_dir}")
+        for entry in sorted(os.listdir(self.base_dir)):
+            d = os.path.join(self.base_dir, entry)
+            name_file = os.path.join(d, "name")
+            if not os.path.isdir(d):
+                continue
+            if self.device in (None, "", entry):
+                return d
+            if os.path.isfile(name_file):
+                with open(name_file) as f:
+                    if f.read().strip() == self.device:
+                        return d
+        raise FileNotFoundError(f"IIO device {self.device!r} not found under "
+                                f"{self.base_dir}")
+
+    def negotiate(self) -> Caps:
+        self._dev_dir = self._find_device()
+        want = None
+        if self.channels and self.channels != "auto":
+            want = {c.strip() for c in str(self.channels).split(",")}
+        self._chan_files, self._scales, self._offsets = [], [], []
+        for fn in sorted(os.listdir(self._dev_dir)):
+            m = re.fullmatch(r"in_([a-z0-9_]+)_raw", fn)
+            if not m:
+                continue
+            if want is not None and m.group(1) not in want:
+                continue
+            self._chan_files.append(os.path.join(self._dev_dir, fn))
+            base = fn[:-4]  # strip "_raw"
+            self._scales.append(self._read_float(f"{base}_scale", 1.0))
+            self._offsets.append(self._read_float(f"{base}_offset", 0.0))
+        if not self._chan_files:
+            raise ValueError(f"no IIO channels found in {self._dev_dir}")
+        self._n = 0
+        self.live = True
+        cfg = TensorsConfig(
+            TensorsInfo.from_strings(f"{len(self._chan_files)}:1", "float32"),
+            Fraction(self.frequency))
+        return Caps.tensors(cfg)
+
+    def _read_float(self, fn: str, default: float) -> float:
+        path = os.path.join(self._dev_dir, fn)
+        try:
+            with open(path) as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            return default
+
+    def create(self) -> Optional[Buffer]:
+        vals = []
+        for path, scale, offset in zip(self._chan_files, self._scales,
+                                       self._offsets):
+            try:
+                with open(path) as f:
+                    raw = float(f.read().strip() or 0)
+            except (OSError, ValueError):
+                raw = 0.0
+            vals.append((raw + offset) * scale)
+        dur = int(NS_PER_SEC / Fraction(self.frequency))
+        buf = Buffer.of(np.asarray([vals], np.float32).reshape(1, -1),
+                        pts=self._n * dur, duration=dur)
+        buf.offset = self._n
+        self._n += 1
+        return buf

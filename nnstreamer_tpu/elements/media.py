@@ -1,0 +1,209 @@
+"""Media helper elements: image file source, image decoder, video scale/convert.
+
+These cover the GStreamer media elements the reference's test pipelines lean
+on (pngdec/jpegdec, videoscale, videoconvert, multifilesrc — e.g.
+tests/nnstreamer_filter_tensorflow2_lite/runTest.sh pipelines decode PNGs
+then scale to the model size). Host-side decode uses PIL; scaling for the
+device path should prefer tensor_transform/XLA — ``videoscale`` here is the
+host fallback for pre-converter media.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from fractions import Fraction
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory, NS_PER_SEC
+from ..core.types import Caps, VIDEO_FORMATS
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.pipeline import SourceElement
+
+
+def _decode_image(data: bytes, fmt: str) -> np.ndarray:
+    from PIL import Image
+    import io
+
+    img = Image.open(io.BytesIO(data))
+    mode = {"RGB": "RGB", "RGBA": "RGBA", "GRAY8": "L"}.get(fmt, "RGB")
+    return np.asarray(img.convert(mode))
+
+
+@register_element
+class ImageFileSrc(SourceElement):
+    """Reads image files (glob pattern) → video/x-raw frames.
+
+    multifilesrc+pngdec equivalent: ``imagefilesrc location="imgs/*.png"
+    framerate=30 loop=false``.
+    """
+
+    ELEMENT_NAME = "imagefilesrc"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.location: Optional[str] = None
+        self.format = "RGB"
+        self.framerate: Any = 30
+        self.loop = False
+        super().__init__(name, **props)
+        self._files: List[str] = []
+        self._idx = 0
+        self._size = None
+
+    def negotiate(self) -> Caps:
+        if not self.location:
+            raise ValueError("imagefilesrc requires location")
+        self._files = sorted(_glob.glob(self.location)) \
+            if any(c in self.location for c in "*?[") else [self.location]
+        if not self._files:
+            raise FileNotFoundError(f"no images match {self.location!r}")
+        self._idx = 0
+        first = _decode_image(open(self._files[0], "rb").read(), self.format)
+        self._size = first.shape
+        h, w = first.shape[:2]
+        return Caps("video/x-raw", {"format": self.format, "width": w,
+                                    "height": h,
+                                    "framerate": Fraction(self.framerate)})
+
+    def create(self) -> Optional[Buffer]:
+        if self._idx >= len(self._files):
+            if not self.loop:
+                return None
+            self._idx = 0
+        frame = _decode_image(open(self._files[self._idx], "rb").read(),
+                              self.format)
+        if frame.shape != self._size:
+            raise ValueError(
+                f"image {self._files[self._idx]} shape {frame.shape} != "
+                f"first image {self._size}")
+        rate = Fraction(self.framerate)
+        dur = int(NS_PER_SEC / rate) if rate > 0 else None
+        buf = Buffer.of(frame, pts=(self._idx * dur if dur else self._idx),
+                        duration=dur)
+        buf.offset = self._idx
+        self._idx += 1
+        return buf
+
+
+@register_element
+class ImageDec(Element):
+    """Decodes encoded image bytes (PNG/JPEG/...) → video/x-raw
+    (pngdec/jpegdec equivalent; upstream delivers whole files per buffer)."""
+
+    ELEMENT_NAME = "imagedec"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.format = "RGB"
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self._caps_sent = False
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        self._caps_sent = False  # actual size known at first frame
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        data = b"".join(m.tobytes() for m in buf.memories)
+        frame = _decode_image(data, self.format)
+        if not self._caps_sent:
+            self._caps_sent = True
+            h, w = frame.shape[:2]
+            self.send_caps_all(Caps("video/x-raw",
+                                    {"format": self.format, "width": w,
+                                     "height": h,
+                                     "framerate": Fraction(0, 1)}))
+        return self.push(buf.with_memories([TensorMemory(frame)]))
+
+
+@register_element
+class VideoScale(Element):
+    """Host-side resize to width×height (videoscale equivalent, PIL
+    bilinear). For device-resident streams prefer jax.image.resize inside a
+    model/transform stage."""
+
+    ELEMENT_NAME = "videoscale"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.width = 0
+        self.height = 0
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        if caps.media_type != "video/x-raw":
+            raise ValueError("videoscale accepts video/x-raw")
+        if not (self.width and self.height):
+            raise ValueError("videoscale requires width and height")
+        pad.caps = caps
+        self.send_caps_all(caps.with_fields(width=int(self.width),
+                                            height=int(self.height)))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        from PIL import Image
+
+        frame = buf.memories[0].host()
+        img = Image.fromarray(frame)
+        img = img.resize((int(self.width), int(self.height)), Image.BILINEAR)
+        return self.push(buf.with_memories([TensorMemory(np.asarray(img))]))
+
+
+@register_element
+class VideoConvert(Element):
+    """Pixel-format conversion among RGB/RGBA/BGR/GRAY8 (videoconvert
+    equivalent). ``format=`` picks the output."""
+
+    ELEMENT_NAME = "videoconvert"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.format = "RGB"
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self._in_fmt = "RGB"
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        if caps.media_type != "video/x-raw":
+            raise ValueError("videoconvert accepts video/x-raw")
+        self._in_fmt = caps.get("format", "RGB")
+        if self.format not in VIDEO_FORMATS:
+            raise ValueError(f"unsupported output format {self.format!r}")
+        pad.caps = caps
+        self.send_caps_all(caps.with_fields(format=self.format))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        frame = buf.memories[0].host()
+        out = _convert_pixels(frame, self._in_fmt, self.format)
+        return self.push(buf.with_memories([TensorMemory(out)]))
+
+
+def _convert_pixels(frame: np.ndarray, src: str, dst: str) -> np.ndarray:
+    if src == dst:
+        return frame
+    # normalize to RGB(A)
+    if src.startswith("BGR"):
+        rgb = frame[..., [2, 1, 0]]
+    elif src == "GRAY8":
+        rgb = np.repeat(frame[..., :1] if frame.ndim == 3 else frame[..., None],
+                        3, axis=-1)
+    elif src in ("RGBA", "RGBx"):
+        rgb = frame[..., :3]
+    else:
+        rgb = frame[..., :3]
+    if dst == "RGB":
+        return np.ascontiguousarray(rgb)
+    if dst in ("BGR",):
+        return np.ascontiguousarray(rgb[..., [2, 1, 0]])
+    if dst in ("RGBA", "RGBx"):
+        alpha = np.full(rgb.shape[:-1] + (1,), 255, np.uint8)
+        return np.concatenate([rgb, alpha], axis=-1)
+    if dst in ("BGRA", "BGRx"):
+        alpha = np.full(rgb.shape[:-1] + (1,), 255, np.uint8)
+        return np.concatenate([rgb[..., [2, 1, 0]], alpha], axis=-1)
+    if dst == "GRAY8":
+        g = (0.299 * rgb[..., 0] + 0.587 * rgb[..., 1] + 0.114 * rgb[..., 2])
+        return g.astype(np.uint8)[..., None]
+    raise ValueError(f"unsupported conversion {src}->{dst}")

@@ -36,6 +36,7 @@ class TensorTransform(Element):
         self._transform: Optional[transform_ops.Transform] = None
         self._jitted = None
         self._out_config: Optional[TensorsConfig] = None
+        self._fused = False  # set by ops.fusion: math runs inside the filter's jit
 
     def _build(self) -> transform_ops.Transform:
         if self.transform_chain:
@@ -64,6 +65,9 @@ class TensorTransform(Element):
         self.send_caps_all(Caps.tensors(self._out_config))
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        if self._fused:  # math happens inside the downstream filter's jit
+            return self.push(buf.with_memories(buf.memories,
+                                               config=self._out_config))
         outs = [TensorMemory(self._jitted(m.device())) for m in buf.memories]
         return self.push(buf.with_memories(outs, config=self._out_config))
 

@@ -153,13 +153,22 @@ class XLAFilter(FilterFramework):
         log.info("xla-tpu opened model=%s device=%s sync=%s",
                  self._bundle.name, self._device, self._sync)
 
+    def set_fused_preprocess(self, pre) -> None:
+        """Install a jax-traceable per-tensor preprocessing stage compiled
+        into the same XLA program (ops.fusion pass)."""
+        self._fused_pre = pre
+        self._build_jit()
+
     def _build_jit(self) -> None:
         import jax
 
         fn = self._bundle.fn()
         precision = self._precision
+        pre = getattr(self, "_fused_pre", None)
 
         def wrapped(*xs):
+            if pre is not None:
+                xs = tuple(pre(x) for x in xs)
             if precision in ("bf16", "bfloat16"):
                 import jax.numpy as jnp
 

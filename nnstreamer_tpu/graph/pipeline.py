@@ -264,6 +264,9 @@ class Pipeline:
         self._sinks_eos: set = set()
         self._lock = threading.Lock()
         self.running = False
+        #: fuse transform→filter chains into one XLA program at start
+        self.auto_fuse = True
+        self._fused_count = 0
 
     # -- construction -------------------------------------------------------- #
     def add(self, *elements: Element) -> Union[Element, Sequence[Element]]:
@@ -309,6 +312,10 @@ class Pipeline:
             el._eos_pads.clear()
             for p in el.sink_pads + el.src_pads:
                 p.eos = False
+        if self.auto_fuse:
+            from ..ops.fusion import fuse_chains
+
+            self._fused_count = fuse_chains(self)
         # start non-sources first so threads/queues are ready, then sources
         for el in self.elements.values():
             if not el.is_source:

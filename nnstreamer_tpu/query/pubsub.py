@@ -1,0 +1,214 @@
+"""Topic pub/sub stream transport (mqttsink/mqttsrc equivalents).
+
+Reference: gst/mqtt/ (3404 LoC; paho-mqtt pub/sub of arbitrary Gst streams
+with a fixed header carrying num_mems/sizes/timestamps + NTP epoch sync,
+mqttcommon.h:29-63). paho isn't in this image, so the broker here is a
+built-in topic-fanout TCP service (``PubSubBroker``); the elements keep the
+reference's semantics:
+
+  * ``mqttsink pub-topic=t``  — publishes every buffer (meta + payload + the
+    publisher's wall-clock epoch, the ntputil analog);
+  * ``mqttsrc sub-topic=t``   — subscribes and re-emits buffers, recording
+    ``mqtt_latency_ns`` (receiver epoch − sender epoch) in buffer meta.
+
+Wire: length-prefixed frames. SUB: {"op":"sub","topic":t}; PUB frames carry
+{"op":"pub","topic":t,...buffer meta...} + payload.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _q
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.buffer import Buffer
+from ..core.log import logger
+from ..core.types import Caps, TensorFormat
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.pipeline import SourceElement
+from .protocol import buffer_to_payload, payload_to_buffer
+
+log = logger("pubsub")
+
+_LEN = struct.Struct("<I")
+
+
+def _send_frame(sock: socket.socket, meta: Dict[str, Any], payload: bytes = b"") -> None:
+    meta_b = json.dumps(meta, separators=(",", ":")).encode()
+    sock.sendall(_LEN.pack(len(meta_b)) + meta_b + _LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out += chunk
+    return out
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], bytes]:
+    (mlen,) = _LEN.unpack(_recv_exact(sock, 4))
+    meta = json.loads(_recv_exact(sock, mlen) or b"{}")
+    (plen,) = _LEN.unpack(_recv_exact(sock, 4))
+    payload = _recv_exact(sock, plen) if plen else b""
+    return meta, payload
+
+
+class PubSubBroker:
+    """Topic-fanout broker: publishers' frames are copied to every current
+    subscriber of the topic (QoS-0 MQTT semantics)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883):
+        self._subs: Dict[str, List[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(32)
+        self._listener.settimeout(0.2)
+        self.host, self.port = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PubSubBroker":
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name="pubsub-broker")
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        subscribed: List[str] = []
+        try:
+            while not self._stop.is_set():
+                meta, payload = _recv_frame(conn)
+                op = meta.get("op")
+                topic = str(meta.get("topic", ""))
+                if op == "sub":
+                    with self._lock:
+                        self._subs.setdefault(topic, []).append(conn)
+                    subscribed.append(topic)
+                elif op == "pub":
+                    with self._lock:
+                        targets = list(self._subs.get(topic, []))
+                    dead = []
+                    for s in targets:
+                        try:
+                            _send_frame(s, meta, payload)
+                        except OSError:
+                            dead.append(s)
+                    if dead:
+                        with self._lock:
+                            for s in dead:
+                                for subs in self._subs.values():
+                                    if s in subs:
+                                        subs.remove(s)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                for t in subscribed:
+                    if conn in self._subs.get(t, []):
+                        self._subs[t].remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+@register_element
+class MqttSink(Element):
+    ELEMENT_NAME = "mqttsink"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.host = "127.0.0.1"
+        self.port = 1883
+        self.pub_topic = "nns/stream"
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self._sock: Optional[socket.socket] = None
+
+    def start(self) -> None:
+        self._sock = socket.create_connection((self.host, int(self.port)),
+                                              timeout=5)
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        meta, payload = buffer_to_payload(buf)
+        meta.update({"op": "pub", "topic": self.pub_topic,
+                     "sent_epoch_ns": time.time_ns()})
+        _send_frame(self._sock, meta, payload)
+        return FlowReturn.OK
+
+    def stop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+@register_element
+class MqttSrc(SourceElement):
+    ELEMENT_NAME = "mqttsrc"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.host = "127.0.0.1"
+        self.port = 1883
+        self.sub_topic = "nns/stream"
+        super().__init__(name, **props)
+        self._sock: Optional[socket.socket] = None
+
+    def negotiate(self) -> Caps:
+        self._sock = socket.create_connection((self.host, int(self.port)),
+                                              timeout=5)
+        _send_frame(self._sock, {"op": "sub", "topic": self.sub_topic})
+        self._sock.settimeout(0.2)
+        return Caps.tensors(format=TensorFormat.FLEXIBLE)
+
+    def create(self) -> Optional[Buffer]:
+        while not self._stop_flag.is_set():
+            try:
+                meta, payload = _recv_frame(self._sock)
+            except socket.timeout:
+                continue
+            except (ConnectionError, OSError):
+                return None
+            buf = payload_to_buffer(meta, payload)
+            sent = meta.get("sent_epoch_ns")
+            if sent is not None:
+                buf.meta["mqtt_latency_ns"] = time.time_ns() - sent
+            return buf
+        return None
+
+    def stop(self) -> None:
+        super().stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

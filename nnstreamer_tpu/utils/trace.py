@@ -1,0 +1,124 @@
+"""Pipeline tracing & profiling.
+
+Reference (SURVEY §5): no in-tree tracer; users attach GstShark tracers
+(``interlatency``, ``proctime``) plus per-filter invoke stats. Here tracing
+is in-tree: a ``PipelineTracer`` wraps every element's chain to record
+per-element processing time (proctime) and source→element latency
+(interlatency), and ``device_trace`` brackets a run with jax.profiler for
+XLA/TPU timelines (xprof).
+
+    tracer = PipelineTracer.attach(pipeline)
+    pipeline.run()
+    print(tracer.report())
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core.buffer import Buffer
+
+
+@dataclass
+class ElementTrace:
+    name: str
+    n: int = 0
+    total_ns: int = 0
+    max_ns: int = 0
+    # interlatency: time from buffer PTS-origin entry into pipeline to entry
+    # into this element (needs source stamping wall-clock in buf.meta)
+    inter_total_ns: int = 0
+    inter_n: int = 0
+
+    @property
+    def proctime_us(self) -> float:
+        return self.total_ns / max(self.n, 1) / 1000
+
+    @property
+    def interlatency_us(self) -> float:
+        return self.inter_total_ns / max(self.inter_n, 1) / 1000
+
+
+class PipelineTracer:
+    """Wraps element chains to collect proctime/interlatency per element."""
+
+    def __init__(self) -> None:
+        self.traces: Dict[str, ElementTrace] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def attach(cls, pipeline: Any) -> "PipelineTracer":
+        tracer = cls()
+        for el in pipeline.elements.values():
+            tracer._wrap(el)
+        return tracer
+
+    def _wrap(self, el: Any) -> None:
+        trace = self.traces.setdefault(el.name, ElementTrace(el.name))
+        if el.is_source:
+            orig_create = getattr(el, "create", None)
+            if orig_create is not None:
+                def create_stamped(_orig=orig_create):
+                    buf = _orig()
+                    if buf is not None:
+                        buf.meta.setdefault("trace_t0_ns", time.monotonic_ns())
+                    return buf
+
+                el.create = create_stamped
+            return
+        orig = el._chain_entry
+
+        def timed_chain(pad, buf, _orig=orig, _t=trace):
+            now = time.monotonic_ns()
+            t0 = buf.meta.get("trace_t0_ns") if isinstance(buf, Buffer) else None
+            start = time.monotonic_ns()
+            ret = _orig(pad, buf)
+            dt = time.monotonic_ns() - start
+            with self._lock:
+                _t.n += 1
+                _t.total_ns += dt
+                _t.max_ns = max(_t.max_ns, dt)
+                if t0 is not None:
+                    _t.inter_n += 1
+                    _t.inter_total_ns += now - t0
+            return ret
+
+        el._chain_entry = timed_chain
+
+    def report(self) -> str:
+        lines = [f"{'element':<24}{'bufs':>7}{'proctime(us)':>14}"
+                 f"{'max(us)':>10}{'interlat(us)':>14}"]
+        for t in self.traces.values():
+            if t.n == 0 and t.inter_n == 0:
+                continue
+            lines.append(f"{t.name:<24}{t.n:>7}{t.proctime_us:>14.1f}"
+                         f"{t.max_ns / 1000:>10.1f}{t.interlatency_us:>14.1f}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        return {t.name: {"n": t.n, "proctime_us": t.proctime_us,
+                         "max_us": t.max_ns / 1000,
+                         "interlatency_us": t.interlatency_us}
+                for t in self.traces.values()}
+
+
+class device_trace:
+    """Context manager: jax.profiler trace around a pipeline run (view with
+    xprof/tensorboard). SURVEY §5 'TPU build: jax.profiler/xprof'."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        import jax
+
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        import jax
+
+        jax.profiler.stop_trace()

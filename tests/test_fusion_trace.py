@@ -1,0 +1,89 @@
+"""Graph fusion pass + pipeline tracer tests."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+
+def caps_of(dims, types, rate=30):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+def build(auto_fuse, data):
+    p = Pipeline()
+    p.auto_fuse = auto_fuse
+    src = p.add_new("appsrc", caps=caps_of("4:1", "uint8"), data=data)
+    t1 = p.add_new("tensor_transform", mode="arithmetic",
+                   option="typecast:float32,add:-127.5,div:127.5")
+    t2 = p.add_new("tensor_transform", mode="clamp", option="-0.5:0.5")
+    f = p.add_new("tensor_filter", model=lambda x: x * 2)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, t1, t2, f, sink)
+    p.run(timeout=60)
+    return p, sink
+
+
+class TestFusion:
+    def test_fused_matches_unfused(self):
+        data = [np.array([[0, 100, 127, 255]], np.uint8)]
+        p_fused, s_fused = build(True, data)
+        p_plain, s_plain = build(False, data)
+        assert p_fused._fused_count == 2
+        assert p_plain._fused_count == 0
+        np.testing.assert_allclose(s_fused.buffers[0].memories[0].host(),
+                                   s_plain.buffers[0].memories[0].host(),
+                                   rtol=1e-6)
+
+    def test_fused_transforms_forward_untouched(self):
+        data = [np.array([[1, 2, 3, 4]], np.uint8)]
+        p, sink = build(True, data)
+        t1 = p["tensor_transform0"] if "tensor_transform0" in p.elements else None
+        # find the transform elements generically
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        transforms = [e for e in p.elements.values()
+                      if isinstance(e, TensorTransform)]
+        assert all(t._fused for t in transforms)
+
+    def test_fusion_stops_at_branching(self):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("4:1", "uint8"),
+                        data=[np.ones((1, 4), np.uint8)])
+        t = p.add_new("tensor_transform", mode="typecast", option="float32")
+        tee = p.add_new("tee")
+        q1 = p.add_new("queue")
+        f = p.add_new("tensor_filter", model=lambda x: x + 1)
+        s1 = p.add_new("tensor_sink", store=True)
+        q2 = p.add_new("queue")
+        s2 = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, t, tee)
+        Pipeline.link(tee, q1, f, s1)
+        Pipeline.link(tee, q2, s2)
+        p.run(timeout=60)
+        # transform feeds a tee → must NOT be fused away
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        tr = next(e for e in p.elements.values() if isinstance(e, TensorTransform))
+        assert not tr._fused
+        np.testing.assert_array_equal(s1.buffers[0].memories[0].host(),
+                                      np.full((1, 4), 2.0, np.float32))
+
+
+class TestTracer:
+    def test_proctime_collection(self):
+        from nnstreamer_tpu.utils.trace import PipelineTracer
+
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=16, height=16, num_buffers=5)
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, sink)
+        tracer = PipelineTracer.attach(p)
+        p.run(timeout=30)
+        d = tracer.as_dict()
+        assert d[conv.name]["n"] == 5
+        assert d[conv.name]["proctime_us"] > 0
+        assert d[sink.name]["interlatency_us"] > 0
+        assert conv.name in tracer.report()

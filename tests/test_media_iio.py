@@ -1,0 +1,92 @@
+"""Media helper elements + IIO sensor source tests (reference
+unittest_src_iio fakes a sysfs tree the same way)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.graph import Pipeline
+
+
+class TestImagePath:
+    def test_imagefilesrc_pipeline(self, tmp_path):
+        from PIL import Image
+
+        for i in range(3):
+            arr = np.full((10, 12, 3), i * 40, np.uint8)
+            Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+        p = Pipeline()
+        src = p.add_new("imagefilesrc", location=str(tmp_path / "*.png"))
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers == 3
+        assert sink.buffers[1].memories[0].host().shape == (1, 10, 12, 3)
+        assert sink.buffers[1].memories[0].host()[0, 0, 0, 0] == 40
+
+    def test_imagedec(self, tmp_path):
+        from PIL import Image
+        import io
+
+        arr = np.full((6, 8, 3), 99, np.uint8)
+        bio = io.BytesIO()
+        Image.fromarray(arr).save(bio, format="PNG")
+        data = bio.getvalue()
+        path = tmp_path / "one.png"
+        path.write_bytes(data)
+        p = Pipeline()
+        src = p.add_new("filesrc", location=str(path), blocksize=1 << 20)
+        dec = p.add_new("imagedec")
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, conv, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host()[0], arr)
+
+    def test_videoscale_and_convert(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=20, height=10, num_buffers=1)
+        scale = p.add_new("videoscale", width=10, height=5)
+        conv = p.add_new("videoconvert", format="GRAY8")
+        tc = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, scale, conv, tc, sink)
+        p.run(timeout=30)
+        assert sink.buffers[0].memories[0].host().shape == (1, 5, 10, 1)
+
+
+class TestIIO:
+    def _fake_device(self, tmp_path, name="accel3d"):
+        dev = tmp_path / "iio:device0"
+        dev.mkdir()
+        (dev / "name").write_text(name + "\n")
+        (dev / "in_accel_x_raw").write_text("100\n")
+        (dev / "in_accel_y_raw").write_text("-50\n")
+        (dev / "in_accel_x_scale").write_text("0.5\n")
+        (dev / "in_accel_x_offset").write_text("10\n")
+        return tmp_path
+
+    def test_scan_and_convert(self, tmp_path):
+        base = self._fake_device(tmp_path)
+        p = Pipeline()
+        src = p.add_new("tensor_src_iio", base_dir=str(base), device="accel3d",
+                        frequency=100, num_buffers=3)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers == 3
+        vals = sink.buffers[0].memories[0].host()
+        assert vals.shape == (1, 2)
+        assert vals[0, 0] == pytest.approx((100 + 10) * 0.5)  # scale+offset
+        assert vals[0, 1] == pytest.approx(-50.0)
+
+    def test_missing_device_fails(self, tmp_path):
+        p = Pipeline()
+        src = p.add_new("tensor_src_iio", base_dir=str(tmp_path),
+                        device="nope", num_buffers=1)
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, sink)
+        from nnstreamer_tpu.graph import PipelineError
+
+        with pytest.raises((PipelineError, TimeoutError)):
+            p.run(timeout=5)

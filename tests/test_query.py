@@ -226,3 +226,61 @@ p.stop()
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+class TestGrpc:
+    def test_push_sink_to_server_src(self):
+        pytest.importorskip("grpc")
+        sp = Pipeline("grpc-server")
+        gsrc = sp.add_new("tensor_grpc_src", port=0, server=True)
+        ssink = sp.add_new("tensor_sink", store=True)
+        Pipeline.link(gsrc, ssink)
+        sp.start()
+        try:
+            time.sleep(0.3)
+            port = gsrc.bound_port
+            cp = Pipeline("grpc-client")
+            src = cp.add_new("appsrc", caps=caps_of("3:1", "float32"),
+                             data=[np.full((1, 3), i, np.float32)
+                                   for i in range(4)])
+            gsink = cp.add_new("tensor_grpc_sink", port=port, server=False)
+            Pipeline.link(src, gsink)
+            cp.run(timeout=30)
+            deadline = time.monotonic() + 10
+            while ssink.num_buffers < 4 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert ssink.num_buffers == 4
+            np.testing.assert_array_equal(
+                ssink.buffers[2].memories[0].host(),
+                np.full((1, 3), 2.0, np.float32))
+        finally:
+            sp.stop()
+
+
+class TestPubSub:
+    def test_mqtt_style_pubsub(self):
+        from nnstreamer_tpu.query.pubsub import PubSubBroker
+
+        broker = PubSubBroker(port=0).start()
+        try:
+            rp = Pipeline("subscriber")
+            msrc = rp.add_new("mqttsrc", port=broker.port, sub_topic="cam0")
+            rsink = rp.add_new("tensor_sink", store=True)
+            Pipeline.link(msrc, rsink)
+            rp.start()
+            time.sleep(0.3)
+            tp = Pipeline("publisher")
+            src = tp.add_new("appsrc", caps=caps_of("2:1", "float32"),
+                             data=[np.full((1, 2), i, np.float32)
+                                   for i in range(3)])
+            msink = tp.add_new("mqttsink", port=broker.port, pub_topic="cam0")
+            Pipeline.link(src, msink)
+            tp.run(timeout=30)
+            deadline = time.monotonic() + 10
+            while rsink.num_buffers < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            rp.stop()
+            assert rsink.num_buffers == 3
+            assert rsink.buffers[0].meta["mqtt_latency_ns"] >= 0
+        finally:
+            broker.stop()
