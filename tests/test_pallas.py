@@ -1,0 +1,41 @@
+"""Pallas kernel correctness (interpret mode on the CPU mesh; the same
+kernels compile for TPU via pallas_call)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.ops.pallas import preprocess as pp
+
+
+class TestNormalize:
+    def test_matches_reference(self):
+        import jax.numpy as jnp
+
+        x = np.random.default_rng(0).integers(0, 256, (2, 33, 47, 3)).astype(np.uint8)
+        out = pp.normalize_u8(jnp.asarray(x), interpret=True, out_dtype=jnp.float32)
+        ref = pp.normalize_u8_reference(jnp.asarray(x), 1 / 127.5, -1.0, jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+        assert out.shape == x.shape
+
+    def test_nonaligned_sizes(self):
+        import jax.numpy as jnp
+
+        for shape in [(1,), (7, 13), (129,), (31, 127)]:
+            x = np.ones(shape, np.uint8) * 200
+            out = pp.normalize_u8(jnp.asarray(x), interpret=True,
+                                  out_dtype=jnp.float32)
+            np.testing.assert_allclose(np.asarray(out),
+                                       (200 / 127.5 - 1.0) * np.ones(shape),
+                                       rtol=1e-6)
+
+
+class TestQuantize:
+    def test_roundtrip(self):
+        import jax.numpy as jnp
+
+        x = np.random.default_rng(1).uniform(-1, 1, (16, 130)).astype(np.float32)
+        q = pp.quantize_affine(jnp.asarray(x), scale=1 / 127.5, zero_point=128,
+                               interpret=True)
+        ref = pp.quantize_affine_reference(jnp.asarray(x), 1 / 127.5, 128)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(ref))
+        assert np.asarray(q).dtype == np.uint8
