@@ -1,0 +1,40 @@
+/* Example C custom filter: multiplies a 4:1 float32 tensor by 2.
+ *
+ * Build:  gcc -O2 -shared -fPIC -I.. scaler_filter.c -o libscaler_filter.so
+ * Use:    tensor_filter framework=custom model=libscaler_filter.so
+ */
+
+#include <stdlib.h>
+#include <string.h>
+#include "../nns_custom.h"
+
+static float factor = 2.0f;
+
+int nns_custom_init(const char *custom_prop) {
+  if (custom_prop && custom_prop[0]) {
+    /* custom="factor=3.5" */
+    const char *eq = strchr(custom_prop, '=');
+    if (eq) factor = (float)atof(eq + 1);
+  }
+  return 0;
+}
+
+int nns_custom_get_input_info(char *dims, char *types, int cap) {
+  strncpy(dims, "4:1", cap);
+  strncpy(types, "float32", cap);
+  return 0;
+}
+
+int nns_custom_get_output_info(char *dims, char *types, int cap) {
+  return nns_custom_get_input_info(dims, types, cap);
+}
+
+int nns_custom_invoke(int num_in, const NnsTensor *in, int num_out,
+                      NnsTensor *out) {
+  if (num_in < 1 || num_out < 1) return -1;
+  const float *src = (const float *)in[0].data;
+  float *dst = (float *)out[0].data;
+  unsigned long n = in[0].size / sizeof(float);
+  for (unsigned long i = 0; i < n; ++i) dst[i] = src[i] * factor;
+  return 0;
+}

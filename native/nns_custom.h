@@ -1,0 +1,51 @@
+/* nns_custom.h — C ABI for custom filter shared objects.
+ *
+ * Role equivalent of the reference's custom filter contract
+ * (gst/nnstreamer/include/tensor_filter_custom.h:46-143: a .so exporting a
+ * struct of callbacks), redesigned as a flat C ABI loadable via ctypes:
+ *
+ *   tensor_filter framework=custom model=libmyfilter.so
+ *
+ * A custom filter .so exports these symbols:
+ *
+ *   int  nns_custom_get_input_info(char *dims, char *types, int cap);
+ *   int  nns_custom_get_output_info(char *dims, char *types, int cap);
+ *       — write dimension/type strings ("4:1", "float32"; comma-separated
+ *         for multi-tensor). Return 0 on success.
+ *
+ *   int  nns_custom_invoke(int num_in, const NnsTensor *in,
+ *                          int num_out, NnsTensor *out);
+ *       — read in[i].data, write out[i].data (buffers pre-allocated to the
+ *         declared output sizes). Return 0 on success, >0 to drop the
+ *         frame (soft failure), <0 on error.
+ *
+ *   (optional) int nns_custom_init(const char *custom_prop);
+ *   (optional) void nns_custom_exit(void);
+ */
+
+#ifndef NNS_CUSTOM_H
+#define NNS_CUSTOM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  void *data;        /* element buffer (contiguous, little-endian) */
+  uint64_t size;     /* bytes */
+} NnsTensor;
+
+typedef int (*nns_custom_info_fn)(char *dims, char *types, int cap);
+typedef int (*nns_custom_invoke_fn)(int num_in, const NnsTensor *in,
+                                    int num_out, NnsTensor *out);
+typedef int (*nns_custom_init_fn)(const char *custom_prop);
+typedef void (*nns_custom_exit_fn)(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NNS_CUSTOM_H */

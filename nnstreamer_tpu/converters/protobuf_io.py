@@ -1,0 +1,72 @@
+"""Protobuf tensor serialization: decoder mode=protobuf + converter subplugin.
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-protobuf.cc +
+tensor_converter/tensor_converter_protobuf.cc (+ extra/nnstreamer_protobuf.cc)
+— tensors ↔ protobuf messages for interop links. Schema:
+converters/proto/tensors.proto (compiled with protoc).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorDType, TensorInfo, TensorsConfig, TensorsInfo
+from ..decoders.base import Decoder, register_decoder
+from . import register_converter
+from .proto import tensors_pb2
+
+
+def frame_to_proto(buf: Buffer) -> bytes:
+    msg = tensors_pb2.TensorFrame()
+    if buf.pts is not None:
+        msg.pts_ns = buf.pts
+    if buf.duration is not None:
+        msg.duration_ns = buf.duration
+    if buf.offset is not None:
+        msg.offset = buf.offset
+    for m in buf.memories:
+        t = msg.tensors.add()
+        t.dtype = str(m.info.dtype)
+        t.dims.extend(m.info.dims)
+        if m.info.name:
+            t.name = m.info.name
+        t.data = m.tobytes()
+    return msg.SerializeToString()
+
+
+def proto_to_frame(data: bytes) -> Buffer:
+    msg = tensors_pb2.TensorFrame()
+    msg.ParseFromString(bytes(data))
+    mems = []
+    for t in msg.tensors:
+        info = TensorInfo(tuple(t.dims), TensorDType.parse(t.dtype),
+                          t.name or None)
+        mems.append(TensorMemory.from_bytes(t.data, info))
+    return Buffer(mems, pts=msg.pts_ns or None,
+                  duration=msg.duration_ns or None,
+                  offset=msg.offset or None)
+
+
+@register_decoder
+class ProtobufDecoder(Decoder):
+    """tensors → application/octet-stream protobuf frames."""
+
+    MODE = "protobuf"
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("application/octet-stream")
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        blob = np.frombuffer(frame_to_proto(buf), np.uint8).copy()
+        return buf.with_memories([TensorMemory(blob)])
+
+
+def _protobuf_converter(buf: Buffer, props) -> tuple:
+    data = b"".join(m.tobytes() for m in buf.memories)
+    frame = proto_to_frame(data)
+    cfg = TensorsConfig(TensorsInfo(tuple(m.info for m in frame.memories)))
+    return frame.memories, cfg
+
+
+register_converter("protobuf", _protobuf_converter)

@@ -14,6 +14,8 @@ def _ensure_builtin_decoders() -> None:
     from . import bounding_box  # noqa: F401
     from . import image_segment  # noqa: F401
     from . import pose  # noqa: F401
+    from . import font  # noqa: F401
+    from ..converters import protobuf_io  # noqa: F401
 
 
 _ensure_builtin_decoders()

@@ -17,13 +17,13 @@ import numpy as np
 
 from ..core.buffer import Buffer, TensorMemory
 from ..core.types import Caps, TensorFormat
-from ..graph.element import Element, FlowReturn, Pad, register_element
-from ..graph.events import Event, EventType
-from ..graph.sync import CollectPads, SyncPolicy
+from ..graph.element import FlowReturn, Pad, register_element
+from ..graph.sync import SyncPolicy
+from .collect_base import CollectingElement
 
 
 @register_element
-class TensorCrop(Element):
+class TensorCrop(CollectingElement):
     ELEMENT_NAME = "tensor_crop"
 
     def __init__(self, name: Optional[str] = None, **props: Any):
@@ -33,14 +33,11 @@ class TensorCrop(Element):
         self.info_pad = self.add_sink_pad("info", template=Caps.any_tensors())
         self.add_src_pad(template=Caps("other/tensors",
                                        {"format": TensorFormat.FLEXIBLE}))
-        self._collect: Optional[CollectPads] = None
         self._caps_sent = False
-        self._eos_sent = False
 
     def start(self) -> None:
-        self._collect = CollectPads(["raw", "info"], SyncPolicy.SLOWEST)
+        self._make_collect(SyncPolicy.SLOWEST)
         self._caps_sent = False
-        self._eos_sent = False
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
@@ -48,10 +45,6 @@ class TensorCrop(Element):
             if not self._caps_sent:
                 self._caps_sent = True
                 self.send_caps_all(Caps.tensors(format=TensorFormat.FLEXIBLE))
-
-    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        sets = self._collect.push(pad.name, buf)
-        return self._emit(sets)
 
     def _emit(self, sets) -> FlowReturn:
         ret = FlowReturn.OK
@@ -74,19 +67,3 @@ class TensorCrop(Element):
             if r is FlowReturn.ERROR:
                 ret = r
         return ret
-
-    def _event_entry(self, pad: Pad, event: Event) -> None:
-        if event.type is EventType.EOS and self._collect is not None:
-            self._emit(self._collect.set_eos(pad.name))
-            with self._lock:
-                pad.eos = True
-                self._eos_pads.add(pad.name)
-                should = (self._collect.exhausted or
-                          len(self._eos_pads) >= len(self.sink_pads)) \
-                    and not self._eos_sent
-                if should:
-                    self._eos_sent = True
-            if should:
-                self.push_event_all(Event.eos())
-            return
-        super()._event_entry(pad, event)

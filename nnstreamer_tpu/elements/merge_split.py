@@ -15,14 +15,14 @@ import numpy as np
 from ..core.buffer import Buffer, TensorMemory
 from ..core.types import Caps, TensorInfo, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
-from ..graph.events import Event, EventType
-from ..graph.sync import CollectPads, SyncPolicy
+from ..graph.sync import SyncPolicy
+from .collect_base import CollectingElement
 
 _AXIS_NAMES = {"first": 0, "second": 1, "third": 2, "fourth": 3}
 
 
 @register_element
-class TensorMerge(Element):
+class TensorMerge(CollectingElement):
     """N tensors → one bigger tensor, concatenated along a reference-order
     dim (0=innermost). Device-resident concat via jnp when inputs are on
     device."""
@@ -36,10 +36,8 @@ class TensorMerge(Element):
         self.sync_option: str = ""
         super().__init__(name, **props)
         self.add_src_pad(template=Caps.any_tensors())
-        self._collect: Optional[CollectPads] = None
         self._pad_caps: Dict[str, Caps] = {}
         self._caps_sent = False
-        self._eos_sent = False
         self._out_config: Optional[TensorsConfig] = None
 
     @property
@@ -51,11 +49,9 @@ class TensorMerge(Element):
     def start(self) -> None:
         if self.mode != "linear":
             raise ValueError(f"tensor_merge: unsupported mode {self.mode!r}")
-        self._collect = CollectPads([p.name for p in self.sink_pads],
-                                    SyncPolicy.parse(self.sync_mode))
+        self._make_collect(SyncPolicy.parse(self.sync_mode))
         self._pad_caps.clear()
         self._caps_sent = False
-        self._eos_sent = False
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
@@ -87,10 +83,6 @@ class TensorMerge(Element):
                 TensorsInfo.of(TensorInfo(tuple(out_dims), base.dtype)), rate)
             self.send_caps_all(Caps.tensors(self._out_config))
 
-    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        sets = self._collect.push(pad.name, buf)
-        return self._emit(sets)
-
     def _emit(self, sets) -> FlowReturn:
         import jax.numpy as jnp
 
@@ -109,22 +101,6 @@ class TensorMerge(Element):
             if r is FlowReturn.ERROR:
                 ret = r
         return ret
-
-    def _event_entry(self, pad: Pad, event: Event) -> None:
-        if event.type is EventType.EOS and self._collect is not None:
-            self._emit(self._collect.set_eos(pad.name))
-            with self._lock:
-                pad.eos = True
-                self._eos_pads.add(pad.name)
-                should = (self._collect.exhausted or
-                          len(self._eos_pads) >= len(self.sink_pads)) \
-                    and not self._eos_sent
-                if should:
-                    self._eos_sent = True
-            if should:
-                self.push_event_all(Event.eos())
-            return
-        super()._event_entry(pad, event)
 
 
 @register_element

@@ -17,12 +17,12 @@ from typing import Any, Dict, List, Optional
 from ..core.buffer import Buffer
 from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
-from ..graph.events import Event
-from ..graph.sync import CollectPads, SyncPolicy
+from ..graph.sync import SyncPolicy
+from .collect_base import CollectingElement
 
 
 @register_element
-class TensorMux(Element):
+class TensorMux(CollectingElement):
     ELEMENT_NAME = "tensor_mux"
 
     def __init__(self, name: Optional[str] = None, **props: Any):
@@ -30,16 +30,8 @@ class TensorMux(Element):
         self.sync_option: str = ""
         super().__init__(name, **props)
         self.add_src_pad(template=Caps.any_tensors())
-        self._collect: Optional[CollectPads] = None
         self._pad_caps: Dict[str, Caps] = {}
         self._caps_sent = False
-        self._eos_sent = False
-
-    def request_sink_pad(self) -> Pad:
-        pad = super().request_sink_pad()
-        if self._collect is not None:
-            self._collect.add_key(pad.name)
-        return pad
 
     def start(self) -> None:
         policy = SyncPolicy.parse(self.sync_mode)
@@ -50,11 +42,9 @@ class TensorMux(Element):
             base_key = f"sink_{int(parts[0])}"
             if len(parts) > 1:
                 base_dur = int(parts[1])
-        self._collect = CollectPads([p.name for p in self.sink_pads], policy,
-                                    base_key=base_key, base_duration_ns=base_dur)
+        self._make_collect(policy, base_key=base_key, base_duration_ns=base_dur)
         self._pad_caps.clear()
         self._caps_sent = False
-        self._eos_sent = False
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
@@ -72,10 +62,6 @@ class TensorMux(Element):
                 self._out_config = out
                 self.send_caps_all(Caps.tensors(out))
 
-    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        sets = self._collect.push(pad.name, buf)
-        return self._emit(sets)
-
     def _emit(self, sets) -> FlowReturn:
         ret = FlowReturn.OK
         for frame, pts in sets:
@@ -87,24 +73,6 @@ class TensorMux(Element):
             if r is FlowReturn.ERROR:
                 ret = r
         return ret
-
-    def _event_entry(self, pad: Pad, event: Event) -> None:
-        from ..graph.events import EventType
-
-        if event.type is EventType.EOS and self._collect is not None:
-            self._emit(self._collect.set_eos(pad.name))
-            with self._lock:
-                pad.eos = True
-                self._eos_pads.add(pad.name)
-                should_forward = (self._collect.exhausted or
-                                  len(self._eos_pads) >= len(self.sink_pads)) \
-                    and not self._eos_sent
-                if should_forward:
-                    self._eos_sent = True
-            if should_forward:
-                self.push_event_all(Event.eos())
-            return
-        super()._event_entry(pad, event)
 
 
 @register_element

@@ -20,6 +20,7 @@ def _ensure_builtin_filters() -> None:
     _loaded = True
     from . import xla  # noqa: F401
     from . import custom  # noqa: F401
+    from . import c_custom  # noqa: F401
     try:
         from . import torch_backend  # noqa: F401
     except ImportError:  # torch genuinely absent

@@ -1,0 +1,103 @@
+"""C custom-filter ABI, protobuf serialization, font decoder tests."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+
+def caps_of(dims, types, rate=0):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+class TestCCustomFilter:
+    @pytest.fixture(scope="class")
+    def scaler_so(self, tmp_path_factory):
+        out = tmp_path_factory.mktemp("cfilter") / "libscaler_filter.so"
+        try:
+            subprocess.run(
+                ["gcc", "-O2", "-shared", "-fPIC", "-I", "native",
+                 "native/examples/scaler_filter.c", "-o", str(out)],
+                check=True, capture_output=True, cwd="/root/repo")
+        except (subprocess.SubprocessError, FileNotFoundError):
+            pytest.skip("no C toolchain")
+        return str(out)
+
+    def test_so_filter_pipeline(self, scaler_so):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                        data=[np.full((1, 4), 3.0, np.float32)])
+        f = p.add_new("tensor_filter", framework="custom", model=scaler_so)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, f, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((1, 4), 6.0, np.float32))
+
+    def test_custom_prop(self, scaler_so):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                        data=[np.ones((1, 4), np.float32)])
+        f = p.add_new("tensor_filter", framework="custom", model=scaler_so,
+                      custom="factor=5")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, f, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(),
+                                      np.full((1, 4), 5.0, np.float32))
+
+    def test_auto_detect_so_extension(self, scaler_so):
+        from nnstreamer_tpu.filters import detect_framework
+
+        assert detect_framework(scaler_so) == "custom"
+
+    def test_missing_so_fails(self):
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(FileNotFoundError):
+            SingleShot(model="/nonexistent/lib.so", framework="custom")
+
+
+class TestProtobuf:
+    def test_roundtrip_functions(self):
+        from nnstreamer_tpu.converters.protobuf_io import (frame_to_proto,
+                                                           proto_to_frame)
+
+        buf = Buffer.of(np.arange(6, dtype=np.int32).reshape(2, 3),
+                        np.ones(4, np.float32), pts=77, offset=5)
+        blob = frame_to_proto(buf)
+        out = proto_to_frame(blob)
+        assert out.pts == 77 and out.offset == 5
+        np.testing.assert_array_equal(out.memories[0].host(),
+                                      buf.memories[0].host())
+
+    def test_decoder_converter_pipeline(self):
+        """tensors → protobuf blob → back to tensors through elements."""
+        p = Pipeline()
+        arr = np.arange(8, dtype=np.float32)
+        src = p.add_new("appsrc", caps=caps_of("8", "float32"), data=[arr])
+        enc = p.add_new("tensor_decoder", mode="protobuf")
+        dec = p.add_new("tensor_converter", mode="custom:protobuf")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, enc, dec, sink)
+        p.run(timeout=30)
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host(), arr)
+
+
+class TestFont:
+    def test_renders_label_text(self):
+        p = Pipeline()
+        text = np.frombuffer(b"orange", np.uint8).copy()
+        src = p.add_new("appsrc", caps=caps_of("6", "uint8"), data=[text])
+        dec = p.add_new("tensor_decoder", mode="font", option1="64:16")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        b = sink.buffers[0]
+        assert b.meta["text"] == "orange"
+        canvas = b.memories[0].host()
+        assert canvas.shape == (16, 64, 4)
+        assert canvas[..., 3].max() == 255  # glyph pixels drawn
