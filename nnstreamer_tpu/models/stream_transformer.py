@@ -1,0 +1,119 @@
+"""Streaming transformer encoder — long-sequence workloads over the mesh.
+
+New capability beyond the reference (SURVEY §5 lists sequence parallelism
+as absent there): a transformer filter for token/feature streams (e.g.
+tensor_aggregator windows of per-frame embeddings) whose attention can run
+**sequence-parallel** across a device mesh via parallel.ring — ring
+attention (ppermute ring over ICI) or Ulysses all-to-all — so context
+length scales with the number of chips.
+
+Zoo entry: ``zoo://stream_transformer?layers=2&dim=128&heads=8&seq=256``
+(+``sp=ring|a2a`` with a mesh for sharded runs via ``make_sp_apply``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None  # (q,k,v)->o, [B,H,L,hd]
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        B, L, D = h.shape
+        hd = D // self.heads
+        qkv = nn.Dense(3 * D, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda t: t.reshape(B, L, self.heads, hd).transpose(0, 2, 1, 3)
+        q, k, v = reshape(q), reshape(k), reshape(v)
+        if self.attention_fn is not None:
+            o = self.attention_fn(q, k, v)
+        else:
+            from ..parallel.ring import reference_attention
+
+            o = reference_attention(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, L, D).astype(self.dtype)
+        x = x + nn.Dense(D, dtype=self.dtype)(o)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(D, dtype=self.dtype)(h)
+        return x
+
+
+class StreamTransformer(nn.Module):
+    layers: int = 2
+    dim: int = 128
+    heads: int = 8
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if x.shape[-1] != self.dim:
+            x = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.layers):
+            x = Block(self.dim, self.heads, dtype=self.dtype,
+                      attention_fn=self.attention_fn, name=f"block_{i}")(x)
+        return nn.LayerNorm(dtype=self.dtype)(x).astype(jnp.float32)
+
+
+def make_stream_transformer(layers: str = "2", dim: str = "128",
+                            heads: str = "8", seq: str = "256",
+                            in_dim: str = "", batch: str = "1",
+                            seed: str = "0", dtype: str = "bfloat16",
+                            **_: Any) -> ModelBundle:
+    L, D, B = int(seq), int(dim), int(batch)
+    d_in = int(in_dim) if in_dim else D
+    model = StreamTransformer(
+        layers=int(layers), dim=D, heads=int(heads),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    params = model.init(jax.random.PRNGKey(int(seed)),
+                        jnp.zeros((B, L, d_in), jnp.float32))
+    return ModelBundle(
+        "stream_transformer", lambda p, x: model.apply(p, x), params=params,
+        in_info=TensorsInfo.from_strings(f"{d_in}:{L}:{B}", "float32"),
+        out_info=TensorsInfo.from_strings(f"{D}:{L}:{B}", "float32"),
+        metadata={"layers": int(layers), "dim": D, "heads": int(heads),
+                  "seq": L})
+
+
+def make_sp_apply(bundle: ModelBundle, mesh, mode: str = "ring",
+                  axis_name: str = "sp", causal: bool = False):
+    """Rebuild the bundle's apply with sequence-parallel attention over
+    ``mesh``: returns (apply_fn, params). Inputs/outputs are globally-shaped;
+    shard the L axis with PartitionSpec(None, axis_name, None)."""
+    from ..parallel.ring import a2a_attention, ring_attention
+
+    meta = bundle.metadata
+    if mode == "ring":
+        attn = lambda q, k, v: ring_attention(q, k, v, mesh, axis_name,
+                                              causal=causal)
+    elif mode in ("a2a", "ulysses"):
+        attn = lambda q, k, v: a2a_attention(q, k, v, mesh, axis_name)
+    else:
+        raise ValueError(f"unknown sp mode {mode!r}")
+    model = StreamTransformer(layers=meta["layers"], dim=meta["dim"],
+                              heads=meta["heads"], dtype=jnp.float32,
+                              attention_fn=attn)
+    return (lambda p, x: model.apply(p, x)), bundle.params
+
+
+register_model("stream_transformer", make_stream_transformer)

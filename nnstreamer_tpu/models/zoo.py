@@ -98,3 +98,4 @@ def _ensure_builtin_models() -> None:
     from . import deeplab  # noqa: F401
     from . import posenet  # noqa: F401
     from . import lstm  # noqa: F401
+    from . import stream_transformer  # noqa: F401

@@ -117,3 +117,51 @@ def test_lstm_repo_loop_with_zoo_cell():
     y1 = out_sink.buffers[1].memories[0].host()
     assert np.all(np.isfinite(y0)) and np.all(np.isfinite(y1))
     assert not np.array_equal(y0, y1)
+
+
+class TestStreamTransformer:
+    def test_single_device_forward(self):
+        bundle = get_model("zoo://stream_transformer?layers=1&dim=32&heads=4"
+                           "&seq=16&dtype=float32")
+        import jax
+
+        out = jax.jit(bundle.fn())(np.zeros((1, 16, 32), np.float32))
+        assert out.shape == (1, 16, 32)
+
+    def test_sequence_parallel_matches_single_device(self):
+        import jax
+        import jax.numpy as jnp
+        from nnstreamer_tpu.models.stream_transformer import make_sp_apply
+        from nnstreamer_tpu.parallel import make_mesh
+
+        bundle = get_model("zoo://stream_transformer?layers=1&dim=32&heads=8"
+                           "&seq=64&dtype=float32")
+        x = np.random.default_rng(0).normal(size=(1, 64, 32)).astype(np.float32)
+        ref = np.asarray(bundle.fn()(jnp.asarray(x)))
+        mesh = make_mesh({"sp": 8})
+        for mode in ("ring", "a2a"):
+            apply_sp, params = make_sp_apply(bundle, mesh, mode=mode)
+            out = np.asarray(apply_sp(params, jnp.asarray(x)))
+            np.testing.assert_allclose(out, ref, rtol=5e-3, atol=5e-4)
+
+    def test_in_pipeline_with_aggregator(self):
+        """Streaming use: per-frame embeddings → aggregator window →
+        transformer filter (the long-context streaming pattern)."""
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        bundle = get_model("zoo://stream_transformer?layers=1&dim=16&heads=2"
+                           "&seq=4&dtype=float32")
+        p = Pipeline()
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("16:1:1", "float32"), 30)),
+                        data=[np.full((1, 1, 16), i, np.float32)
+                              for i in range(8)])
+        agg = p.add_new("tensor_aggregator", frames_out=4, frames_dim=1)
+        filt = p.add_new("tensor_filter", model=bundle)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, agg, filt, sink)
+        p.run(timeout=120)
+        assert sink.num_buffers == 2
+        assert sink.buffers[0].memories[0].host().shape == (1, 4, 16)
