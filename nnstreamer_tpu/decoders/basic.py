@@ -55,7 +55,25 @@ class ImageLabeling(Decoder):
         return Caps("text/x-raw", {"format": "utf8"})
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
-        scores = buf.memories[0].host().reshape(-1)
+        m = buf.memories[0]
+        if m.is_device:
+            # argmax on device: D2H transfers 2 scalars, not the logits
+            import jax
+            import jax.numpy as jnp
+
+            if not hasattr(self, "_argmax"):
+                self._argmax = jax.jit(
+                    lambda x: (jnp.argmax(x.reshape(-1)),
+                               jnp.max(x.reshape(-1))))
+            idx_d, score_d = self._argmax(m.device())
+            idx, top = int(idx_d), float(score_d)
+            label = self.labels[idx] if idx < len(self.labels) else str(idx)
+            out = buf.with_memories(
+                [TensorMemory(np.frombuffer(label.encode("utf-8"),
+                                            np.uint8).copy())])
+            out.meta.update(label=label, label_index=idx, label_score=top)
+            return out
+        scores = m.host().reshape(-1)
         idx = int(np.argmax(scores))
         label = self.labels[idx] if idx < len(self.labels) else str(idx)
         out = buf.with_memories(

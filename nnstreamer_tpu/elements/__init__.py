@@ -15,6 +15,7 @@ from . import cond  # noqa: F401
 from . import rate  # noqa: F401
 from . import repo  # noqa: F401
 from . import sparse  # noqa: F401
+from . import trainer  # noqa: F401
 from ..query import server as _query_server  # noqa: F401
 from ..query import client as _query_client  # noqa: F401
 from ..query import pubsub as _query_pubsub  # noqa: F401

@@ -1,0 +1,137 @@
+"""tensor_trainer — online fine-tuning as a stream element.
+
+New capability (the reference defers training to the out-of-repo nntrainer
+project; its registry reserves the TRAINER subplugin type,
+nnstreamer_subplugin.h:40-51). A training step runs *inside the pipeline*:
+buffers carry (x, y) tensor pairs (mux'd streams or a 2-tensor frame), each
+frame executes one optimizer step on device, and the updated params are
+exposed for the serving path — so a deployed stream can adapt without
+leaving the TPU.
+
+Props: model (zoo:// or bundle), learning_rate, optimizer (sgd/adam/adamw),
+loss (xent/mse), checkpoint_path (saved on EOS), report_every (bus messages
+with running loss). Output: passthrough of the input frame with
+``loss`` in buffer meta (so a sink can monitor), letting trainers sit on a
+tee branch next to the serving filter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps
+from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.events import MessageType
+
+
+@register_element
+class TensorTrainer(Element):
+    ELEMENT_NAME = "tensor_trainer"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.model: Any = None
+        self.learning_rate = 1e-3
+        self.optimizer = "adam"
+        self.loss = "xent"
+        self.checkpoint_path: Optional[str] = None
+        self.report_every = 0  # frames; 0 = no bus reports
+        super().__init__(name, **props)
+        self.add_sink_pad(template=Caps.any_tensors())
+        self.add_src_pad(template=Caps.any_tensors())
+        self._step = None
+        self._params = None
+        self._opt_state = None
+        self._n = 0
+        self.last_loss: Optional[float] = None
+        self.losses: List[float] = []
+
+    def start(self) -> None:
+        import jax
+        import optax
+
+        from ..filters.xla import resolve_model
+
+        bundle = resolve_model(self.model, {})
+        apply_fn = bundle.apply if bundle.params is not None else \
+            (lambda p, *xs: bundle.apply(*xs))
+        opt = {"sgd": optax.sgd(self.learning_rate, momentum=0.9),
+               "adam": optax.adam(self.learning_rate),
+               "adamw": optax.adamw(self.learning_rate)}.get(self.optimizer)
+        if opt is None:
+            raise ValueError(f"tensor_trainer: unknown optimizer {self.optimizer!r}")
+
+        if self.loss == "xent":
+            def loss_fn(logits, y):
+                import jax.numpy as jnp
+
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                yi = y.astype(jnp.int32).reshape(-1)
+                return -jnp.mean(
+                    jnp.take_along_axis(logp, yi[:, None], axis=-1))
+        elif self.loss == "mse":
+            def loss_fn(pred, y):
+                import jax.numpy as jnp
+
+                return jnp.mean((pred.astype(jnp.float32) -
+                                 y.astype(jnp.float32)) ** 2)
+        else:
+            raise ValueError(f"tensor_trainer: unknown loss {self.loss!r}")
+
+        self._params = bundle.params
+        self._opt_state = opt.init(self._params)
+        self._bundle = bundle
+
+        def step(params, opt_state, x, y):
+            def objective(p):
+                return loss_fn(apply_fn(p, x), y)
+
+            lv, grads = jax.value_and_grad(objective)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, lv
+
+        self._step = jax.jit(step)
+        self._n = 0
+        self.losses.clear()
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        if buf.num_tensors < 2:
+            raise ValueError("tensor_trainer expects (x, y) tensor frames "
+                             "(use tensor_mux)")
+        x = buf.memories[0].device()
+        y = buf.memories[1].device()
+        self._params, self._opt_state, lv = self._step(
+            self._params, self._opt_state, x, y)
+        self._n += 1
+        self.last_loss = float(lv)
+        self.losses.append(self.last_loss)
+        if self.report_every and self._n % int(self.report_every) == 0:
+            self.post_message(MessageType.ELEMENT,
+                              {"trainer": self.name, "frames": self._n,
+                               "loss": self.last_loss})
+        out = buf.with_memories(buf.memories, config=buf.config)
+        out.meta["loss"] = self.last_loss
+        return self.push(out)
+
+    @property
+    def params(self):
+        """Current (trained) params — hand to a serving filter via
+        update_model for hot deployment of the fine-tuned weights."""
+        return self._params
+
+    def trained_bundle(self):
+        from dataclasses import replace
+
+        return replace(self._bundle, params=self._params)
+
+    def on_eos(self) -> None:
+        if self.checkpoint_path and self._params is not None:
+            from ..utils import checkpoints
+
+            checkpoints.save_variables(self.checkpoint_path, self._params)
+            self.post_message(MessageType.ELEMENT,
+                              {"trainer": self.name,
+                               "checkpoint": self.checkpoint_path})

@@ -51,7 +51,7 @@ class TensorQueryClient(Element):
         self._caps_out_sent = False
 
     # -- connection ---------------------------------------------------------- #
-    def _resolve_endpoint(self) -> tuple:
+    def _resolve_endpoints(self) -> list:
         if self.operation:
             from .hybrid import discover
 
@@ -60,19 +60,28 @@ class TensorQueryClient(Element):
             if not nodes:
                 raise ConnectionError(
                     f"hybrid discovery: no servers for {self.operation!r}")
-            return nodes[0]
-        return (self.host, int(self.port))
+            return nodes  # failover across all advertised nodes
+        return [(self.host, int(self.port))]
 
     def _connect(self) -> socket.socket:
-        host, port = self._resolve_endpoint()
-        sock = socket.create_connection((host, port), timeout=self.timeout_s)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        send_message(sock, Cmd.INFO_REQ, {"caps": str(self.sink_pad.caps or "")})
-        cmd, meta, _ = recv_message(sock)
-        if cmd is not Cmd.INFO_APPROVE:
-            sock.close()
-            raise ConnectionError(f"server denied connection: {meta}")
-        return sock
+        last: Optional[Exception] = None
+        for host, port in self._resolve_endpoints():
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=self.timeout_s)
+            except OSError as e:
+                last = e
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            send_message(sock, Cmd.INFO_REQ,
+                         {"caps": str(self.sink_pad.caps or "")})
+            cmd, meta, _ = recv_message(sock)
+            if cmd is not Cmd.INFO_APPROVE:
+                sock.close()
+                last = ConnectionError(f"server denied connection: {meta}")
+                continue
+            return sock
+        raise ConnectionError(f"no reachable server: {last}")
 
     def _ensure_conn(self) -> socket.socket:
         if self._sock is None:
