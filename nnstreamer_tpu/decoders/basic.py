@@ -67,20 +67,14 @@ class ImageLabeling(Decoder):
                                jnp.max(x.reshape(-1))))
             idx_d, score_d = self._argmax(m.device())
             idx, top = int(idx_d), float(score_d)
-            label = self.labels[idx] if idx < len(self.labels) else str(idx)
-            out = buf.with_memories(
-                [TensorMemory(np.frombuffer(label.encode("utf-8"),
-                                            np.uint8).copy())])
-            out.meta.update(label=label, label_index=idx, label_score=top)
-            return out
-        scores = m.host().reshape(-1)
-        idx = int(np.argmax(scores))
+        else:
+            scores = m.host().reshape(-1)
+            idx = int(np.argmax(scores))
+            top = float(scores[idx])
         label = self.labels[idx] if idx < len(self.labels) else str(idx)
         out = buf.with_memories(
             [TensorMemory(np.frombuffer(label.encode("utf-8"), np.uint8).copy())])
-        out.meta["label"] = label
-        out.meta["label_index"] = idx
-        out.meta["label_score"] = float(scores[idx])
+        out.meta.update(label=label, label_index=idx, label_score=top)
         return out
 
 

@@ -10,6 +10,8 @@ Reference: ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c (modes
     class ids [M], scores [M], count [1] (tflite detection postprocess).
   * ``ov-person-detection`` / ``ov-face-detection`` — OpenVINO layout
     rows [image_id, label, conf, x0, y0, x1, y1].
+  * ``tflite-ssd`` / ``tf-ssd`` — backward-compat OLDNAME aliases for the
+    first two modes (tensordec-boundingbox.c:129-131, 151-159).
 
 Options: option2=label file, option3=priors file[:threshold[:iou]],
 option4="W:H" output video size, option5="W:H" model input size.
@@ -72,7 +74,7 @@ class BoundingBox(Decoder):
         opt3 = self.option(3)
         if opt3:
             parts = opt3.split(":")
-            if self.box_mode == "mobilenet-ssd":
+            if self.box_mode in ("mobilenet-ssd", "tflite-ssd"):
                 self.priors = load_box_priors(parts[0])
                 extra = parts[1:]
             else:
@@ -138,9 +140,10 @@ class BoundingBox(Decoder):
         return np.asarray(out, np.float32).reshape(-1, 6)
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
-        if self.box_mode == "mobilenet-ssd":
+        if self.box_mode in ("mobilenet-ssd", "tflite-ssd"):
             objs = self._objects_mobilenet_ssd(buf)
-        elif self.box_mode in ("mobilenet-ssd-postprocess", "tflite-ssd-postprocess"):
+        elif self.box_mode in ("mobilenet-ssd-postprocess", "tf-ssd",
+                               "tflite-ssd-postprocess"):
             objs = self._objects_postprocess(buf)
         elif self.box_mode.startswith("ov-"):
             objs = self._objects_ov(buf)

@@ -17,7 +17,8 @@ tee branch next to the serving filter.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import collections
+from typing import Any, Optional
 
 import numpy as np
 
@@ -46,7 +47,8 @@ class TensorTrainer(Element):
         self._opt_state = None
         self._n = 0
         self.last_loss: Optional[float] = None
-        self.losses: List[float] = []
+        # bounded: perpetual online-training streams must not grow memory
+        self.losses: "collections.deque[float]" = collections.deque(maxlen=1024)
 
     def start(self) -> None:
         import jax

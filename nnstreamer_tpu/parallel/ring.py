@@ -30,6 +30,22 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map (0.8+) with fallback to the experimental module;
+    replication checking off (we manage specs explicitly)."""
+    if hasattr(jax, "shard_map"):
+        for flag in ({"check_vma": False}, {"check_rep": False}, {}):
+            try:
+                return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, **flag)
+            except TypeError:
+                continue
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
 def _online_block(q, k, v, m_prev, l_prev, o_prev, mask=None):
     """One flash-attention accumulation step against a K/V block."""
     d = q.shape[-1]
@@ -83,14 +99,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
     q/k/v: [batch, heads, seq, head_dim] (global views; seq must divide by
     the axis size). Returns same-shape output, sequence-sharded."""
-    from jax.experimental.shard_map import shard_map
-
     spec = P(None, None, axis_name, None)
-    fn = shard_map(
+    fn = _shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
@@ -118,16 +131,13 @@ def _a2a_attention_local(q, k, v, axis_name: str):
 def a2a_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                   axis_name: str = "sp") -> jax.Array:
     """Ulysses-style sequence-parallel attention (all_to_all re-sharding)."""
-    from jax.experimental.shard_map import shard_map
-
     n = mesh.shape[axis_name]
     if q.shape[1] % n != 0:
         raise ValueError(f"heads {q.shape[1]} not divisible by "
                          f"{axis_name} axis size {n}")
     spec = P(None, None, axis_name, None)
-    fn = shard_map(functools.partial(_a2a_attention_local, axis_name=axis_name),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                   check_rep=False)
+    fn = _shard_map(functools.partial(_a2a_attention_local, axis_name=axis_name),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

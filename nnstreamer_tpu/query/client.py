@@ -66,21 +66,26 @@ class TensorQueryClient(Element):
     def _connect(self) -> socket.socket:
         last: Optional[Exception] = None
         for host, port in self._resolve_endpoints():
+            sock: Optional[socket.socket] = None
+            # any failure on this node — TCP connect, a reset mid-handshake,
+            # a protocol violation, or a deny — moves on to the next node
             try:
                 sock = socket.create_connection((host, port),
                                                 timeout=self.timeout_s)
-            except OSError as e:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                send_message(sock, Cmd.INFO_REQ,
+                             {"caps": str(self.sink_pad.caps or "")})
+                cmd, meta, _ = recv_message(sock)
+                if cmd is not Cmd.INFO_APPROVE:
+                    raise ConnectionError(f"server denied connection: {meta}")
+                return sock
+            except (OSError, QueryProtocolError, ConnectionError) as e:
                 last = e
-                continue
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            send_message(sock, Cmd.INFO_REQ,
-                         {"caps": str(self.sink_pad.caps or "")})
-            cmd, meta, _ = recv_message(sock)
-            if cmd is not Cmd.INFO_APPROVE:
-                sock.close()
-                last = ConnectionError(f"server denied connection: {meta}")
-                continue
-            return sock
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
         raise ConnectionError(f"no reachable server: {last}")
 
     def _ensure_conn(self) -> socket.socket:

@@ -80,6 +80,75 @@ class TestIIO:
         assert vals[0, 0] == pytest.approx((100 + 10) * 0.5)  # scale+offset
         assert vals[0, 1] == pytest.approx(-50.0)
 
+    def _fake_buffered_device(self, tmp_path, n_scans=4):
+        """Fake sysfs tree with scan_elements + packed binary dev node:
+        accel_x le:s12/16>>4 (idx 0), accel_y le:u8/8 (idx 1),
+        timestamp le:s64/64 (idx 2, 8-byte aligned) → 16-byte scans."""
+        import struct
+
+        base = self._fake_device(tmp_path)
+        dev = base / "iio:device0"
+        scan = dev / "scan_elements"
+        scan.mkdir()
+        for ch, typ, idx in [("accel_x", "le:s12/16>>4", 0),
+                             ("accel_y", "le:u8/8>>0", 1),
+                             ("timestamp", "le:s64/64>>0", 2)]:
+            (scan / f"in_{ch}_type").write_text(typ + "\n")
+            (scan / f"in_{ch}_index").write_text(f"{idx}\n")
+            (scan / f"in_{ch}_en").write_text("1\n")
+        (dev / "buffer").mkdir()
+        (dev / "buffer" / "enable").write_text("0\n")
+        (dev / "buffer" / "length").write_text("0\n")
+        raw = b""
+        for i in range(n_scans):
+            x12 = (-5 - i) & 0xFFF        # 12-bit signed, stored <<4
+            raw += struct.pack("<H", x12 << 4) + struct.pack("B", 200 + i)
+            raw += b"\x00" * 5            # pad to 8-byte ts alignment
+            raw += struct.pack("<q", 1000 + i)
+        devnode = tmp_path / "devnode.bin"
+        devnode.write_bytes(raw)
+        return base, devnode
+
+    def test_buffered_capture(self, tmp_path):
+        base, devnode = self._fake_buffered_device(tmp_path)
+        p = Pipeline()
+        src = p.add_new("tensor_src_iio", base_dir=str(base), device="accel3d",
+                        mode="buffer", dev_path=str(devnode),
+                        frames_per_buffer=2, frequency=100)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers == 2  # 4 scans / 2 frames-per-buffer
+        vals = sink.buffers[0].memories[0].host()
+        assert vals.shape == (2, 3)
+        # x: (-5 + offset 10) * scale 0.5; y unscaled; ts passthrough
+        assert vals[0, 0] == pytest.approx((-5 + 10) * 0.5)
+        assert vals[1, 0] == pytest.approx((-6 + 10) * 0.5)
+        assert vals[0, 1] == pytest.approx(200.0)
+        assert vals[0, 2] == pytest.approx(1000.0)
+        # buffer was enabled during capture, disabled on stop
+        assert (base / "iio:device0" / "buffer" / "enable").read_text() == "0"
+
+    def test_scan_type_parse_and_layout(self):
+        from nnstreamer_tpu.elements.iio import (ScanChannel, parse_scan_type,
+                                                 scan_layout)
+
+        assert parse_scan_type("le:s12/16>>4") == (False, True, 12, 16, 4)
+        assert parse_scan_type("be:u10/16>>6") == (True, False, 10, 16, 6)
+        with pytest.raises(ValueError):
+            parse_scan_type("nonsense")
+        chans = [ScanChannel("ts", 2, False, True, 64, 64, 0),
+                 ScanChannel("x", 0, False, True, 12, 16, 4),
+                 ScanChannel("y", 1, False, False, 8, 8, 0)]
+        assert scan_layout(chans) == 16
+        by_name = {c.name: c for c in chans}
+        assert by_name["x"].byte_offset == 0
+        assert by_name["y"].byte_offset == 2
+        assert by_name["ts"].byte_offset == 8
+        # big-endian signed extraction with shift
+        ch = ScanChannel("v", 0, True, True, 12, 16, 4)
+        assert ch.extract((0xFFB0).to_bytes(2, "big")) == pytest.approx(-5.0)
+
     def test_missing_device_fails(self, tmp_path):
         p = Pipeline()
         src = p.add_new("tensor_src_iio", base_dir=str(tmp_path),

@@ -38,8 +38,9 @@ class TestTrainerElement:
         sink = p.add_new("tensor_sink", store=True)
         Pipeline.link(src, tr, sink)
         p.run(timeout=60)
-        assert len(tr.losses) == 20
-        assert np.mean(tr.losses[-5:]) < np.mean(tr.losses[:5])
+        losses = list(tr.losses)  # bounded deque
+        assert len(losses) == 20
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
         assert sink.buffers[0].meta["loss"] > 0
         assert ckpt.exists()
         # bus received progress reports
