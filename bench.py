@@ -39,7 +39,10 @@ def build_pipeline(frames, labels_path, sync: bool):
     conv = p.add_new("tensor_converter")
     filt = p.add_new("tensor_filter", framework="xla-tpu", model=MODEL,
                      custom="sync=true" if sync else "")
-    dec = p.add_new("tensor_decoder", mode="image_labeling", option1=labels_path)
+    # pipelined decode: keep D2H readbacks in flight (readback RTT, not TPU
+    # compute, bounds streaming FPS — see tensor_decoder async_depth)
+    dec = p.add_new("tensor_decoder", mode="image_labeling", option1=labels_path,
+                    async_depth=4 if sync else 16)
     sink = p.add_new("tensor_sink")
     Pipeline.link(src, conv, filt, dec, sink)
     return p, filt, sink

@@ -39,9 +39,10 @@ class TensorMemory:
     the refcount dance).
     """
 
-    __slots__ = ("_host", "_device", "info")
+    __slots__ = ("_host", "_device", "_prefetched", "info")
 
     def __init__(self, array: Any, info: Optional[TensorInfo] = None):
+        self._prefetched = False
         if _is_jax_array(array):
             self._device = array
             self._host = None
@@ -61,6 +62,25 @@ class TensorMemory:
         if self._host is None:
             self._host = np.asarray(self._device)
         return self._host
+
+    def prefetch(self) -> None:
+        """Start an async D2H copy so a later ``host()`` is (nearly) free.
+
+        TPU-first pipelining: device→host readback has RTT latency; issuing
+        the copy at dispatch time and materializing a few frames later keeps
+        many transfers in flight (see tensor_decoder ``async_depth``).
+        No-op for host tensors or if already materialized.
+        """
+        if self._host is None and self._device is not None and not self._prefetched:
+            try:
+                self._device.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                return  # no async copy issued: keep device-side decode paths
+            self._prefetched = True
+
+    @property
+    def prefetched(self) -> bool:
+        return self._prefetched
 
     def device(self, device: Any = None) -> Any:
         """Device jax.Array (H2D transfer on first access for host tensors)."""

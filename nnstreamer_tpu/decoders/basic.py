@@ -56,17 +56,20 @@ class ImageLabeling(Decoder):
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         m = buf.memories[0]
-        if m.is_device:
+        if m.is_device and not m.prefetched:
             # argmax on device: D2H transfers 2 scalars, not the logits
             import jax
             import jax.numpy as jnp
 
             if not hasattr(self, "_argmax"):
+                # one stacked fetch: each D2H readback pays full RTT, so
+                # (argmax, max) come back as a single 2-element array
                 self._argmax = jax.jit(
-                    lambda x: (jnp.argmax(x.reshape(-1)),
-                               jnp.max(x.reshape(-1))))
-            idx_d, score_d = self._argmax(m.device())
-            idx, top = int(idx_d), float(score_d)
+                    lambda x: jnp.stack(
+                        [jnp.argmax(x.reshape(-1)).astype(jnp.float32),
+                         jnp.max(x.reshape(-1)).astype(jnp.float32)]))
+            pair = np.asarray(self._argmax(m.device()))
+            idx, top = int(pair[0]), float(pair[1])
         else:
             scores = m.host().reshape(-1)
             idx = int(np.argmax(scores))

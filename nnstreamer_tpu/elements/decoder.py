@@ -6,6 +6,7 @@ Reference: gst/nnstreamer/elements/gsttensordec.c (subplugin dispatch by
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Dict, Optional
 
 from ..core.buffer import Buffer
@@ -16,12 +17,21 @@ from ..graph.element import Element, FlowReturn, Pad, register_element
 
 @register_element
 class TensorDecoder(Element):
+    """``async_depth=N`` (default 0 = reference-exact synchronous decode)
+    pipelines the tensor→media boundary: each arriving buffer's device
+    memories start an async D2H copy immediately, and the actual decode of
+    a buffer happens N frames later, when its readback has landed. Output
+    order/count is unchanged; pending frames flush on EOS. This keeps up to
+    N device→host transfers in flight — on TPU the readback RTT is the
+    streaming bottleneck, not the compute."""
+
     ELEMENT_NAME = "tensor_decoder"
 
     MAX_OPTIONS = 9
 
     def __init__(self, name: Optional[str] = None, **props: Any):
         self.mode: Optional[str] = None
+        self.async_depth: int = 0
         for i in range(1, self.MAX_OPTIONS + 1):
             setattr(self, f"option{i}", None)
         super().__init__(name, **props)
@@ -29,6 +39,7 @@ class TensorDecoder(Element):
         self.add_src_pad()
         self._decoder: Optional[Decoder] = None
         self._config: Optional[TensorsConfig] = None
+        self._pending: deque = deque()
 
     def _options_dict(self) -> Dict[int, str]:
         return {i: str(getattr(self, f"option{i}"))
@@ -54,5 +65,23 @@ class TensorDecoder(Element):
         self.send_caps_all(self._decoder.out_caps(self._config))
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        out = self._decoder.decode(buf, self._config)
-        return self.push(out)
+        depth = int(self.async_depth or 0)
+        if depth <= 0:
+            return self.push(self._decoder.decode(buf, self._config))
+        for m in buf.memories:
+            m.prefetch()
+        self._pending.append((buf, self._config))
+        ret: Optional[FlowReturn] = None
+        while len(self._pending) > depth:
+            old_buf, old_cfg = self._pending.popleft()
+            ret = self.push(self._decoder.decode(old_buf, old_cfg))
+        return ret
+
+    def on_eos(self) -> None:
+        while self._pending:
+            old_buf, old_cfg = self._pending.popleft()
+            self.push(self._decoder.decode(old_buf, old_cfg))
+
+    def stop(self) -> None:
+        self._pending.clear()
+        super().stop()

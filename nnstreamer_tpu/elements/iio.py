@@ -166,6 +166,10 @@ class TensorSrcIIO(SourceElement):
                 with open(base + "_index") as f:
                     index = int(f.read().strip())
             except (OSError, ValueError):
+                # unparseable channel MUST be disabled, or the kernel's scan
+                # layout includes it while ours doesn't and every
+                # higher-index channel decodes from the wrong bytes
+                self._write_sysfs(base + "_en", "0")
                 continue
             en_path = base + "_en"
             if want is None and os.path.isfile(en_path):
@@ -275,8 +279,13 @@ class TensorSrcIIO(SourceElement):
                 if not r:
                     continue  # no data yet; re-check stop flag
                 chunk = os.read(self._dev_fd, need - len(data))
+            except BlockingIOError:
+                continue  # spurious select wakeup (EAGAIN): not EOS
             except (OSError, ValueError):
-                return None  # fd closed under us during teardown
+                if self._stop_flag.is_set() or self._dev_fd is None:
+                    return None  # fd closed under us during teardown
+                self.post_error(f"iio read failed on {self.device!r}")
+                return None
             if not chunk:
                 return None  # device EOF (fake files in tests)
             data += chunk

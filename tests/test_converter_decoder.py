@@ -139,6 +139,26 @@ class TestImageLabeling:
         assert bytes(b.memories[0].host().tobytes()) == b"orange"
         assert sink.sink_pad.caps.media_type == "text/x-raw"
 
+    def test_async_depth_preserves_order_and_flushes(self, tmp_path):
+        """async_depth pipelines decode; output count/order must match the
+        synchronous path, with pending frames flushed at EOS."""
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"l{i}" for i in range(8)))
+        n = 11  # > depth, not a multiple of it
+        data = [np.eye(8, dtype=np.float32)[i % 8][None, :] for i in range(n)]
+        p = Pipeline()
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("8:1", "float32"), 0)),
+                        data=data)
+        dec = p.add_new("tensor_decoder", mode="image_labeling",
+                        option1=str(labels), async_depth=4)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, sink)
+        p.run(timeout=30)
+        assert [b.meta["label"] for b in sink.buffers] == \
+            [f"l{i % 8}" for i in range(n)]
+
     def test_missing_label_file_fails(self):
         from nnstreamer_tpu.graph import PipelineError
 
