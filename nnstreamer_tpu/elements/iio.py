@@ -31,9 +31,12 @@ from typing import Any, List, Optional
 import numpy as np
 
 from ..core.buffer import Buffer, NS_PER_SEC
+from ..core.log import logger
 from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import register_element
 from ..graph.pipeline import SourceElement
+
+log = logger("iio")
 
 _DEFAULT_SYSFS = "/sys/bus/iio/devices"
 
@@ -142,6 +145,7 @@ class TensorSrcIIO(SourceElement):
         return path if os.path.exists(path) else None
 
     def _setup_buffered(self, want) -> bool:
+        self._buffered_fail = "no scan_elements or dev node"
         scan_dir = os.path.join(self._dev_dir, "scan_elements")
         if not os.path.isdir(scan_dir):
             return False
@@ -165,11 +169,19 @@ class TensorSrcIIO(SourceElement):
                     be, sg, bits, storage, shift = parse_scan_type(f.read())
                 with open(base + "_index") as f:
                     index = int(f.read().strip())
-            except (OSError, ValueError):
+            except (OSError, ValueError) as e:
                 # unparseable channel MUST be disabled, or the kernel's scan
                 # layout includes it while ours doesn't and every
                 # higher-index channel decodes from the wrong bytes
                 self._write_sysfs(base + "_en", "0")
+                if want is not None and ch_name in want:
+                    # explicitly requested: don't silently shrink the tensor;
+                    # fail buffered setup (mode=auto falls back to sysfs
+                    # polling, which serves the channel without scan decode)
+                    self._buffered_fail = (f"requested channel {ch_name!r} "
+                                           f"unusable for scan decode ({e})")
+                    log.warning("iio: %s", self._buffered_fail)
+                    return False
                 continue
             en_path = base + "_en"
             if want is None and os.path.isfile(en_path):
@@ -182,6 +194,7 @@ class TensorSrcIIO(SourceElement):
                 scale=self._read_float(f"in_{ch_name}_scale", 1.0),
                 offset=self._read_float(f"in_{ch_name}_offset", 0.0)))
         if not chans:
+            self._buffered_fail = "no usable scan channels"
             return False
         chans.sort(key=lambda c: c.index)
         self._scan_channels = chans
@@ -194,9 +207,10 @@ class TensorSrcIIO(SourceElement):
             # non-blocking + select in the read loop so stop() can always
             # interrupt a reader waiting on a slow sensor
             self._dev_fd = os.open(dev, os.O_RDONLY | os.O_NONBLOCK)
-        except OSError:  # dev node exists but unreadable (e.g. EACCES)
+        except OSError as e:  # dev node exists but unreadable (e.g. EACCES)
             self._write_sysfs(os.path.join(buf_dir, "enable"), "0")
             self._scan_channels = []
+            self._buffered_fail = f"cannot open {dev}: {e}"
             return False
         return True
 
@@ -219,7 +233,7 @@ class TensorSrcIIO(SourceElement):
             if not self._buffered and self.mode == "buffer":
                 raise ValueError(
                     f"IIO buffer capture unavailable for {self._dev_dir} "
-                    "(no scan_elements or dev node)")
+                    f"({self._buffered_fail})")
         if not self._buffered:
             self._setup_poll(want)
         self._n = 0

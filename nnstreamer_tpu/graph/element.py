@@ -194,7 +194,13 @@ class Element:
                 self._eos_pads.add(pad.name)
                 all_eos = len(self._eos_pads) >= len(self.sink_pads)
             if all_eos:
-                self.on_eos()
+                try:
+                    self.on_eos()
+                except Exception as e:  # noqa: BLE001 — any flush failure
+                    # must surface on the bus, and EOS must still propagate,
+                    # or downstream never terminates and run() hits timeout
+                    self.post_error(f"eos flush error: {type(e).__name__}: {e}",
+                                    exc=e)
                 if self.is_sink:
                     self.post_message(MessageType.ELEMENT, {"event": "eos"})
                     if self.pipeline is not None:
