@@ -93,8 +93,11 @@ def main() -> None:
     # overstate steady-state throughput)
     ts = np.asarray(arrivals[n_warmup:len(arrivals) - DECODE_DEPTH])
     win = min(64, len(ts) - 1)
-    spans = ts[win:] - ts[:-win]
-    fps = win / spans.min() if len(spans) and spans.min() > 0 else float("nan")
+    if win > 0:
+        spans = ts[win:] - ts[:-win]
+        fps = win / spans.min() if spans.min() > 0 else float("nan")
+    else:
+        fps = float("nan")
 
     import jax
 

@@ -1,0 +1,188 @@
+"""FlexBuffers / FlatBuffers tensor serialization (decoder + converter pairs).
+
+Reference: ext/nnstreamer/tensor_decoder/tensordec-flexbuf.cc and
+tensordec-flatbuf.cc + tensor_converter/tensor_converter_flexbuf.cc and
+tensor_converter_flatbuf.cc — tensors ↔ (Flex|Flat)Buffers blobs for interop
+links. The reference compiles a schema with flatc; here the FlatBuffers frame
+table is built/read with the runtime ``flatbuffers.Builder``/``Table`` API
+directly (no codegen step), and FlexBuffers uses the schema-less API.
+
+Frame layout (both formats carry the same fields):
+  rate_n/rate_d  — stream framerate
+  tensors[]      — name, dtype (string), dims (int vector, innermost-first
+                   like TensorInfo.dims), data (byte blob)
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import List, Tuple
+
+import flatbuffers  # gates registration: decoders/__init__ skips on ImportError
+import numpy as np
+from flatbuffers import flexbuffers
+from flatbuffers import number_types as N
+
+from ..core.buffer import Buffer, TensorMemory
+from ..core.types import Caps, TensorDType, TensorInfo, TensorsConfig, TensorsInfo
+from ..decoders.base import Decoder, register_decoder
+from . import register_converter
+
+
+# ---------------------------------------------------------------------------- #
+# FlexBuffers (schema-less)
+# ---------------------------------------------------------------------------- #
+
+def frame_to_flexbuf(buf: Buffer, config: TensorsConfig = None) -> bytes:
+    rate = config.rate if config is not None and config.rate else Fraction(0, 1)
+    b = flexbuffers.Builder()
+    with b.Map():
+        b.Key("rate_n"); b.Int(rate.numerator)
+        b.Key("rate_d"); b.Int(rate.denominator)
+        b.Key("tensors")
+        with b.Vector():
+            for m in buf.memories:
+                with b.Map():
+                    b.Key("name"); b.String(m.info.name or "")
+                    b.Key("dtype"); b.String(str(m.info.dtype))
+                    b.Key("dims")
+                    with b.TypedVector():
+                        for d in m.info.dims:
+                            b.Int(int(d))
+                    b.Key("data"); b.Blob(m.tobytes())
+    return bytes(b.Finish())
+
+
+def flexbuf_to_frame(data: bytes) -> Tuple[Buffer, Fraction]:
+    root = flexbuffers.GetRoot(bytearray(data)).AsMap
+    rate = Fraction(root["rate_n"].AsInt, max(root["rate_d"].AsInt, 1))
+    mems: List[TensorMemory] = []
+    for t in root["tensors"].AsVector:
+        tm = t.AsMap
+        info = TensorInfo(
+            tuple(e.AsInt for e in tm["dims"].AsTypedVector),
+            TensorDType.parse(tm["dtype"].AsString),
+            tm["name"].AsString or None)
+        mems.append(TensorMemory.from_bytes(bytes(tm["data"].AsBlob), info))
+    return Buffer(mems), rate
+
+
+# ---------------------------------------------------------------------------- #
+# FlatBuffers (schema'd: Frame{rate_n, rate_d, tensors:[Tensor]},
+#              Tensor{name, dtype, dims:[int32], data:[ubyte]})
+# ---------------------------------------------------------------------------- #
+
+_SLOT = lambda i: 4 + 2 * i  # vtable offset of field slot i
+
+
+def frame_to_flatbuf(buf: Buffer, config: TensorsConfig = None) -> bytes:
+    rate = config.rate if config is not None and config.rate else Fraction(0, 1)
+    b = flatbuffers.Builder(1024)
+    tensor_offs = []
+    for m in buf.memories:
+        name = b.CreateString(m.info.name or "")
+        dtype = b.CreateString(str(m.info.dtype))
+        data = b.CreateByteVector(m.tobytes())
+        dims = m.info.dims
+        b.StartVector(4, len(dims), 4)
+        for d in reversed(dims):
+            b.PrependInt32(int(d))
+        dims_off = b.EndVector()
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(0, name, 0)
+        b.PrependUOffsetTRelativeSlot(1, dtype, 0)
+        b.PrependUOffsetTRelativeSlot(2, dims_off, 0)
+        b.PrependUOffsetTRelativeSlot(3, data, 0)
+        tensor_offs.append(b.EndObject())
+    b.StartVector(4, len(tensor_offs), 4)
+    for off in reversed(tensor_offs):
+        b.PrependUOffsetTRelative(off)
+    tvec = b.EndVector()
+    b.StartObject(3)
+    b.PrependInt32Slot(0, rate.numerator, 0)
+    b.PrependInt32Slot(1, rate.denominator, 0)
+    b.PrependUOffsetTRelativeSlot(2, tvec, 0)
+    b.Finish(b.EndObject())
+    return bytes(b.Output())
+
+
+def flatbuf_to_frame(data: bytes) -> Tuple[Buffer, Fraction]:
+    raw = bytearray(data)
+    root = flatbuffers.table.Table(
+        raw, flatbuffers.encode.Get(N.UOffsetTFlags.packer_type, raw, 0))
+
+    def i32(tab, slot, default=0):
+        o = tab.Offset(_SLOT(slot))
+        return tab.Get(N.Int32Flags, o + tab.Pos) if o else default
+
+    rate = Fraction(i32(root, 0), max(i32(root, 1), 1))
+    mems: List[TensorMemory] = []
+    o = root.Offset(_SLOT(2))
+    n = root.VectorLen(o) if o else 0
+    for i in range(n):
+        t = flatbuffers.table.Table(raw, root.Indirect(root.Vector(o) + 4 * i))
+        no = t.Offset(_SLOT(0))
+        name = t.String(no + t.Pos).decode() if no else ""
+        do = t.Offset(_SLOT(1))
+        dtype = t.String(do + t.Pos).decode() if do else "uint8"
+        so = t.Offset(_SLOT(2))
+        dims = tuple(t.Get(N.Int32Flags, t.Vector(so) + 4 * j)
+                     for j in range(t.VectorLen(so))) if so else ()
+        bo = t.Offset(_SLOT(3))
+        if bo:
+            start, ln = t.Vector(bo), t.VectorLen(bo)
+            payload = bytes(raw[start:start + ln])
+        else:
+            payload = b""
+        info = TensorInfo(dims, TensorDType.parse(dtype), name or None)
+        if len(payload) != info.size_bytes:
+            raise ValueError(
+                f"flatbuf tensor {i}: {len(payload)} payload bytes for "
+                f"{info.dim_string}:{info.dtype} ({info.size_bytes} expected)")
+        mems.append(TensorMemory.from_bytes(payload, info))
+    return Buffer(mems), rate
+
+
+# ---------------------------------------------------------------------------- #
+# element plumbing: decoder modes + converter subplugins
+# ---------------------------------------------------------------------------- #
+
+class _SerializeDecoder(Decoder):
+    ENCODE = None  # staticmethod set by subclass
+
+    def out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps("application/octet-stream")
+
+    def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        blob = np.frombuffer(type(self).ENCODE(buf, config), np.uint8).copy()
+        return buf.with_memories([TensorMemory(blob)])
+
+
+@register_decoder
+class FlexBufDecoder(_SerializeDecoder):
+    """tensors → FlexBuffers blobs (tensordec-flexbuf.cc analog)."""
+
+    MODE = "flexbuf"
+    ENCODE = staticmethod(frame_to_flexbuf)
+
+
+@register_decoder
+class FlatBufDecoder(_SerializeDecoder):
+    """tensors → FlatBuffers frames (tensordec-flatbuf.cc analog)."""
+
+    MODE = "flatbuf"
+    ENCODE = staticmethod(frame_to_flatbuf)
+
+
+def _make_converter(parse):
+    def convert(buf: Buffer, props) -> tuple:
+        data = b"".join(m.tobytes() for m in buf.memories)
+        frame, rate = parse(data)
+        cfg = TensorsConfig(TensorsInfo(tuple(m.info for m in frame.memories)),
+                            rate)
+        return frame.memories, cfg
+    return convert
+
+
+register_converter("flexbuf", _make_converter(flexbuf_to_frame))
+register_converter("flatbuf", _make_converter(flatbuf_to_frame))

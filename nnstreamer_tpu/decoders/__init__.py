@@ -16,6 +16,10 @@ def _ensure_builtin_decoders() -> None:
     from . import pose  # noqa: F401
     from . import font  # noqa: F401
     from ..converters import protobuf_io  # noqa: F401
+    try:
+        from ..converters import fb_io  # noqa: F401
+    except ImportError:  # flatbuffers runtime not installed
+        pass
 
 
 _ensure_builtin_decoders()

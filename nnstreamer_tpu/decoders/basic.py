@@ -83,11 +83,12 @@ class ImageLabeling(Decoder):
 
 @register_decoder
 class FlexBuf(Decoder):
-    """tensors → self-describing flex blobs (tensordec-flexbuf.cc analog,
-    using our 128-byte meta header wire format)."""
+    """tensors → self-describing flex blobs using our native 128-byte meta
+    header wire format (the query/edge links' framing). For reference-style
+    FlexBuffers/FlatBuffers interop blobs use mode=flexbuf / mode=flatbuf
+    (converters/fb_io.py)."""
 
-    MODE = "flexbuf"
-    ALIASES = ("flatbuf",)
+    MODE = "flex"
 
     def out_caps(self, config: TensorsConfig) -> Caps:
         return Caps("application/octet-stream")

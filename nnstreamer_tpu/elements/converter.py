@@ -69,12 +69,15 @@ class TensorConverter(Element):
         self._media = caps.media_type
         self._pending.clear()
         fpt = int(self.frames_per_tensor)
-        if self.mode and self.mode.startswith("custom"):
-            name = self.mode.split(":", 1)[1] if ":" in self.mode else ""
+        if self.mode and self.mode not in ("auto",):
+            # "custom:<name>" or a registered converter subplugin name
+            # (protobuf/flexbuf/flatbuf/... — reference external converters)
+            name = self.mode.split(":", 1)[1] if ":" in self.mode else self.mode
             self._custom = get_subplugin(SubpluginType.CONVERTER, name)
             if self._custom is None:
-                raise ValueError(f"tensor_converter: no custom converter {name!r}")
-            self._out_config = None  # custom decides per-buffer
+                raise ValueError(f"tensor_converter: no converter subplugin "
+                                 f"{name!r} (mode={self.mode!r})")
+            self._out_config = None  # subplugin decides per-buffer
             return
 
         rate = caps.get("framerate", Fraction(0, 1))

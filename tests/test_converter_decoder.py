@@ -2,6 +2,7 @@
 unittest_converter/unittest_decoder + SSAT decoder groups)."""
 
 import numpy as np
+from fractions import Fraction
 import pytest
 
 from nnstreamer_tpu.core import (
@@ -316,7 +317,7 @@ class TestFlexBuf:
                         caps=Caps.tensors(TensorsConfig(
                             TensorsInfo.from_strings("3:2", "int16"), 0)),
                         data=[arr])
-        dec = p.add_new("tensor_decoder", mode="flexbuf")
+        dec = p.add_new("tensor_decoder", mode="flex")
         sink = p.add_new("tensor_sink", store=True)
         Pipeline.link(src, dec, sink)
         p.run(timeout=30)
@@ -325,3 +326,42 @@ class TestFlexBuf:
         out = np.frombuffer(payload[:meta.info.size_bytes],
                             np.int16).reshape(2, 3)
         np.testing.assert_array_equal(out, arr)
+
+    @pytest.mark.parametrize("fmt", ["flexbuf", "flatbuf"])
+    def test_fb_roundtrip_through_elements(self, fmt):
+        pytest.importorskip("flatbuffers")
+        """tensors → (Flex|Flat)Buffers blob → back, preserving dtype/shape/
+        name and framerate (reference flexbuf/flatbuf subplugin pair)."""
+        arrs = [np.arange(6, dtype=np.int16).reshape(2, 3),
+                np.linspace(0, 1, 4, dtype=np.float32).reshape(1, 4)]
+        cfg = TensorsConfig(TensorsInfo.from_strings("3:2,4:1", "int16,float32"),
+                            Fraction(30, 1))
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=Caps.tensors(cfg), data=[arrs])
+        enc = p.add_new("tensor_decoder", mode=fmt)
+        dec = p.add_new("tensor_converter", mode=fmt)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, enc, dec, sink)
+        p.run(timeout=30)
+        out = sink.buffers[0]
+        assert len(out.memories) == 2
+        np.testing.assert_array_equal(out.memories[0].host(), arrs[0])
+        np.testing.assert_array_equal(out.memories[1].host(), arrs[1])
+        assert out.memories[1].info.dtype.np_dtype == np.float32
+        assert sink.sink_pad.caps.to_config().rate == Fraction(30, 1)
+
+    def test_flexbuf_blob_is_real_flexbuffers(self):
+        """The flexbuf wire format must parse with the stock FlexBuffers
+        runtime (interop, not a bespoke framing)."""
+        pytest.importorskip("flatbuffers")
+        from flatbuffers import flexbuffers
+
+        from nnstreamer_tpu.converters.fb_io import frame_to_flexbuf
+        from nnstreamer_tpu.core.buffer import Buffer
+
+        arr = np.arange(4, dtype=np.uint8)
+        blob = frame_to_flexbuf(Buffer.of(arr))
+        root = flexbuffers.GetRoot(bytearray(blob)).AsMap
+        t = root["tensors"].AsVector[0].AsMap
+        assert t["dtype"].AsString == "uint8"
+        assert bytes(t["data"].AsBlob) == arr.tobytes()
