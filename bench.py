@@ -58,6 +58,72 @@ def _video_caps():
                                 "framerate": Fraction(0, 1)})
 
 
+def _windowed_fps(arrivals, n_warmup: int, tail: int) -> float:
+    ts = np.asarray(arrivals[n_warmup:len(arrivals) - tail])
+    win = min(64, len(ts) - 1)
+    if win <= 0:
+        return float("nan")
+    spans = ts[win:] - ts[:-win]
+    return win / spans.min() if spans.min() > 0 else float("nan")
+
+
+def _pipeline_fps(model_spec: str, size: int, dec_mode: str, dec_opts: dict,
+                  n_frames: int = 96, n_warmup: int = 16) -> float:
+    """Steady-state FPS of a videotestsrc → converter → filter → decoder
+    pipeline (BASELINE.md 'numbers to produce' configs)."""
+    from nnstreamer_tpu.graph import Pipeline
+
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=size, height=size,
+                    num_buffers=n_warmup + n_frames, pattern="random")
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=model_spec)
+    dec = p.add_new("tensor_decoder", mode=dec_mode, async_depth=DECODE_DEPTH,
+                    **dec_opts)
+    sink = p.add_new("tensor_sink")
+    arrivals = []
+    sink.new_data = lambda buf: arrivals.append(time.monotonic())
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=600)
+    return _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
+
+
+def _extra_benches(tmpdir: str) -> dict:
+    """SSD/DeepLab/PoseNet pipeline FPS (reference model sizes)."""
+    import traceback
+
+    from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+
+    priors = os.path.join(tmpdir, "box_priors.txt")
+    write_box_priors(priors, size=300)
+    labels91 = os.path.join(tmpdir, "coco.txt")
+    with open(labels91, "w") as f:
+        f.write("\n".join(f"c{i}" for i in range(91)))
+    configs = {
+        "ssd_mobilenet_300_fps": (
+            "zoo://ssd_mobilenet_v2?size=300&num_classes=91", 300,
+            "bounding_box",
+            dict(option1="mobilenet-ssd", option2=labels91, option3=priors,
+                 option4="300:300", option5="300:300")),
+        "deeplab_v3_257_fps": (
+            "zoo://deeplab_v3?size=257&num_classes=21", 257,
+            "image_segment", dict(option1="tflite-deeplab")),
+        "posenet_257_fps": (
+            "zoo://posenet?size=257", 257,
+            "pose_estimation",
+            dict(option1="514:514", option2="257:257",
+                 option4="heatmap-offset")),
+    }
+    out = {}
+    for key, (spec, size, mode, opts) in configs.items():
+        try:
+            out[key] = round(_pipeline_fps(spec, size, mode, opts), 2)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            out[key] = None
+    return out
+
+
 def main() -> None:
     n_warmup, n_frames = 16, int(os.environ.get("BENCH_FRAMES", "256"))
     rng = np.random.default_rng(0)
@@ -91,13 +157,7 @@ def main() -> None:
     # drop warmup head and the EOS flush tail (the decoder's pending frames
     # drain back-to-back at EOS — a window overlapping that burst would
     # overstate steady-state throughput)
-    ts = np.asarray(arrivals[n_warmup:len(arrivals) - DECODE_DEPTH])
-    win = min(64, len(ts) - 1)
-    if win > 0:
-        spans = ts[win:] - ts[:-win]
-        fps = win / spans.min() if spans.min() > 0 else float("nan")
-    else:
-        fps = float("nan")
+    fps = _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
 
     import jax
 
@@ -110,6 +170,16 @@ def main() -> None:
         "frames": n_frames,
         "device": str(jax.devices()[0]),
     }
+    if os.environ.get("BENCH_EXTRAS", "1") != "0":
+        try:
+            import tempfile as _tf
+
+            with _tf.TemporaryDirectory() as td:
+                result.update(_extra_benches(td))
+        except Exception:  # never lose the headline measurement
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
     print(json.dumps(result))
 
 

@@ -18,8 +18,30 @@ def _ensure_builtin_decoders() -> None:
     from ..converters import protobuf_io  # noqa: F401
     try:
         from ..converters import fb_io  # noqa: F401
-    except ImportError:  # flatbuffers runtime not installed
-        pass
+    except ImportError:
+        # flatbuffers runtime absent: register stubs so mode=flexbuf/flatbuf
+        # fails with the actionable cause, not "unknown mode"
+        from ..converters import register_converter
+
+        class _MissingFlatbuffers(Decoder):
+            MODE = "flexbuf"
+            ALIASES = ("flatbuf",)
+
+            def init(self, options) -> None:
+                raise ImportError(
+                    "mode=flexbuf/flatbuf needs the 'flatbuffers' package "
+                    "(pip install flatbuffers); the dependency-free native "
+                    "framing is available as mode=flex")
+
+        register_decoder(_MissingFlatbuffers)
+
+        def _missing(buf, props):
+            raise ImportError(
+                "converter mode=flexbuf/flatbuf needs the 'flatbuffers' "
+                "package (pip install flatbuffers)")
+
+        register_converter("flexbuf", _missing)
+        register_converter("flatbuf", _missing)
 
 
 _ensure_builtin_decoders()

@@ -40,6 +40,24 @@ class Decoder:
         (video frame array / utf-8 text bytes / serialized blob)."""
         raise NotImplementedError
 
+    # -- pipelined decode (tensor_decoder async_depth) ----------------------- #
+    def submit(self, buf: Buffer, config: TensorsConfig) -> Any:
+        """Start this frame's async work — device-side reductions and D2H
+        copies — and return a token ``complete()`` turns into the decoded
+        buffer N frames later. Default: prefetch the raw memories and run
+        ``decode`` on host at completion. Decoders whose host output is much
+        smaller than their tensor input (argmax masks, box lists) override
+        this to dispatch the reduction on device and prefetch only the
+        small result — on TPU the device→host link, not compute, bounds
+        streaming FPS."""
+        for m in buf.memories:
+            m.prefetch()
+        return buf
+
+    def complete(self, token: Any, config: TensorsConfig) -> Buffer:
+        """Turn a ``submit`` token into the decoded buffer."""
+        return self.decode(token, config)
+
 
 def register_decoder(cls: type) -> type:
     register_subplugin(SubpluginType.DECODER, cls.MODE, cls, replace=True)

@@ -42,6 +42,23 @@ def _sigmoid(x: np.ndarray) -> np.ndarray:
     return 1.0 / (1.0 + np.exp(-x))
 
 
+def ssd_box_math(xp, locs, raw_scores, priors):
+    """Center-size decode + sigmoid class scores, array-namespace-agnostic
+    (xp = numpy for the host path, jax.numpy inside the device-reduce jit —
+    ONE implementation so the two paths cannot diverge).
+    Returns (x0, y0, x1, y1, cls_scores) with cls_scores (N, L-1),
+    background class 0 already dropped."""
+    locs = locs.reshape(-1, 4).astype(xp.float32)
+    scores = 1.0 / (1.0 + xp.exp(
+        -raw_scores.reshape(locs.shape[0], -1).astype(xp.float32)))
+    ycenter = locs[:, 0] / Y_SCALE * priors[2] + priors[0]
+    xcenter = locs[:, 1] / X_SCALE * priors[3] + priors[1]
+    hh = xp.exp(locs[:, 2] / H_SCALE) * priors[2]
+    ww = xp.exp(locs[:, 3] / W_SCALE) * priors[3]
+    return (xcenter - ww / 2, ycenter - hh / 2,
+            xcenter + ww / 2, ycenter + hh / 2, scores[:, 1:])
+
+
 def load_box_priors(path: str) -> np.ndarray:
     """Priors file: 4 whitespace-separated float rows [ycenter,xcenter,h,w]
     (reference box_priors.txt layout)."""
@@ -95,26 +112,15 @@ class BoundingBox(Decoder):
     def _objects_mobilenet_ssd(self, buf: Buffer) -> np.ndarray:
         if self.priors is None:
             raise ValueError("mobilenet-ssd mode requires option3 box-priors file")
-        locs = buf.memories[0].host().reshape(-1, 4).astype(np.float32)   # (N,4)
-        raw = buf.memories[1].host()
-        scores = _sigmoid(raw.reshape(-1, raw.shape[-1] if raw.ndim > 1 else
-                                      raw.size // locs.shape[0]).astype(np.float32))
-        scores = scores.reshape(locs.shape[0], -1)                         # (N,L)
-        pr = self.priors  # (4,N): ycenter,xcenter,h,w
-        ycenter = locs[:, 0] / Y_SCALE * pr[2] + pr[0]
-        xcenter = locs[:, 1] / X_SCALE * pr[3] + pr[1]
-        hh = np.exp(locs[:, 2] / H_SCALE) * pr[2]
-        ww = np.exp(locs[:, 3] / W_SCALE) * pr[3]
-        x0, y0 = xcenter - ww / 2, ycenter - hh / 2
-        x1, y1 = xcenter + ww / 2, ycenter + hh / 2
-        out = []
-        cls = scores[:, 1:]  # class 0 = background
+        x0, y0, x1, y1, cls = ssd_box_math(
+            np, buf.memories[0].host(), buf.memories[1].host(), self.priors)
         best = np.argmax(cls, axis=1)
         best_score = cls[np.arange(len(best)), best]
-        sel = best_score >= self.threshold
-        for i in np.nonzero(sel)[0]:
-            out.append([x0[i], y0[i], x1[i], y1[i], best_score[i], best[i] + 1])
-        return np.asarray(out, np.float32).reshape(-1, 6)
+        sel = np.nonzero(best_score >= self.threshold)[0]
+        return np.stack(
+            [x0[sel], y0[sel], x1[sel], y1[sel], best_score[sel],
+             (best[sel] + 1).astype(np.float32)], axis=1) if len(sel) else \
+            np.zeros((0, 6), np.float32)
 
     def _objects_postprocess(self, buf: Buffer) -> np.ndarray:
         boxes = buf.memories[0].host().reshape(-1, 4).astype(np.float32)
@@ -139,6 +145,60 @@ class BoundingBox(Decoder):
             out.append([r[3], r[4], r[5], r[6], r[2], r[1]])
         return np.asarray(out, np.float32).reshape(-1, 6)
 
+    #: device-reduce candidate cap: top-K anchors by best-class score are
+    #: shipped to host; with a sane threshold the survivors are far fewer
+    TOP_K = 128
+
+    def submit(self, buf: Buffer, config: TensorsConfig):
+        if (self.box_mode in ("mobilenet-ssd", "tflite-ssd")
+                and self.priors is not None and buf.num_tensors >= 2
+                and buf.memories[0].is_device and buf.memories[1].is_device):
+            # box decode + class max + top-K on device: D2H ships K rows of
+            # 6 floats, not N_anchors*(4+num_classes) logits
+            import jax
+            import jax.numpy as jnp
+
+            if not hasattr(self, "_device_reduce"):
+                pr = jnp.asarray(self.priors, jnp.float32)
+                threshold = float(self.threshold)
+
+                def reduce(locs, raw):
+                    x0, y0, x1, y1, cls = ssd_box_math(jnp, locs, raw, pr)
+                    best = jnp.argmax(cls, axis=1)
+                    best_score = jnp.max(cls, axis=1)
+                    k = min(self.TOP_K, int(best_score.shape[0]))
+                    top_score, idx = jax.lax.top_k(best_score, k)
+                    rows = jnp.stack(
+                        [x0[idx], y0[idx], x1[idx], y1[idx], top_score,
+                         (best[idx] + 1).astype(jnp.float32)], axis=1)
+                    # above-threshold count rides along so complete() can
+                    # detect top-K overflow and fall back to the exact path
+                    n_above = jnp.sum(best_score >= threshold)
+                    counter = jnp.zeros((1, 6), jnp.float32
+                                        ).at[0, 0].set(n_above.astype(jnp.float32))
+                    return jnp.concatenate([rows, counter])
+
+                self._device_reduce = jax.jit(reduce)
+            rows = TensorMemory(self._device_reduce(
+                buf.memories[0].device(), buf.memories[1].device()))
+            rows.prefetch()
+            return (buf, rows)
+        return super().submit(buf, config)
+
+    def complete(self, token, config: TensorsConfig) -> Buffer:
+        if isinstance(token, tuple):
+            buf, rows_mem = token
+            rows = rows_mem.host()
+            rows, n_above = rows[:-1], int(rows[-1, 0])
+            if n_above > len(rows):
+                # more candidates pass the threshold than the device top-K
+                # kept: redo on host over all anchors (exactness beats speed
+                # in this rare low-threshold case; raw memories still exist)
+                return self.decode(buf, config)
+            objs = rows[rows[:, 4] >= self.threshold]
+            return self._finish(objs, buf)
+        return self.decode(token, config)
+
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         if self.box_mode in ("mobilenet-ssd", "tflite-ssd"):
             objs = self._objects_mobilenet_ssd(buf)
@@ -149,6 +209,9 @@ class BoundingBox(Decoder):
             objs = self._objects_ov(buf)
         else:
             raise ValueError(f"bounding_box: unknown mode {self.box_mode!r}")
+        return self._finish(objs, buf)
+
+    def _finish(self, objs: np.ndarray, buf: Buffer) -> Buffer:
         objs = nms(objs, self.iou_threshold)
         canvas = new_canvas(self.out_w, self.out_h)
         detections = []

@@ -51,6 +51,32 @@ class ImageSegment(Decoder):
         return Caps("video/x-raw", {"format": "RGBA", "width": w, "height": h,
                                     "framerate": config.rate})
 
+    def submit(self, buf: Buffer, config: TensorsConfig):
+        m = buf.memories[0]
+        if m.is_device and self.scheme == "tflite-deeplab":
+            # argmax on device: D2H ships H*W uint8 class ids, not the
+            # H*W*classes float logits (21x smaller for deeplab-v3)
+            import jax
+            import jax.numpy as jnp
+
+            if not hasattr(self, "_argmax"):
+                self._argmax = jax.jit(
+                    lambda x: jnp.argmax(x, axis=-1).astype(jnp.uint8))
+            cls_mem = TensorMemory(self._argmax(m.device()))
+            cls_mem.prefetch()
+            return (buf, cls_mem)
+        return super().submit(buf, config)
+
+    def complete(self, token, config: TensorsConfig) -> Buffer:
+        if isinstance(token, tuple):
+            buf, cls_mem = token
+            classes = cls_mem.host()
+            if classes.ndim == 3:
+                classes = classes[0]
+            canvas = _PALETTE[classes]
+            return buf.with_memories([TensorMemory(np.ascontiguousarray(canvas))])
+        return self.decode(token, config)
+
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         arr = buf.memories[0].host()
         if self.scheme == "tflite-deeplab":

@@ -80,10 +80,68 @@ class PoseEstimation(Decoder):
             pts.append((px / self.in_w, py / self.in_h, score))
         return pts
 
+    def submit(self, buf: Buffer, config: TensorsConfig):
+        m = buf.memories[0]
+        use_off = self.offset_mode and buf.num_tensors > 1
+        if m.is_device and (not use_off or buf.memories[1].is_device):
+            # per-keypoint argmax + gather on device: D2H ships K rows of 5
+            # floats instead of the H*W*K heatmaps (+offsets)
+            import jax
+            import jax.numpy as jnp
+
+            key = "_reduce_off" if use_off else "_reduce"
+            if not hasattr(self, key):
+                def reduce(hm, off):
+                    hm = hm.reshape(hm.shape[-3:])
+                    H, W, K = hm.shape
+                    flat = hm.reshape(H * W, K)
+                    idx = jnp.argmax(flat, axis=0)
+                    ks = jnp.arange(K)
+                    heat = flat[idx, ks]
+                    x = (idx % W).astype(jnp.float32)
+                    y = (idx // W).astype(jnp.float32)
+                    if off is None:
+                        oy = ox = jnp.zeros((K,), jnp.float32)
+                    else:
+                        off_flat = off.reshape(H * W, 2 * K)
+                        oy = off_flat[idx, ks]
+                        ox = off_flat[idx, ks + K]
+                    return jnp.stack([x, y, heat, oy, ox], axis=1)
+
+                setattr(self, key,
+                        jax.jit(reduce) if use_off
+                        else jax.jit(lambda hm: reduce(hm, None)))
+            fn = getattr(self, key)
+            rows = TensorMemory(fn(m.device(), buf.memories[1].device())
+                                if use_off else fn(m.device()))
+            rows.prefetch()
+            hm_shape = m.shape[-3:]
+            return (buf, rows, hm_shape)
+        return super().submit(buf, config)
+
+    def complete(self, token, config: TensorsConfig) -> Buffer:
+        if isinstance(token, tuple):
+            buf, rows_mem, (H, W, K) = token
+            pts: List[Tuple[float, float, float]] = []
+            for x, y, heat, oy, ox in rows_mem.host():
+                score = float(_sigmoid(heat))
+                if self.offset_mode and buf.num_tensors > 1:
+                    px = (x / max(W - 1, 1)) * self.in_w + float(ox)
+                    py = (y / max(H - 1, 1)) * self.in_h + float(oy)
+                else:
+                    px = (x + 0.5) / W * self.in_w
+                    py = (y + 0.5) / H * self.in_h
+                pts.append((px / self.in_w, py / self.in_h, score))
+            return self._finish(pts, buf)
+        return self.decode(token, config)
+
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        pts = self.keypoints(buf)
+        return self._finish(pts, buf)
+
+    def _finish(self, pts, buf: Buffer) -> Buffer:
         from .util import new_canvas
 
-        pts = self.keypoints(buf)
         canvas = new_canvas(self.out_w, self.out_h)
         coords = []
         for nx, ny, score in pts:

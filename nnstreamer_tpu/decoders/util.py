@@ -153,18 +153,29 @@ def iou(a: np.ndarray, b: np.ndarray) -> float:
 
 def nms(boxes: np.ndarray, iou_threshold: float = 0.5) -> np.ndarray:
     """boxes: (N, >=5) rows [x0,y0,x1,y1,score,...]; returns kept rows,
-    score-descending (reference do_nms)."""
+    score-descending (reference do_nms, greedy same-order semantics), with
+    the pairwise IOU row vectorized — the reference's O(N²) scalar loop is
+    seconds per frame at SSD anchor counts."""
     if len(boxes) == 0:
         return boxes
-    order = np.argsort(-boxes[:, 4])
+    order = np.argsort(-boxes[:, 4], kind="stable")
     boxes = boxes[order]
+    x0, y0, x1, y1 = (boxes[:, i].astype(np.float64) for i in range(4))
+    areas = (x1 - x0) * (y1 - y0)
+    alive = np.ones(len(boxes), bool)
     keep: List[int] = []
     for i in range(len(boxes)):
-        ok = True
-        for j in keep:
-            if iou(boxes[i], boxes[j]) > iou_threshold:
-                ok = False
-                break
-        if ok:
-            keep.append(i)
+        if not alive[i]:
+            continue
+        keep.append(i)
+        rest = alive.copy()
+        rest[: i + 1] = False
+        if not rest.any():
+            continue
+        ix = np.minimum(x1[i], x1[rest]) - np.maximum(x0[i], x0[rest])
+        iy = np.minimum(y1[i], y1[rest]) - np.maximum(y0[i], y0[rest])
+        inter = np.clip(ix, 0, None) * np.clip(iy, 0, None)
+        union = areas[i] + areas[rest] - inter
+        over = np.where(union > 0, inter / union, 0.0) > iou_threshold
+        alive[np.flatnonzero(rest)[over]] = False
     return boxes[keep]

@@ -68,19 +68,18 @@ class TensorDecoder(Element):
         depth = int(self.async_depth or 0)
         if depth <= 0:
             return self.push(self._decoder.decode(buf, self._config))
-        for m in buf.memories:
-            m.prefetch()
-        self._pending.append((buf, self._config))
+        token = self._decoder.submit(buf, self._config)
+        self._pending.append((token, self._config))
         ret: Optional[FlowReturn] = None
         while len(self._pending) > depth:
-            old_buf, old_cfg = self._pending.popleft()
-            ret = self.push(self._decoder.decode(old_buf, old_cfg))
+            token, cfg = self._pending.popleft()
+            ret = self.push(self._decoder.complete(token, cfg))
         return ret
 
     def on_eos(self) -> None:
         while self._pending:
-            old_buf, old_cfg = self._pending.popleft()
-            self.push(self._decoder.decode(old_buf, old_cfg))
+            token, cfg = self._pending.popleft()
+            self.push(self._decoder.complete(token, cfg))
 
     def stop(self) -> None:
         self._pending.clear()

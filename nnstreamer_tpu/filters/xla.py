@@ -23,7 +23,13 @@ Design notes (TPU-first):
   * optional ``custom="donate=true"`` donates input buffers (in-place reuse
     of HBM when shapes/dtypes match);
   * precision: ``custom="precision=bf16"`` casts float inputs to bfloat16 at
-    the XLA boundary (MXU-preferred; int inputs untouched).
+    the XLA boundary (MXU-preferred; int inputs untouched);
+  * dynamic-count streams (SURVEY §7 hard part b — e.g. tensor_crop regions):
+    ``custom="bucket=8"`` stacks a frame's N same-shape tensors into one
+    batch, zero-pads N up to the next multiple of 8 so XLA sees a small
+    closed set of static shapes (one compile per bucket, cached), invokes
+    once, and emits the first N rows as a single stacked result; add
+    ``resize=H:W`` to conform variable-size image regions on device first.
 """
 
 from __future__ import annotations
@@ -145,6 +151,16 @@ class XLAFilter(FilterFramework):
         self._sync = opts.get("sync", "false").lower() in ("1", "true", "yes")
         self._precision = opts.get("precision", "")
         self._donate = opts.get("donate", "false").lower() in ("1", "true", "yes")
+        self._bucket = int(opts.get("bucket", "0") or 0)
+        resize = opts.get("resize", "")
+        if resize:
+            parts = tuple(int(v) for v in resize.split(":"))
+            if len(parts) != 2:
+                raise ValueError(f"xla-tpu: resize wants H:W, got {resize!r}")
+            self._resize = parts
+        else:
+            self._resize = None
+        self.flexible_output = self._bucket > 0
         self._build_jit()
         self._in_info = props.input_info or self._bundle.in_info
         self._out_info = props.output_info or self._bundle.out_info
@@ -209,6 +225,8 @@ class XLAFilter(FilterFramework):
 
     # -- execution ----------------------------------------------------------- #
     def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        if self._bucket > 0:
+            return self._invoke_bucketed(inputs)
         arrays = [m.device(self._device) for m in inputs]
         with self._lock:
             outs = self._jitted(*arrays)
@@ -216,6 +234,87 @@ class XLAFilter(FilterFramework):
             for o in outs:
                 o.block_until_ready()
         return [TensorMemory(o) for o in outs]
+
+    def _invoke_bucketed(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        """N tensors → one padded-batch invoke → one (N, ...) result per
+        model output. jax.jit's shape-keyed cache makes each bucket size
+        compile exactly once; zero rows are masked off by slicing."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(inputs)
+        if n == 0:
+            return []
+        if self._resize is not None:
+            arrays = [self._resize_region(m) for m in inputs]
+        else:
+            arrays = [m.device(self._device) for m in inputs]
+        shapes = {tuple(a.shape) for a in arrays}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"bucketed invoke needs same-shape tensors, got {shapes} "
+                "(add custom=\"resize=H:W\" for image regions)")
+        bucket = -(-n // self._bucket) * self._bucket
+        if not hasattr(self, "_stack_fn"):
+            # stack+pad inside one jit so the pad constant folds and the
+            # whole prep is a single dispatch
+            self._stack_fn = jax.jit(
+                lambda pad_rows, *xs: jnp.concatenate(
+                    [jnp.stack(xs),
+                     jnp.zeros((pad_rows,) + xs[0].shape, xs[0].dtype)]),
+                static_argnums=0)
+        batch = self._stack_fn(bucket - n, *arrays)
+        with self._lock:
+            outs = self._jitted(batch)
+        if self._sync:
+            for o in outs:
+                o.block_until_ready()
+        return [TensorMemory(o[:n]) for o in outs]
+
+    def _resize_region(self, mem: TensorMemory):
+        """Bilinear-resize a variable-size region to the static target with a
+        BOUNDED compile-shape set: the region is zero-padded (host-side) to
+        the next power-of-two extents, and a gather-based bilinear kernel —
+        keyed only on the padded shape — samples the true (h, w) extent
+        passed as runtime scalars. Matches jax.image.resize(antialias=False)
+        (tflite resize_bilinear semantics); the padding is never sampled."""
+        import jax
+        import jax.numpy as jnp
+
+        arr = mem.host()
+        h, w = arr.shape[0], arr.shape[1]
+        hp = 1 << max(3, (h - 1).bit_length())
+        wp = 1 << max(3, (w - 1).bit_length())
+        padded = np.zeros((hp, wp) + arr.shape[2:], arr.dtype)
+        padded[:h, :w] = arr
+        if not hasattr(self, "_region_resize_fn"):
+            th, tw = self._resize
+
+            def region_resize(p, hw):
+                trailing = p.shape[2:]
+                p = p.reshape(p.shape[0], p.shape[1], -1).astype(jnp.float32)
+                hf = hw[0].astype(jnp.float32)
+                wf = hw[1].astype(jnp.float32)
+                ys = jnp.clip((jnp.arange(th) + 0.5) * hf / th - 0.5,
+                              0.0, hf - 1.0)
+                xs = jnp.clip((jnp.arange(tw) + 0.5) * wf / tw - 0.5,
+                              0.0, wf - 1.0)
+                y0 = jnp.floor(ys).astype(jnp.int32)
+                x0 = jnp.floor(xs).astype(jnp.int32)
+                y1 = jnp.minimum(y0 + 1, hw[0] - 1)
+                x1 = jnp.minimum(x0 + 1, hw[1] - 1)
+                wy = (ys - y0)[:, None, None]
+                wx = (xs - x0)[None, :, None]
+                a = p[y0[:, None], x0[None, :]]
+                b = p[y0[:, None], x1[None, :]]
+                c = p[y1[:, None], x0[None, :]]
+                d = p[y1[:, None], x1[None, :]]
+                out = (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+                       + c * wy * (1 - wx) + d * wy * wx)
+                return out.reshape((th, tw) + trailing)
+
+            self._region_resize_fn = jax.jit(region_resize)
+        return self._region_resize_fn(padded, np.array([h, w], np.int32))
 
     # -- events -------------------------------------------------------------- #
     def reload_model(self, model: Any) -> None:

@@ -238,3 +238,60 @@ class TestMobileNetV2:
         assert out.shape == (1, 10)
         assert out.dtype == np.float32
         assert np.all(np.isfinite(out))
+
+
+class TestBucketedInvoke:
+    """custom="bucket=N": dynamic-count flexible streams (tensor_crop
+    regions) through static-shape XLA programs via batch padding."""
+
+    def test_crop_to_bucketed_filter(self):
+        def region_mean(x):  # (B, H, W, C) -> (B, C)
+            return x.mean(axis=(1, 2))
+
+        img = np.arange(12 * 12 * 2, dtype=np.float32).reshape(1, 12, 12, 2)
+        frames = [np.array([[0, 0, 4, 4], [2, 2, 4, 4], [1, 1, 8, 8]], np.int32),
+                  np.array([[0, 0, 4, 4]], np.int32)]  # n varies per frame
+        p = Pipeline()
+        raw = p.add_new("appsrc",
+                        caps=tensor_caps("2:12:12:1", "float32"),
+                        data=[img, img.copy()], framerate=30)
+        info = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(
+            TensorsInfo((), __import__("nnstreamer_tpu").core.TensorFormat.FLEXIBLE), 30)),
+            data=frames)
+        crop = p.add_new("tensor_crop")
+        filt = p.add_new("tensor_filter", framework="xla-tpu",
+                         model=region_mean, custom="bucket=4,resize=4:4")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(raw, crop)
+        Pipeline.link(info, crop)
+        Pipeline.link(crop, filt, sink)
+        p.run(timeout=120)
+        assert sink.num_buffers == 2
+        out0 = sink.buffers[0].memories[0].host()
+        out1 = sink.buffers[1].memories[0].host()
+        assert out0.shape == (3, 2) and out1.shape == (1, 2)
+        # region 0 of frame 0: img[0, 0:4, 0:4] — resize 4x4 is identity
+        np.testing.assert_allclose(out0[0], img[0, 0:4, 0:4].mean(axis=(0, 1)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out1[0], out0[0], rtol=1e-5)
+
+    def test_mixed_shapes_without_resize_fails(self):
+        def ident(x):
+            return x
+
+        p = Pipeline()
+        img = np.zeros((1, 10, 10, 1), np.float32)
+        boxes = np.array([[0, 0, 2, 2], [0, 0, 4, 4]], np.int32)
+        raw = p.add_new("appsrc", caps=tensor_caps("1:10:10:1", "float32"),
+                        data=[img], framerate=30)
+        info = p.add_new("appsrc", caps=tensor_caps("4:2", "int32"),
+                         data=[boxes], framerate=30)
+        crop = p.add_new("tensor_crop")
+        filt = p.add_new("tensor_filter", framework="xla-tpu", model=ident,
+                         custom="bucket=4")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(raw, crop)
+        Pipeline.link(info, crop)
+        Pipeline.link(crop, filt, sink)
+        with pytest.raises(PipelineError, match="same-shape"):
+            p.run(timeout=60)
