@@ -2,26 +2,35 @@
 
 Mirrors BASELINE.md's headline config (videotestsrc ! tensor_converter !
 tensor_filter framework=xla-tpu model=mobilenet_v2 ! tensor_decoder
-mode=image_labeling ! sink) end-to-end on the real TPU chip, measuring
-steady-state pipeline FPS and p50 per-invoke latency.
+mode=image_labeling ! sink) end-to-end on the real TPU chip.
 
-``vs_baseline``: the reference publishes no absolute numbers (BASELINE.md —
-its golden pipeline is correctness-only on CPU tflite); we normalize against
-the 30 FPS real-time camera rate the reference pipelines are built around,
-so vs_baseline = FPS / 30 (≥1.0 ⇒ faster than real-time streaming).
+Reported (BASELINE.md "numbers to produce" + VERDICT r2 #3 methodology):
+  * ``value``/``fps_median`` — steady-state pipeline FPS, best and median
+    64-frame window (peak shows capability; median is the honest
+    sustained number over the jittery tunnel);
+  * ``p50_invoke_us`` — synchronous per-invoke latency (reference
+    tensor_filter.c:366-380 ``latency`` prop contract: includes transfer);
+  * ``split`` — amortized per-frame H2D/compute/D2H + one-shot RTT
+    (utils/probes.phase_split), separating tunnel cost from chip cost;
+  * ``mfu`` — model FLOPs (XLA cost analysis) × FPS / chip peak;
+  * ``vs_baseline`` — speedup over the same pipeline on same-host jax-CPU
+    (the reference's tflite-CPU analog, run in a subprocess); falls back
+    to FPS/30 (real-time camera rate) if the CPU run fails;
+  * extras: SSD / DeepLab / PoseNet pipeline FPS (peak + median), batched
+    serving scaling, and the on-chip smoke lane (utils/probes.tpu_smoke).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
-
 
 #: env overrides let the harness be validated on CPU with a tiny model;
 #: the driver's TPU run uses the defaults
@@ -29,7 +38,24 @@ SIZE = int(os.environ.get("BENCH_SIZE", "224"))
 MODEL = os.environ.get(
     "BENCH_MODEL", f"zoo://mobilenet_v2?width=1.0&size={SIZE}")
 CLASSES = int(os.environ.get("BENCH_CLASSES", "1001"))
-DECODE_DEPTH = 16  # async_depth of the throughput pipeline's decoder
+#: max in-flight frames at the decode boundary. The decoder drains frames
+#: the moment their readback lands (readiness-based), so depth only needs
+#: to cover RTT / per-frame-host-time; 64 spans the tunnel's ~70-130 ms RTT
+#: at ~1-2 ms/frame of host work with negligible memory cost.
+DECODE_DEPTH = int(os.environ.get("BENCH_DEPTH", "64"))
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: repeat bench runs skip the slow
+    first compile (harmless no-op if the backend rejects it)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 def build_pipeline(frames, labels_path, sync: bool):
@@ -58,17 +84,22 @@ def _video_caps():
                                 "framerate": Fraction(0, 1)})
 
 
-def _windowed_fps(arrivals, n_warmup: int, tail: int) -> float:
+def _windowed_fps(arrivals, n_warmup: int, tail: int, window: int = 64):
+    """(peak, median) FPS over sliding ``window``-frame windows, excluding
+    warmup head and the EOS drain tail (a window overlapping the EOS burst
+    would overstate steady-state throughput)."""
     ts = np.asarray(arrivals[n_warmup:len(arrivals) - tail])
-    win = min(64, len(ts) - 1)
+    win = min(window, len(ts) - 1)
     if win <= 0:
-        return float("nan")
+        return float("nan"), float("nan")
     spans = ts[win:] - ts[:-win]
-    return win / spans.min() if spans.min() > 0 else float("nan")
+    if not len(spans) or spans.min() <= 0:
+        return float("nan"), float("nan")
+    return win / spans.min(), win / float(np.median(spans))
 
 
 def _pipeline_fps(model_spec: str, size: int, dec_mode: str, dec_opts: dict,
-                  n_frames: int = 96, n_warmup: int = 16) -> float:
+                  n_frames: int = 160, n_warmup: int = 16):
     """Steady-state FPS of a videotestsrc → converter → filter → decoder
     pipeline (BASELINE.md 'numbers to produce' configs)."""
     from nnstreamer_tpu.graph import Pipeline
@@ -117,14 +148,81 @@ def _extra_benches(tmpdir: str) -> dict:
     out = {}
     for key, (spec, size, mode, opts) in configs.items():
         try:
-            out[key] = round(_pipeline_fps(spec, size, mode, opts), 2)
+            peak, med = _pipeline_fps(spec, size, mode, opts)
+            out[key] = round(peak, 2)
+            out[key.replace("_fps", "_fps_median")] = round(med, 2)
         except Exception:
             traceback.print_exc(file=sys.stderr)
             out[key] = None
     return out
 
 
+def _batched_bench() -> dict:
+    """Batched serving (VERDICT r2 #4): same model at batch=8 via the
+    converter's frames-per-tensor regrouping; FPS counts source frames."""
+    import traceback
+
+    try:
+        from nnstreamer_tpu.graph import Pipeline
+
+        batch = 8
+        n_batches, warm, depth = 40, 4, 16
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=SIZE, height=SIZE,
+                        num_buffers=(n_batches + warm) * batch,
+                        pattern="random")
+        conv = p.add_new("tensor_converter", frames_per_tensor=batch)
+        filt = p.add_new("tensor_filter", framework="xla-tpu",
+                         model=MODEL + ("&" if "?" in MODEL else "?") + f"batch={batch}")
+        dec = p.add_new("tensor_decoder", mode="image_labeling",
+                        async_depth=depth)
+        sink = p.add_new("tensor_sink")
+        arrivals = []
+        sink.new_data = lambda buf: arrivals.append(time.monotonic())
+        Pipeline.link(src, conv, filt, dec, sink)
+        p.run(timeout=600)
+        peak, med = _windowed_fps(arrivals, warm, depth, window=16)
+        if not np.isfinite(peak):
+            return {}
+        return {"batch8_fps": round(peak * batch, 2),
+                "batch8_fps_median": round(med * batch, 2)}
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
+def _cpu_reference() -> float:
+    """Same-host CPU run of the headline pipeline (reference tflite-CPU
+    analog, BASELINE.md row 1) in a subprocess so backends don't collide."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_CPU_CHILD="1",
+               BENCH_FRAMES="144",
+               BENCH_DEPTH="8",
+               BENCH_EXTRAS="0")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=600)
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "value" in rec:
+                return float(rec.get("fps_median") or rec["value"])
+    except Exception:
+        pass
+    return float("nan")
+
+
 def main() -> None:
+    _enable_compile_cache()
+    cpu_child = os.environ.get("BENCH_CPU_CHILD") == "1"
+    if cpu_child:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     n_warmup, n_frames = 16, int(os.environ.get("BENCH_FRAMES", "256"))
     rng = np.random.default_rng(0)
     frames = [rng.integers(0, 255, (SIZE, SIZE, 3)).astype(np.uint8)
@@ -146,37 +244,89 @@ def main() -> None:
     p50_us = float(np.percentile(np.asarray(lats[n_warmup:]) / 1000.0, 50))
 
     # -- throughput run (async dispatch, end-to-end pipeline FPS) ------------ #
-    # FPS = best sustained 64-frame window: the TPU tunnel's RTT jitters, and
-    # a single hiccup shouldn't mask steady-state pipeline throughput
     tp_frames = [frames[i % len(frames)] for i in range(n_warmup + n_frames)]
     p2, filt2, sink2 = build_pipeline(tp_frames, labels_path, sync=False)
     arrivals = []
 
     sink2.new_data = lambda buf: arrivals.append(time.monotonic())
     p2.run(timeout=600)
-    # drop warmup head and the EOS flush tail (the decoder's pending frames
-    # drain back-to-back at EOS — a window overlapping that burst would
-    # overstate steady-state throughput)
-    fps = _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
+    fps, fps_median = _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
 
     import jax
+
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.utils import probes
+
+    device = jax.devices()[0]
+
+    # -- instrumentation: per-phase split + MFU ------------------------------ #
+    split = flops = mfu_val = None
+    try:
+        bundle = get_model(MODEL)
+        fn = bundle.fn()
+        example = frames[0][None]
+        split = probes.phase_split(fn, [example], device=device, k=32)
+        flops = probes.model_flops(fn, example)
+        mfu_val = probes.mfu(flops, fps_median, device)
+    except Exception:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
 
     result = {
         "metric": f"mobilenet_v2_{SIZE}_pipeline_fps",
         "value": round(fps, 2),
         "unit": "frames/sec",
-        "vs_baseline": round(fps / 30.0, 3),
+        "fps_median": round(fps_median, 2),
         "p50_invoke_us": round(p50_us, 1),
         "frames": n_frames,
-        "device": str(jax.devices()[0]),
+        "device": str(device),
     }
+    if split is not None:
+        result["split"] = split
+    if flops:
+        result["model_gflops"] = round(flops / 1e9, 3)
+    if mfu_val is not None:
+        result["mfu"] = round(mfu_val, 6)
+
+    if not cpu_child and os.environ.get("BENCH_CPU_REF", "1") != "0":
+        cpu_fps = _cpu_reference()
+        if np.isfinite(cpu_fps) and cpu_fps > 0:
+            result["cpu_reference_fps"] = round(cpu_fps, 2)
+            result["vs_baseline"] = round(fps_median / cpu_fps, 3)
+            result["vs_baseline_kind"] = "speedup_vs_same_host_jax_cpu"
+    if "vs_baseline" not in result:
+        # fallback: the 30 FPS real-time camera rate the reference
+        # pipelines are built around
+        result["vs_baseline"] = round(fps_median / 30.0, 3)
+        result["vs_baseline_kind"] = "fps_median_over_30fps_realtime"
+
     if os.environ.get("BENCH_EXTRAS", "1") != "0":
         try:
             import tempfile as _tf
 
             with _tf.TemporaryDirectory() as td:
                 result.update(_extra_benches(td))
+            result.update(_batched_bench())
+            if flops and result.get("batch8_fps_median"):
+                result["batch8_mfu"] = round(
+                    probes.mfu(flops, result["batch8_fps_median"], device)
+                    or 0.0, 6)
         except Exception:  # never lose the headline measurement
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+        try:
+            smoke = probes.tpu_smoke(device)
+            result["smoke"] = smoke
+            if device.platform != "cpu":
+                # committed driver-visible artifact: proof these paths ran
+                # on the real chip (a CPU validation run must not clobber)
+                with open(os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_SMOKE.json"), "w") as f:
+                    json.dump(smoke, f, indent=1)
+        except Exception:
             import traceback
 
             traceback.print_exc(file=sys.stderr)

@@ -58,6 +58,25 @@ class Decoder:
         """Turn a ``submit`` token into the decoded buffer."""
         return self.decode(token, config)
 
+    def token_ready(self, token: Any) -> bool:
+        """Non-blocking: True when ``complete(token)`` would not stall on a
+        device→host transfer. Walks the token's TensorMemory/Buffer members
+        (tuples of them are the submit-token convention). The decoder
+        element drains ready frames eagerly and only blocks when the
+        pipeline exceeds ``async_depth`` — on TPU the readback RTT is far
+        larger than per-frame host work, so depth alone can't hide it."""
+        return _ready(token)
+
+
+def _ready(obj: Any) -> bool:
+    if isinstance(obj, TensorMemory):
+        return obj.is_ready()
+    if isinstance(obj, Buffer):
+        return all(m.is_ready() for m in obj.memories)
+    if isinstance(obj, (tuple, list)):
+        return all(_ready(v) for v in obj)
+    return True
+
 
 def register_decoder(cls: type) -> type:
     register_subplugin(SubpluginType.DECODER, cls.MODE, cls, replace=True)

@@ -54,30 +54,48 @@ class ImageLabeling(Decoder):
     def out_caps(self, config: TensorsConfig) -> Caps:
         return Caps("text/x-raw", {"format": "utf8"})
 
+    @staticmethod
+    def _rows(arr):
+        """Scores as (frames, classes): a batched tensor (converter
+        frames-per-tensor regrouping) yields one label per frame."""
+        return arr.reshape(-1) if arr.ndim <= 1 or arr.shape[0] == 1 \
+            else arr.reshape(arr.shape[0], -1)
+
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         m = buf.memories[0]
         if m.is_device and not m.prefetched:
-            # argmax on device: D2H transfers 2 scalars, not the logits
+            # argmax on device: D2H transfers 2 scalars per frame, not the
+            # logits
             import jax
             import jax.numpy as jnp
 
             if not hasattr(self, "_argmax"):
                 # one stacked fetch: each D2H readback pays full RTT, so
-                # (argmax, max) come back as a single 2-element array
+                # (argmax, max) come back as a single array
                 self._argmax = jax.jit(
                     lambda x: jnp.stack(
-                        [jnp.argmax(x.reshape(-1)).astype(jnp.float32),
-                         jnp.max(x.reshape(-1)).astype(jnp.float32)]))
-            pair = np.asarray(self._argmax(m.device()))
-            idx, top = int(pair[0]), float(pair[1])
+                        [jnp.argmax(self._rows(x), axis=-1)
+                         .astype(jnp.float32).reshape(-1),
+                         jnp.max(self._rows(x), axis=-1)
+                         .astype(jnp.float32).reshape(-1)], axis=1))
+            pairs = np.asarray(self._argmax(m.device()))
         else:
-            scores = m.host().reshape(-1)
-            idx = int(np.argmax(scores))
-            top = float(scores[idx])
-        label = self.labels[idx] if idx < len(self.labels) else str(idx)
+            rows = np.atleast_2d(self._rows(m.host()))
+            idxs = np.argmax(rows, axis=-1)
+            pairs = np.stack(
+                [idxs.astype(np.float32),
+                 rows[np.arange(len(rows)), idxs].astype(np.float32)], axis=1)
+        names = [self.labels[int(i)] if int(i) < len(self.labels) else str(int(i))
+                 for i, _ in pairs]
+        label, idx, top = names[0], int(pairs[0][0]), float(pairs[0][1])
         out = buf.with_memories(
-            [TensorMemory(np.frombuffer(label.encode("utf-8"), np.uint8).copy())])
+            [TensorMemory(np.frombuffer("\n".join(names).encode("utf-8"),
+                                        np.uint8).copy())])
         out.meta.update(label=label, label_index=idx, label_score=top)
+        if len(names) > 1:
+            out.meta.update(labels=names,
+                            label_indices=[int(i) for i, _ in pairs],
+                            label_scores=[float(s) for _, s in pairs])
         return out
 
 

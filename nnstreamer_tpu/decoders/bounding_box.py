@@ -117,6 +117,9 @@ class BoundingBox(Decoder):
         best = np.argmax(cls, axis=1)
         best_score = cls[np.arange(len(best)), best]
         sel = np.nonzero(best_score >= self.threshold)[0]
+        if len(sel) > self.PRE_NMS_TOPK:
+            order = np.argsort(-best_score[sel], kind="stable")[:self.PRE_NMS_TOPK]
+            sel = np.sort(sel[order])
         return np.stack(
             [x0[sel], y0[sel], x1[sel], y1[sel], best_score[sel],
              (best[sel] + 1).astype(np.float32)], axis=1) if len(sel) else \
@@ -145,38 +148,63 @@ class BoundingBox(Decoder):
             out.append([r[3], r[4], r[5], r[6], r[2], r[1]])
         return np.asarray(out, np.float32).reshape(-1, 6)
 
-    #: device-reduce candidate cap: top-K anchors by best-class score are
-    #: shipped to host; with a sane threshold the survivors are far fewer
-    TOP_K = 128
+    #: pre-NMS candidate cap, applied identically on the host and device
+    #: paths: the top-K anchors by best-class score enter NMS (the tflite
+    #: detection-postprocess convention the reference consumes via its
+    #: mobilenet-ssd-postprocess mode). A static K is what lets the whole
+    #: threshold→top-K→NMS reduction compile to one fixed-shape XLA program:
+    #: D2H ships K rows of 6 floats instead of N_anchors×(4+num_classes)
+    #: logits, and no data-dependent host fallback exists to serialize the
+    #: stream (submit/complete stays fully pipelined).
+    PRE_NMS_TOPK = 256
 
     def submit(self, buf: Buffer, config: TensorsConfig):
         if (self.box_mode in ("mobilenet-ssd", "tflite-ssd")
                 and self.priors is not None and buf.num_tensors >= 2
                 and buf.memories[0].is_device and buf.memories[1].is_device):
-            # box decode + class max + top-K on device: D2H ships K rows of
-            # 6 floats, not N_anchors*(4+num_classes) logits
+            # box decode + class max + threshold + top-K + greedy NMS, all
+            # on device in one jit — complete() only filters kept rows
             import jax
             import jax.numpy as jnp
 
             if not hasattr(self, "_device_reduce"):
                 pr = jnp.asarray(self.priors, jnp.float32)
                 threshold = float(self.threshold)
+                iou_thr = float(self.iou_threshold)
 
                 def reduce(locs, raw):
                     x0, y0, x1, y1, cls = ssd_box_math(jnp, locs, raw, pr)
                     best = jnp.argmax(cls, axis=1)
                     best_score = jnp.max(cls, axis=1)
-                    k = min(self.TOP_K, int(best_score.shape[0]))
-                    top_score, idx = jax.lax.top_k(best_score, k)
-                    rows = jnp.stack(
-                        [x0[idx], y0[idx], x1[idx], y1[idx], top_score,
+                    k = min(self.PRE_NMS_TOPK, int(best_score.shape[0]))
+                    # mask below-threshold anchors out before ranking so the
+                    # K slots hold only real candidates (score -1 ⇒ unused)
+                    masked = jnp.where(best_score >= threshold, best_score, -1.0)
+                    top_score, idx = jax.lax.top_k(masked, k)
+                    bx0, by0, bx1, by1 = x0[idx], y0[idx], x1[idx], y1[idx]
+                    # greedy same-order NMS (reference nms(),
+                    # tensordec-boundingbox.c:962-976: strict > suppresses),
+                    # vectorized as a K-step masked sweep over the IoU matrix
+                    area = (bx1 - bx0) * (by1 - by0)
+                    ix = (jnp.minimum(bx1[:, None], bx1[None, :])
+                          - jnp.maximum(bx0[:, None], bx0[None, :]))
+                    iy = (jnp.minimum(by1[:, None], by1[None, :])
+                          - jnp.maximum(by0[:, None], by0[None, :]))
+                    inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
+                    union = area[:, None] + area[None, :] - inter
+                    iou = jnp.where(union > 0, inter / union, 0.0)
+                    later = jnp.arange(k)[None, :] > jnp.arange(k)[:, None]
+                    suppresses = (iou > iou_thr) & later
+
+                    def body(i, alive):
+                        return alive & ~(alive[i] & suppresses[i])
+
+                    alive = jax.lax.fori_loop(
+                        0, k, body, top_score >= threshold)
+                    out_score = jnp.where(alive, top_score, -1.0)
+                    return jnp.stack(
+                        [bx0, by0, bx1, by1, out_score,
                          (best[idx] + 1).astype(jnp.float32)], axis=1)
-                    # above-threshold count rides along so complete() can
-                    # detect top-K overflow and fall back to the exact path
-                    n_above = jnp.sum(best_score >= threshold)
-                    counter = jnp.zeros((1, 6), jnp.float32
-                                        ).at[0, 0].set(n_above.astype(jnp.float32))
-                    return jnp.concatenate([rows, counter])
 
                 self._device_reduce = jax.jit(reduce)
             rows = TensorMemory(self._device_reduce(
@@ -189,12 +217,8 @@ class BoundingBox(Decoder):
         if isinstance(token, tuple):
             buf, rows_mem = token
             rows = rows_mem.host()
-            rows, n_above = rows[:-1], int(rows[-1, 0])
-            if n_above > len(rows):
-                # more candidates pass the threshold than the device top-K
-                # kept: redo on host over all anchors (exactness beats speed
-                # in this rare low-threshold case; raw memories still exist)
-                return self.decode(buf, config)
+            # device reduce already thresholded + NMS'd; suppressed slots
+            # carry score -1
             objs = rows[rows[:, 4] >= self.threshold]
             return self._finish(objs, buf)
         return self.decode(token, config)

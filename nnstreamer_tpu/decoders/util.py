@@ -48,10 +48,16 @@ def draw_rect(canvas: np.ndarray, x0: int, y0: int, x1: int, y1: int,
 
 def draw_disc(canvas: np.ndarray, cx: int, cy: int, radius: int,
               color: Sequence[int] = (255, 0, 0, 255)) -> None:
+    # rasterize only the disc's bounding square — a full-canvas mask is
+    # O(H*W) per call and dominated the pose decoder's per-frame cost
     h, w = canvas.shape[:2]
-    y, x = np.ogrid[:h, :w]
+    x0, x1 = max(cx - radius, 0), min(cx + radius + 1, w)
+    y0, y1 = max(cy - radius, 0), min(cy + radius + 1, h)
+    if x0 >= x1 or y0 >= y1:
+        return
+    y, x = np.ogrid[y0:y1, x0:x1]
     mask = (x - cx) ** 2 + (y - cy) ** 2 <= radius ** 2
-    canvas[mask] = np.asarray(color, np.uint8)
+    canvas[y0:y1, x0:x1][mask] = np.asarray(color, np.uint8)
 
 
 def draw_line(canvas: np.ndarray, x0: int, y0: int, x1: int, y1: int,

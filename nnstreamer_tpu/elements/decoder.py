@@ -71,7 +71,12 @@ class TensorDecoder(Element):
         token = self._decoder.submit(buf, self._config)
         self._pending.append((token, self._config))
         ret: Optional[FlowReturn] = None
-        while len(self._pending) > depth:
+        # drain every leading frame whose readback has landed (in order,
+        # non-blocking); block on the oldest only when over depth — depth
+        # caps in-flight frames, readiness decides when to complete
+        while self._pending and (
+                len(self._pending) > depth
+                or self._decoder.token_ready(self._pending[0][0])):
             token, cfg = self._pending.popleft()
             ret = self.push(self._decoder.complete(token, cfg))
         return ret
