@@ -1,22 +1,26 @@
 """FlexBuffers / FlatBuffers tensor serialization (decoder + converter pairs).
 
-Reference: ext/nnstreamer/tensor_decoder/tensordec-flexbuf.cc and
-tensordec-flatbuf.cc + tensor_converter/tensor_converter_flexbuf.cc and
-tensor_converter_flatbuf.cc — tensors ↔ (Flex|Flat)Buffers blobs for interop
-links. The reference compiles a schema with flatc; here the FlatBuffers frame
-table is built/read with the runtime ``flatbuffers.Builder``/``Table`` API
-directly (no codegen step), and FlexBuffers uses the schema-less API.
+Reference-exact wire layouts, interoperable with upstream peers:
 
-Frame layout (both formats carry the same fields):
-  rate_n/rate_d  — stream framerate
-  tensors[]      — name, dtype (string), dims (int vector, innermost-first
-                   like TensorInfo.dims), data (byte blob)
+* FlexBuffers (tensordec-flexbuf.cc:26-33, tensor_converter_flexbuf.cc:107-146):
+  ``Map { "num_tensors": UInt, "rate_n": Int, "rate_d": Int, "format": Int,
+  "tensor_#i": Vector[ String name, Int type_enum, TypedVector dims(rank 4),
+  Blob data ] }`` — dims zero-rank-padded with 1 to NNS_TENSOR_RANK_LIMIT=4
+  (tensor_typedef.h:34), dtype as the reference ``tensor_type`` enum
+  (tensor_typedef.h:155-166).
+
+* FlatBuffers (ext/nnstreamer/include/nnstreamer.fbs:12-53):
+  ``table Tensors { num_tensor:int; fr:frame_rate(struct rate_n,rate_d);
+  tensor:[Tensor]; format:Tensor_format }``,
+  ``table Tensor { name:string; type:Tensor_type; dimension:[uint32];
+  data:[ubyte] }`` — built/read with the runtime ``flatbuffers`` API (no
+  codegen step), field slots matching flatc's vtable layout for that schema.
 """
 
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import flatbuffers  # gates registration: decoders/__init__ skips on ImportError
 import numpy as np
@@ -24,9 +28,64 @@ from flatbuffers import flexbuffers
 from flatbuffers import number_types as N
 
 from ..core.buffer import Buffer, TensorMemory
-from ..core.types import Caps, TensorDType, TensorInfo, TensorsConfig, TensorsInfo
+from ..core.types import (
+    Caps,
+    TensorDType,
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+    TensorsInfo,
+)
 from ..decoders.base import Decoder, register_decoder
 from . import register_converter
+
+#: NNS_TENSOR_RANK_LIMIT (tensor_typedef.h:34)
+RANK_LIMIT = 4
+
+#: reference ``tensor_type`` enum (tensor_typedef.h:155-166; identical to
+#: nnstreamer.fbs Tensor_type)
+_DTYPE_TO_ENUM = {
+    TensorDType.INT32: 0, TensorDType.UINT32: 1,
+    TensorDType.INT16: 2, TensorDType.UINT16: 3,
+    TensorDType.INT8: 4, TensorDType.UINT8: 5,
+    TensorDType.FLOAT64: 6, TensorDType.FLOAT32: 7,
+    TensorDType.INT64: 8, TensorDType.UINT64: 9,
+}
+_ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
+_FORMAT_TO_ENUM = {TensorFormat.STATIC: 0, TensorFormat.FLEXIBLE: 1,
+                   TensorFormat.SPARSE: 2}
+_ENUM_TO_FORMAT = {v: k for k, v in _FORMAT_TO_ENUM.items()}
+
+
+def _dtype_enum(info: TensorInfo) -> int:
+    e = _DTYPE_TO_ENUM.get(info.dtype)
+    if e is None:
+        raise ValueError(
+            f"dtype {info.dtype} has no reference tensor_type enum value "
+            "(bf16/f16 are TPU-local; typecast before serializing)")
+    return e
+
+
+def _padded_dims(info: TensorInfo) -> List[int]:
+    dims = [int(d) for d in info.dims[:RANK_LIMIT]]
+    if len(info.dims) > RANK_LIMIT:
+        raise ValueError(
+            f"rank {len(info.dims)} exceeds the wire format's "
+            f"NNS_TENSOR_RANK_LIMIT={RANK_LIMIT}")
+    return dims + [1] * (RANK_LIMIT - len(dims))
+
+
+def _trimmed_info(dims: Tuple[int, ...], type_enum: int,
+                  name: str) -> TensorInfo:
+    dt = _ENUM_TO_DTYPE.get(type_enum)
+    if dt is None:
+        raise ValueError(f"unknown tensor_type enum {type_enum}")
+    trimmed = list(dims)
+    while len(trimmed) > 1 and trimmed[-1] in (1, 0):
+        trimmed.pop()
+    if any(d <= 0 for d in trimmed):
+        raise ValueError(f"invalid dimension {dims}")
+    return TensorInfo(tuple(trimmed), dt, name or None)
 
 
 # ---------------------------------------------------------------------------- #
@@ -35,41 +94,45 @@ from . import register_converter
 
 def frame_to_flexbuf(buf: Buffer, config: TensorsConfig = None) -> bytes:
     rate = config.rate if config is not None and config.rate else Fraction(0, 1)
+    fmt = config.info.format if config is not None else TensorFormat.STATIC
     b = flexbuffers.Builder()
     with b.Map():
+        b.Key("num_tensors"); b.UInt(len(buf.memories), 4)
         b.Key("rate_n"); b.Int(rate.numerator)
         b.Key("rate_d"); b.Int(rate.denominator)
-        b.Key("tensors")
-        with b.Vector():
-            for m in buf.memories:
-                with b.Map():
-                    b.Key("name"); b.String(m.info.name or "")
-                    b.Key("dtype"); b.String(str(m.info.dtype))
-                    b.Key("dims")
-                    with b.TypedVector():
-                        for d in m.info.dims:
-                            b.Int(int(d))
-                    b.Key("data"); b.Blob(m.tobytes())
+        b.Key("format"); b.Int(_FORMAT_TO_ENUM.get(fmt, 0))
+        for i, m in enumerate(buf.memories):
+            b.Key(f"tensor_{i}")
+            with b.Vector():
+                b.String(m.info.name or "")
+                b.Int(_dtype_enum(m.info))
+                b.TypedVectorFromElements(_padded_dims(m.info))
+                b.Blob(m.tobytes())
     return bytes(b.Finish())
 
 
 def flexbuf_to_frame(data: bytes) -> Tuple[Buffer, Fraction]:
     root = flexbuffers.GetRoot(bytearray(data)).AsMap
+    num = root["num_tensors"].AsInt
+    if num < 0 or num > 16:  # NNS_TENSOR_SIZE_LIMIT
+        raise ValueError(f"flexbuf: num_tensors {num} out of range")
     rate = Fraction(root["rate_n"].AsInt, max(root["rate_d"].AsInt, 1))
     mems: List[TensorMemory] = []
-    for t in root["tensors"].AsVector:
-        tm = t.AsMap
-        info = TensorInfo(
-            tuple(e.AsInt for e in tm["dims"].AsTypedVector),
-            TensorDType.parse(tm["dtype"].AsString),
-            tm["name"].AsString or None)
-        mems.append(TensorMemory.from_bytes(bytes(tm["data"].AsBlob), info))
+    for i in range(num):
+        t = root[f"tensor_{i}"].AsVector
+        dims = tuple(e.AsInt for e in t[2].AsTypedVector)
+        info = _trimmed_info(dims, t[1].AsInt, t[0].AsString)
+        payload = bytes(t[3].AsBlob)
+        if len(payload) != info.size_bytes:
+            raise ValueError(
+                f"flexbuf tensor {i}: {len(payload)} payload bytes for "
+                f"{info.dim_string}:{info.dtype} ({info.size_bytes} expected)")
+        mems.append(TensorMemory.from_bytes(payload, info))
     return Buffer(mems), rate
 
 
 # ---------------------------------------------------------------------------- #
-# FlatBuffers (schema'd: Frame{rate_n, rate_d, tensors:[Tensor]},
-#              Tensor{name, dtype, dims:[int32], data:[ubyte]})
+# FlatBuffers (nnstreamer.fbs layout)
 # ---------------------------------------------------------------------------- #
 
 _SLOT = lambda i: 4 + 2 * i  # vtable offset of field slot i
@@ -77,20 +140,22 @@ _SLOT = lambda i: 4 + 2 * i  # vtable offset of field slot i
 
 def frame_to_flatbuf(buf: Buffer, config: TensorsConfig = None) -> bytes:
     rate = config.rate if config is not None and config.rate else Fraction(0, 1)
+    fmt = config.info.format if config is not None else TensorFormat.STATIC
     b = flatbuffers.Builder(1024)
     tensor_offs = []
     for m in buf.memories:
         name = b.CreateString(m.info.name or "")
-        dtype = b.CreateString(str(m.info.dtype))
         data = b.CreateByteVector(m.tobytes())
-        dims = m.info.dims
+        dims = _padded_dims(m.info)
         b.StartVector(4, len(dims), 4)
         for d in reversed(dims):
-            b.PrependInt32(int(d))
+            b.PrependUint32(int(d))
         dims_off = b.EndVector()
+        # table Tensor { name:0, type:1 (default NNS_END=10),
+        #               dimension:2, data:3 }
         b.StartObject(4)
         b.PrependUOffsetTRelativeSlot(0, name, 0)
-        b.PrependUOffsetTRelativeSlot(1, dtype, 0)
+        b.PrependInt32Slot(1, _dtype_enum(m.info), 10)
         b.PrependUOffsetTRelativeSlot(2, dims_off, 0)
         b.PrependUOffsetTRelativeSlot(3, data, 0)
         tensor_offs.append(b.EndObject())
@@ -98,10 +163,15 @@ def frame_to_flatbuf(buf: Buffer, config: TensorsConfig = None) -> bytes:
     for off in reversed(tensor_offs):
         b.PrependUOffsetTRelative(off)
     tvec = b.EndVector()
-    b.StartObject(3)
-    b.PrependInt32Slot(0, rate.numerator, 0)
-    b.PrependInt32Slot(1, rate.denominator, 0)
+    # table Tensors { num_tensor:0, fr:1 (inline struct), tensor:2, format:3 }
+    b.StartObject(4)
+    b.PrependInt32Slot(0, len(tensor_offs), 0)
+    b.Prep(4, 8)  # struct frame_rate { rate_n:int; rate_d:int }
+    b.PrependInt32(rate.denominator)
+    b.PrependInt32(rate.numerator)
+    b.PrependStructSlot(1, b.Offset(), 0)
     b.PrependUOffsetTRelativeSlot(2, tvec, 0)
+    b.PrependInt32Slot(3, _FORMAT_TO_ENUM.get(fmt, 0), 0)
     b.Finish(b.EndObject())
     return bytes(b.Output())
 
@@ -115,18 +185,27 @@ def flatbuf_to_frame(data: bytes) -> Tuple[Buffer, Fraction]:
         o = tab.Offset(_SLOT(slot))
         return tab.Get(N.Int32Flags, o + tab.Pos) if o else default
 
-    rate = Fraction(i32(root, 0), max(i32(root, 1), 1))
+    # fr: inline frame_rate struct at slot 1
+    fo = root.Offset(_SLOT(1))
+    if fo:
+        rate_n = root.Get(N.Int32Flags, fo + root.Pos)
+        rate_d = root.Get(N.Int32Flags, fo + root.Pos + 4)
+    else:
+        rate_n, rate_d = 0, 0
+    rate = Fraction(rate_n, max(rate_d, 1))
+    num = i32(root, 0)
     mems: List[TensorMemory] = []
     o = root.Offset(_SLOT(2))
     n = root.VectorLen(o) if o else 0
+    if num and num != n:
+        raise ValueError(f"flatbuf: num_tensor {num} != vector length {n}")
     for i in range(n):
         t = flatbuffers.table.Table(raw, root.Indirect(root.Vector(o) + 4 * i))
         no = t.Offset(_SLOT(0))
         name = t.String(no + t.Pos).decode() if no else ""
-        do = t.Offset(_SLOT(1))
-        dtype = t.String(do + t.Pos).decode() if do else "uint8"
+        type_enum = i32(t, 1, 10)
         so = t.Offset(_SLOT(2))
-        dims = tuple(t.Get(N.Int32Flags, t.Vector(so) + 4 * j)
+        dims = tuple(t.Get(N.Uint32Flags, t.Vector(so) + 4 * j)
                      for j in range(t.VectorLen(so))) if so else ()
         bo = t.Offset(_SLOT(3))
         if bo:
@@ -134,7 +213,7 @@ def flatbuf_to_frame(data: bytes) -> Tuple[Buffer, Fraction]:
             payload = bytes(raw[start:start + ln])
         else:
             payload = b""
-        info = TensorInfo(dims, TensorDType.parse(dtype), name or None)
+        info = _trimmed_info(dims, type_enum, name)
         if len(payload) != info.size_bytes:
             raise ValueError(
                 f"flatbuf tensor {i}: {len(payload)} payload bytes for "
@@ -160,7 +239,7 @@ class _SerializeDecoder(Decoder):
 
 @register_decoder
 class FlexBufDecoder(_SerializeDecoder):
-    """tensors → FlexBuffers blobs (tensordec-flexbuf.cc analog)."""
+    """tensors → FlexBuffers blobs (tensordec-flexbuf.cc layout)."""
 
     MODE = "flexbuf"
     ENCODE = staticmethod(frame_to_flexbuf)
@@ -168,7 +247,7 @@ class FlexBufDecoder(_SerializeDecoder):
 
 @register_decoder
 class FlatBufDecoder(_SerializeDecoder):
-    """tensors → FlatBuffers frames (tensordec-flatbuf.cc analog)."""
+    """tensors → FlatBuffers frames (nnstreamer.fbs layout)."""
 
     MODE = "flatbuf"
     ENCODE = staticmethod(frame_to_flatbuf)

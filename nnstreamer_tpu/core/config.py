@@ -33,6 +33,7 @@ _ENV_PATH_KEYS = {
 #: model file extension → ordered backend priority (framework auto-detect;
 #: nnstreamer_conf framework_priority_* + tensor_filter_common.c:1153-1260)
 DEFAULT_FRAMEWORK_PRIORITY: Dict[str, List[str]] = {
+    ".jaxexport": ["xla-tpu"],
     ".jax": ["xla-tpu"],
     ".stablehlo": ["xla-tpu"],
     ".mlir": ["xla-tpu"],

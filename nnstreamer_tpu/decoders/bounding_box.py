@@ -217,10 +217,10 @@ class BoundingBox(Decoder):
         if isinstance(token, tuple):
             buf, rows_mem = token
             rows = rows_mem.host()
-            # device reduce already thresholded + NMS'd; suppressed slots
-            # carry score -1
+            # device reduce already thresholded + NMS'd (suppressed slots
+            # carry score -1); don't pay the O(K²) host NMS again
             objs = rows[rows[:, 4] >= self.threshold]
-            return self._finish(objs, buf)
+            return self._finish(objs, buf, suppressed=True)
         return self.decode(token, config)
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
@@ -235,8 +235,10 @@ class BoundingBox(Decoder):
             raise ValueError(f"bounding_box: unknown mode {self.box_mode!r}")
         return self._finish(objs, buf)
 
-    def _finish(self, objs: np.ndarray, buf: Buffer) -> Buffer:
-        objs = nms(objs, self.iou_threshold)
+    def _finish(self, objs: np.ndarray, buf: Buffer,
+                suppressed: bool = False) -> Buffer:
+        if not suppressed:
+            objs = nms(objs, self.iou_threshold)
         canvas = new_canvas(self.out_w, self.out_h)
         detections = []
         for x0, y0, x1, y1, score, cls in objs:

@@ -124,23 +124,43 @@ for ch, rows in _ALPHA.items():
     _deffont(ch, ["00000", "00000"] + rows if len(rows) == 5 else rows)
 
 
-def draw_text(canvas: np.ndarray, x: int, y: int, text: str,
-              color: Sequence[int] = (255, 255, 255, 255)) -> None:
-    h, w = canvas.shape[:2]
-    c = np.asarray(color, np.uint8)
-    cx = x
-    for ch in text.lower():
+#: rendered-text sprite cache: text → mask (7,W) bool (color-independent;
+#: the color applies at blit time). Rendering glyph bitmaps per character
+#: per frame is Python-loop-bound; labels repeat across frames, so each
+#: unique string rasterizes once and then blits.
+_SPRITES: Dict[str, np.ndarray] = {}
+
+
+def _text_mask(text: str) -> np.ndarray:
+    mask = np.zeros((7, 6 * len(text)), bool)
+    for i, ch in enumerate(text.lower()):
         glyph = _FONT.get(ch)
         if glyph is None:
-            cx += 6
             continue
         for ry, rowbits in enumerate(glyph):
             for rx in range(5):
                 if rowbits & (1 << (4 - rx)):
-                    px, py = cx + rx, y + ry
-                    if 0 <= px < w and 0 <= py < h:
-                        canvas[py, px] = c
-        cx += 6
+                    mask[ry, i * 6 + rx] = True
+    return mask
+
+
+def draw_text(canvas: np.ndarray, x: int, y: int, text: str,
+              color: Sequence[int] = (255, 255, 255, 255)) -> None:
+    if not text:
+        return
+    mask = _SPRITES.get(text)
+    if mask is None:
+        if len(_SPRITES) > 4096:  # unbounded label sets stay bounded
+            _SPRITES.clear()
+        mask = _SPRITES[text] = _text_mask(text)
+    h, w = canvas.shape[:2]
+    mh, mw = mask.shape
+    x0, y0 = max(x, 0), max(y, 0)
+    x1, y1 = min(x + mw, w), min(y + mh, h)
+    if x0 >= x1 or y0 >= y1:
+        return
+    sub = mask[y0 - y:y1 - y, x0 - x:x1 - x]
+    canvas[y0:y1, x0:x1][sub] = np.asarray(color, np.uint8)
 
 
 # --------------------------------------------------------------------------- #

@@ -41,6 +41,12 @@ class TensorAggregator(Element):
         self._out_config: Optional[TensorsConfig] = None
 
     def start(self) -> None:
+        if int(self.frames_out) < 1 or int(self.frames_in) < 1:
+            raise ValueError(
+                f"tensor_aggregator: frames_in/frames_out must be >= 1 "
+                f"(got {self.frames_in}/{self.frames_out})")
+        if int(self.frames_flush) < 0:
+            raise ValueError("tensor_aggregator: frames_flush must be >= 0")
         self._window.clear()
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:

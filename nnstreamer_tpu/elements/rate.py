@@ -47,10 +47,14 @@ class TensorRate(Element):
     @property
     def _rate(self) -> Fraction:
         r = self.framerate
-        if isinstance(r, str) and "/" in r:
-            n, d = r.split("/")
-            return Fraction(int(n), int(d))
-        return Fraction(r)
+        try:
+            if isinstance(r, str) and "/" in r:
+                n, d = r.split("/")
+                return Fraction(int(n), int(d))
+            return Fraction(r)
+        except (ValueError, ZeroDivisionError, TypeError) as e:
+            raise ValueError(
+                f"tensor_rate: bad framerate {r!r} (want N/D or number): {e}")
 
     @property
     def _interval_ns(self) -> int:
@@ -60,6 +64,7 @@ class TensorRate(Element):
         return int(NS_PER_SEC / rate)
 
     def start(self) -> None:
+        self._interval_ns  # validate framerate eagerly (prop errors at start)
         self.n_in = self.n_out = self.n_dup = self.n_drop = 0
         self._next_ts = None
         self._prev = None

@@ -69,14 +69,28 @@ def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> Model
             pass
         return ModelBundle(getattr(model, "__name__", "model"), model)
     if isinstance(model, str):
+        from ..models import deploy
+
         if model.startswith("zoo://") or not os.path.sep in model and not os.path.exists(model) \
-                and not model.endswith(".py"):
+                and not model.endswith(".py") and not deploy.is_deployable_path(model):
             return get_model(model, **options)
         if model.endswith(".py"):
             return _bundle_from_pyfile(model, options)
+        if model.lower().endswith(deploy.EXPORT_EXTS):
+            return deploy.load_exported(model)
+        if model.lower().endswith(deploy.CKPT_EXTS) or os.path.isdir(model):
+            arch = options.get("arch")
+            if not arch:
+                raise ValueError(
+                    f"checkpoint model {model!r} needs custom=\"arch=...\" "
+                    "(a zoo:// spec or make_model .py) to restore into")
+            arch_opts = {k[5:]: v for k, v in options.items()
+                         if k.startswith("arch_")}
+            return deploy.load_checkpointed(model, arch, **arch_opts)
         raise ValueError(f"xla-tpu: unsupported model file {model!r} "
-                         "(use zoo://, a .py exporting make_model, or an "
-                         "in-process callable)")
+                         "(use zoo://, a .jaxexport artifact, checkpoint "
+                         "params + custom=\"arch=...\", a .py exporting "
+                         "make_model, or an in-process callable)")
     raise ValueError(f"xla-tpu: cannot interpret model {model!r}")
 
 

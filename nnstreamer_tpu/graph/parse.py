@@ -23,7 +23,7 @@ import shlex
 from fractions import Fraction
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core.types import Caps, TensorFormat
+from ..core.types import ANY, Caps, TensorFormat
 from .element import Element, FlowReturn, Pad, make_element, register_element
 from .pipeline import Pipeline
 
@@ -57,9 +57,26 @@ _MEDIA_TYPES = ("video/x-raw", "audio/x-raw", "text/x-raw",
 _INT_FIELDS = {"width", "height", "channels", "rate", "num"}
 
 
+def _split_caps_fields(s: str) -> List[str]:
+    """Split caps on commas outside double quotes (GStreamer quoting for
+    values containing commas, e.g. multi-tensor dimension strings)."""
+    parts, cur, quoted = [], [], False
+    for ch in s:
+        if ch == '"':
+            quoted = not quoted
+            cur.append(ch)
+        elif ch == "," and not quoted:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
 def parse_caps_string(s: str) -> Caps:
     """"video/x-raw,format=RGB,width=640" → Caps."""
-    parts = s.split(",")
+    parts = _split_caps_fields(s)
     media = parts[0].strip()
     if media == "other/tensor":
         media = "other/tensors"
@@ -72,8 +89,8 @@ def parse_caps_string(s: str) -> Caps:
             raise ValueError(f"bad caps field {kv!r} in {s!r}")
         k, v = kv.split("=", 1)
         k = k.strip()
-        v = v.strip().strip('"')
-        v = re.sub(r"^\(\w+\)", "", v)  # drop gst type annotations "(int)3"
+        v = re.sub(r"^\(\w+\)", "", v.strip())  # drop "(int)3" annotations
+        v = v.strip('"')
         if k in ("dimensions", "dimension"):
             k = "dims"
         elif k in ("num_tensors",):
@@ -102,6 +119,8 @@ def _auto_type(v: str) -> Any:
 
 def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
     """Build (and return) a Pipeline from a textual description."""
+    if not description.strip():
+        raise ValueError("empty pipeline description")
     p = pipeline or Pipeline()
     branches = _split_branches(description)
     named: Dict[str, Element] = {}
@@ -161,8 +180,12 @@ def _split_branches(description: str):
             current.append((head, props))
         seg_tokens.clear()
 
-    for tok in tokens:
+    for i, tok in enumerate(tokens):
         if tok == "!":
+            if not seg_tokens and not current:
+                raise ValueError("pipeline link '!' with no upstream element")
+            if i == len(tokens) - 1:
+                raise ValueError("pipeline ends with a dangling '!'")
             flush_segment()
             continue
         # a segment token arriving while another segment is open (no "!"
@@ -186,3 +209,31 @@ def _looks_like_element(tok: str) -> bool:
     if "/" in tok or "," in tok or "=" in tok:
         return False
     return element_class(tok) is not None
+
+
+def caps_to_gst_string(caps: Caps) -> str:
+    """Inverse of ``parse_caps_string`` in GStreamer's annotated syntax
+    (``media,k=(type)v,...``) — the representation carried on external
+    wires (MQTT GstMQTTMessageHdr.gst_caps_str, mqttcommon.h:60)."""
+    from fractions import Fraction as _F
+
+    parts = [caps.media_type]
+    for k, v in sorted(caps.fields.items()):
+        if v is ANY:
+            continue
+        if k == "dims":
+            k = "dimensions"
+        elif k == "num":
+            k = "num_tensors"
+        if isinstance(v, _F):
+            parts.append(f"{k}=(fraction){v.numerator}/{v.denominator}")
+        elif isinstance(v, bool):
+            parts.append(f"{k}=(boolean){'true' if v else 'false'}")
+        elif isinstance(v, int):
+            parts.append(f"{k}=(int){v}")
+        else:
+            vs = str(v)
+            if "," in vs:
+                vs = f'"{vs}"'  # GStreamer quoting for commas
+            parts.append(f"{k}=(string){vs}")
+    return ",".join(parts)

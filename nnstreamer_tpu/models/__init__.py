@@ -1,5 +1,7 @@
 """Model zoo: native JAX/flax models + the ModelBundle contract."""
 
+from .deploy import export_model, load_checkpointed, load_exported
 from .zoo import ModelBundle, get_model, model_names, register_model
 
-__all__ = ["ModelBundle", "get_model", "model_names", "register_model"]
+__all__ = ["ModelBundle", "export_model", "get_model", "load_checkpointed",
+           "load_exported", "model_names", "register_model"]

@@ -77,8 +77,10 @@ def make_deeplab_v3(width: str = "1.0", size: str = "257",
     w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
     model = DeepLabV3(num_classes=nc, width=w,
                       dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
-    variables = model.init(jax.random.PRNGKey(int(seed)),
-                           jnp.zeros((b, hw, hw, 3), jnp.float32))
+    from .zoo import init_variables
+
+    variables = init_variables(model, int(seed),
+                               jnp.zeros((b, hw, hw, 3), jnp.float32))
 
     def apply(params, x):
         if x.dtype == jnp.uint8:

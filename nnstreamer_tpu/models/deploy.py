@@ -1,0 +1,134 @@
+"""Serialized model deployment: ``.jaxexport`` artifacts + checkpoint dirs.
+
+Reference parity: the reference's central deployment story is loading an
+opaque model *file* produced elsewhere (``framework=tflite
+model=foo.tflite`` — tensor_filter_tensorflow_lite.cc:154; extension
+auto-detect tensor_filter_common.c:1153-1260).  The TPU-native equivalent
+is a **jax.export StableHLO artifact**: a params-closed, shape-specialized
+XLA program serialized to one file.  A model exported in one process (or
+on another host, with no access to the defining Python source) deploys in
+a pipeline string as ``tensor_filter framework=xla-tpu model=foo.jaxexport``.
+
+Two deployable forms:
+
+* ``foo.jaxexport`` (also ``.stablehlo``/``.jax``) — ``export_model()``
+  output: the serialized ``jax.export.Exported`` bytes.  Self-describing
+  (input/output avals ride along); exported for both cpu and tpu by
+  default so one artifact serves laptop validation and chip serving.
+* checkpoint params (``.msgpack`` file or orbax directory) +
+  ``custom="arch=zoo://..."`` — weights produced by a training job, glued
+  to a zoo/py architecture at load time (utils/checkpoints).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.types import TensorInfo, TensorsInfo
+from .zoo import ModelBundle
+
+#: extensions treated as serialized jax.export artifacts
+EXPORT_EXTS = (".jaxexport", ".stablehlo", ".jax")
+#: extensions treated as parameter checkpoints needing custom="arch=..."
+CKPT_EXTS = (".msgpack", ".ckpt", ".orbax")
+
+
+def export_model(path: str, model: Any, example_args: Optional[Sequence] = None,
+                 platforms: Tuple[str, ...] = ("cpu", "tpu")) -> None:
+    """Serialize ``model`` (ModelBundle or params-closed callable) to
+    ``path`` as a jax.export artifact runnable on ``platforms``.
+
+    ``example_args`` fixes the input shapes/dtypes (XLA programs are
+    shape-specialized); defaults to zeros of the bundle's ``in_info``.
+    """
+    import jax
+    from jax import export as jexport
+
+    if isinstance(model, ModelBundle):
+        fn = model.fn()
+        if example_args is None:
+            if model.in_info is None:
+                raise ValueError(
+                    "export_model: bundle has no in_info; pass example_args")
+            example_args = [np.zeros(i.shape, i.dtype.np_dtype)
+                            for i in model.in_info]
+    else:
+        fn = model
+        if example_args is None:
+            raise ValueError("export_model: callables need example_args")
+    avals = [jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+             for a in example_args]
+    exported = jexport.export(jax.jit(fn), platforms=tuple(platforms))(*avals)
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+
+
+def _info_from_avals(avals) -> TensorsInfo:
+    infos = []
+    for a in avals:
+        shape = tuple(int(d) for d in a.shape) or (1,)
+        infos.append(TensorInfo.from_shape(shape, np.dtype(a.dtype)))
+    return TensorsInfo(tuple(infos))
+
+
+def load_exported(path: str) -> ModelBundle:
+    """``.jaxexport`` file → ModelBundle (I/O metadata from the artifact's
+    avals; no defining Python source needed)."""
+    from jax import export as jexport
+
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+
+    def apply(*xs):
+        out = exported.call(*xs)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    name = os.path.splitext(os.path.basename(path))[0]
+    return ModelBundle(
+        name, apply,
+        in_info=_info_from_avals(exported.in_avals),
+        out_info=_info_from_avals(exported.out_avals),
+        metadata={"deployed_from": path,
+                  "platforms": tuple(exported.platforms)})
+
+
+def load_checkpointed(path: str, arch: str, **arch_opts: Any) -> ModelBundle:
+    """Checkpoint params (``.msgpack`` / orbax dir) + ``arch=`` spec →
+    ModelBundle with the trained weights swapped in.
+
+    ``arch`` is any model spec the zoo resolves (``zoo://...``) or a
+    ``.py`` file exporting ``make_model`` — the same forms ``model=``
+    accepts for in-source models.
+    """
+    from ..utils.checkpoints import load_variables
+    from .zoo import get_model
+
+    if arch.endswith(".py"):
+        from ..filters.xla import _bundle_from_pyfile
+
+        bundle = _bundle_from_pyfile(arch, arch_opts)
+    else:
+        bundle = get_model(arch, **arch_opts)
+    if bundle.params is None:
+        raise ValueError(
+            f"arch {arch!r} has no parameters to restore into")
+    params = load_variables(path, bundle.params)
+    return ModelBundle(
+        bundle.name, bundle.apply, params=params,
+        in_info=bundle.in_info, out_info=bundle.out_info,
+        preprocess=bundle.preprocess, postprocess=bundle.postprocess,
+        metadata={**bundle.metadata, "deployed_from": path, "arch": arch})
+
+
+def is_deployable_path(path: str) -> bool:
+    """True for model= values the deploy loader owns (serialized artifact
+    or checkpoint params)."""
+    lower = path.lower()
+    if lower.endswith(EXPORT_EXTS) or lower.endswith(CKPT_EXTS):
+        return True
+    return os.path.isdir(path)  # orbax checkpoint directory

@@ -26,7 +26,9 @@ def make_lstm_cell(features: str = "32", input_size: str = "32",
     key = jax.random.PRNGKey(int(seed))
     dummy_x = jnp.zeros((b, inp), jnp.float32)
     carry0 = cell.initialize_carry(key, dummy_x.shape)
-    params = cell.init(key, carry0, dummy_x)
+    from .zoo import init_variables
+
+    params = init_variables(cell, int(seed), carry0, dummy_x)
 
     def apply(p, x, h, c):
         (new_c, new_h), y = cell.apply(p, (c, h), x)

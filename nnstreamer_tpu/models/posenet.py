@@ -49,8 +49,10 @@ def make_posenet(width: str = "1.0", size: str = "257",
     w, hw, k, b = float(width), int(size), int(num_keypoints), int(batch)
     model = PoseNet(num_keypoints=k, width=w,
                     dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
-    variables = model.init(jax.random.PRNGKey(int(seed)),
-                           jnp.zeros((b, hw, hw, 3), jnp.float32))
+    from .zoo import init_variables
+
+    variables = init_variables(model, int(seed),
+                               jnp.zeros((b, hw, hw, 3), jnp.float32))
     out_hw = -(-hw // 16)  # stride-16 feature grid
 
     def apply(params, x):

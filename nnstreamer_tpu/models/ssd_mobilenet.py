@@ -116,8 +116,10 @@ def make_ssd_mobilenet_v2(width: str = "1.0", size: str = "300",
     w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
     model = SSDMobileNetV2(num_classes=nc, width=w,
                            dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
-    dummy = jnp.zeros((b, hw, hw, 3), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(int(seed)), dummy)
+    from .zoo import init_variables
+
+    variables = init_variables(model, int(seed),
+                               jnp.zeros((b, hw, hw, 3), jnp.float32))
     n_anchors = sum(g * g * 6 for g in feature_grid_sizes(hw))
 
     def apply(params, x):

@@ -85,8 +85,10 @@ def make_stream_transformer(layers: str = "2", dim: str = "128",
     model = StreamTransformer(
         layers=int(layers), dim=D, heads=int(heads),
         dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
-    params = model.init(jax.random.PRNGKey(int(seed)),
-                        jnp.zeros((B, L, d_in), jnp.float32))
+    from .zoo import init_variables
+
+    params = init_variables(model, int(seed),
+                            jnp.zeros((B, L, d_in), jnp.float32))
     return ModelBundle(
         "stream_transformer", lambda p, x: model.apply(p, x), params=params,
         in_info=TensorsInfo.from_strings(f"{d_in}:{L}:{B}", "float32"),

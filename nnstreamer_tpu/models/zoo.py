@@ -54,6 +54,17 @@ class ModelBundle:
         return lambda *xs: apply(params, *xs)
 
 
+def init_variables(module: Any, seed: int, *dummies: Any) -> Any:
+    """One-dispatch model init: the whole flax ``init`` traces into a
+    single compiled XLA program. Eager init runs hundreds of tiny device
+    ops — minutes over a high-RTT TPU tunnel; jitted it is one compile +
+    one execute."""
+    import jax
+
+    fn = jax.jit(lambda key: module.init(key, *dummies))
+    return fn(jax.random.PRNGKey(int(seed)))
+
+
 def register_model(name: str, factory: Callable[..., ModelBundle]) -> None:
     with _lock:
         _factories[name.lower()] = factory

@@ -5,9 +5,18 @@ extra/nnstreamer_grpc_* (``service TensorService { rpc SendTensors(stream
 Tensors); rpc RecvTensors(...) }``, nnstreamer.proto; either side may be the
 gRPC server, blocking or async).
 
-Implemented with grpcio's generic handlers (no codegen needed): message body
-is our wire meta-JSON + flex-tensor payload (query/protocol.py), method
-``/nns.TensorService/SendTensors`` (client-streaming push). Elements:
+Implemented with grpcio's generic handlers (no codegen needed); method
+``/nns.TensorService/SendTensors`` (client-streaming push). The message body
+is selected by ``idl=``, mirroring the reference's two IDL builds
+(nnstreamer_grpc_protobuf.cc / nnstreamer_grpc_flatbuf.cc):
+
+  * ``idl=flex`` (default) — our wire meta-JSON + flex-tensor payload
+    (query/protocol.py);
+  * ``idl=protobuf`` — proto/tensors.proto messages (converters/protobuf_io);
+  * ``idl=flatbuf`` — nnstreamer.fbs-layout FlatBuffers frames
+    (converters/fb_io), byte-compatible with the reference schema.
+
+Elements:
 
   * ``tensor_grpc_sink`` — client by default (streams buffers to a server),
     or ``server=true`` to serve RecvTensors pulls.
@@ -35,7 +44,7 @@ SEND_METHOD = "/nns.TensorService/SendTensors"
 RECV_METHOD = "/nns.TensorService/RecvTensors"
 
 
-def _encode(buf: Buffer) -> bytes:
+def _encode_flex(buf: Buffer) -> bytes:
     import json
 
     meta, payload = buffer_to_payload(buf)
@@ -43,12 +52,34 @@ def _encode(buf: Buffer) -> bytes:
     return struct.pack("<I", len(meta_b)) + meta_b + payload
 
 
-def _decode(raw: bytes) -> Buffer:
+def _decode_flex(raw: bytes) -> Buffer:
     import json
 
     (mlen,) = struct.unpack_from("<I", raw)
     meta = json.loads(raw[4:4 + mlen])
     return payload_to_buffer(meta, raw[4 + mlen:])
+
+
+def _codec(idl: str):
+    """(encode, decode) pair for an IDL name."""
+    idl = (idl or "flex").lower()
+    if idl == "flex":
+        return _encode_flex, _decode_flex
+    if idl == "protobuf":
+        from ..converters.protobuf_io import frame_to_proto, proto_to_frame
+
+        return frame_to_proto, proto_to_frame
+    if idl == "flatbuf":
+        from ..converters.fb_io import flatbuf_to_frame, frame_to_flatbuf
+
+        def enc(buf: Buffer) -> bytes:
+            return frame_to_flatbuf(buf, buf.config)
+
+        def dec(raw: bytes) -> Buffer:
+            return flatbuf_to_frame(raw)[0]
+
+        return enc, dec
+    raise ValueError(f"grpc: unknown idl {idl!r} (flex/protobuf/flatbuf)")
 
 
 @register_element
@@ -59,7 +90,9 @@ class TensorGrpcSrc(SourceElement):
         self.host = "127.0.0.1"
         self.port = 55115
         self.server = True
+        self.idl = "flex"
         super().__init__(name, **props)
+        self._encode, self._decode = _codec(self.idl)
         self._inbox: "_q.Queue[Buffer]" = _q.Queue(maxsize=64)
         self._grpc_server = None
 
@@ -80,7 +113,7 @@ class TensorGrpcSrc(SourceElement):
                 if handler_call_details.method == SEND_METHOD:
                     def send_tensors(request_iterator, context):
                         for raw in request_iterator:
-                            element._inbox.put(_decode(raw))
+                            element._inbox.put(element._decode(raw))
                         return b""
 
                     return grpc.stream_unary_rpc_method_handler(
@@ -108,7 +141,7 @@ class TensorGrpcSrc(SourceElement):
         def pull() -> None:
             try:
                 for raw in stream(b""):
-                    self._inbox.put(_decode(raw))
+                    self._inbox.put(self._decode(raw))
             except grpc.RpcError as e:
                 log.warning("grpc pull ended: %s", e)
 
@@ -138,7 +171,9 @@ class TensorGrpcSink(Element):
         self.host = "127.0.0.1"
         self.port = 55115
         self.server = False
+        self.idl = "flex"
         super().__init__(name, **props)
+        self._encode, self._decode = _codec(self.idl)
         self.add_sink_pad(template=Caps.any_tensors())
         self._outq: "_q.Queue[Optional[bytes]]" = _q.Queue(maxsize=64)
         self._call_thread: Optional[threading.Thread] = None
@@ -199,7 +234,7 @@ class TensorGrpcSink(Element):
         self._call_thread.start()
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        self._outq.put(_encode(buf))
+        self._outq.put(self._encode(buf))
         return FlowReturn.OK
 
     def stop(self) -> None:

@@ -49,10 +49,24 @@ class Cmd(enum.IntEnum):
     ERROR = 6
     PING = 7
     PONG = 8
+    # chunked transfer (reference TRANSFER_START/DATA/END,
+    # tensor_query_common.h:42-68): payloads over CHUNK_SIZE stream as
+    # bounded chunks with a per-chunk receive timeout, assembled into one
+    # preallocated buffer (no monolithic send, no unbounded recv stall)
+    CHUNK_START = 9
+    CHUNK_DATA = 10
+    CHUNK_END = 11
 
 
 class QueryProtocolError(RuntimeError):
     pass
+
+
+#: max bytes per wire chunk; also the granularity of receive timeouts
+CHUNK_SIZE = 1 << 20
+#: a chunk that doesn't arrive within this window fails the transfer —
+#: per-chunk progress detection instead of one whole-payload stall
+CHUNK_TIMEOUT = 15.0
 
 
 def pack_message(cmd: Cmd, meta: Dict[str, Any], payload: bytes = b"") -> bytes:
@@ -60,7 +74,8 @@ def pack_message(cmd: Cmd, meta: Dict[str, Any], payload: bytes = b"") -> bytes:
     return _HEADER.pack(MAGIC, int(cmd), len(meta_b), len(payload)) + meta_b + payload
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes (list-accumulated; O(n) for large payloads)."""
     chunks = []
     got = 0
     while got < n:
@@ -72,7 +87,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_message(sock: socket.socket) -> Tuple[Cmd, Dict[str, Any], bytes]:
+_recv_exact = recv_exact
+
+
+def _recv_one(sock: socket.socket) -> Tuple[Cmd, Dict[str, Any], bytes]:
     hdr = _recv_exact(sock, _HEADER.size)
     magic, cmd, meta_len, payload_len = _HEADER.unpack(hdr)
     if magic != MAGIC:
@@ -84,9 +102,70 @@ def recv_message(sock: socket.socket) -> Tuple[Cmd, Dict[str, Any], bytes]:
     return Cmd(cmd), meta, payload
 
 
+def recv_message(sock: socket.socket,
+                 chunk_timeout: float = CHUNK_TIMEOUT
+                 ) -> Tuple[Cmd, Dict[str, Any], bytes]:
+    cmd, meta, payload = _recv_one(sock)
+    if cmd is not Cmd.CHUNK_START:
+        return cmd, meta, payload
+    # chunked transfer: assemble into a preallocated buffer under a
+    # per-chunk timeout
+    try:
+        total = int(meta.pop("chunked_total"))
+        inner = Cmd(int(meta.pop("chunked_cmd")))
+    except (KeyError, ValueError) as e:
+        raise QueryProtocolError(f"bad CHUNK_START meta: {e}")
+    if total > MAX_MESSAGE or total < 0:
+        raise QueryProtocolError(f"chunked payload too large: {total}")
+    assembled = bytearray(total)
+    got = 0
+    prev_timeout = sock.gettimeout()
+    sock.settimeout(chunk_timeout)
+    try:
+        while True:
+            try:
+                ccmd, cmeta, chunk = _recv_one(sock)
+            except socket.timeout:
+                raise QueryProtocolError(
+                    f"chunk timeout after {got}/{total} bytes "
+                    f"({chunk_timeout}s without progress)")
+            if ccmd is Cmd.CHUNK_DATA:
+                off = int(cmeta.get("off", -1))
+                if off != got:
+                    # offsets must be strictly sequential: a duplicate or
+                    # overlapping chunk would otherwise inflate the byte
+                    # counter and let a hole pass the completeness check
+                    raise QueryProtocolError(
+                        f"chunk out of order: off={off}, expected {got}")
+                if off + len(chunk) > total:
+                    raise QueryProtocolError(
+                        f"chunk out of bounds: off={off} len={len(chunk)}")
+                assembled[off:off + len(chunk)] = chunk
+                got += len(chunk)
+            elif ccmd is Cmd.CHUNK_END:
+                if got != total:
+                    raise QueryProtocolError(
+                        f"chunked transfer incomplete: {got}/{total} bytes")
+                return inner, meta, bytes(assembled)
+            else:
+                raise QueryProtocolError(
+                    f"unexpected {ccmd.name} inside chunked transfer")
+    finally:
+        sock.settimeout(prev_timeout)
+
+
 def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
                  payload: bytes = b"") -> None:
-    sock.sendall(pack_message(cmd, meta, payload))
+    if len(payload) <= CHUNK_SIZE:
+        sock.sendall(pack_message(cmd, meta, payload))
+        return
+    start = dict(meta, chunked_cmd=int(cmd), chunked_total=len(payload))
+    sock.sendall(pack_message(Cmd.CHUNK_START, start))
+    view = memoryview(payload)
+    for off in range(0, len(payload), CHUNK_SIZE):
+        sock.sendall(pack_message(Cmd.CHUNK_DATA, {"off": off},
+                                  bytes(view[off:off + CHUNK_SIZE])))
+    sock.sendall(pack_message(Cmd.CHUNK_END, {}))
 
 
 # --------------------------------------------------------------------------- #

@@ -1,142 +1,131 @@
-"""Topic pub/sub stream transport (mqttsink/mqttsrc equivalents).
+"""mqttsink / mqttsrc — publish/subscribe streams over real MQTT 3.1.1.
 
-Reference: gst/mqtt/ (3404 LoC; paho-mqtt pub/sub of arbitrary Gst streams
-with a fixed header carrying num_mems/sizes/timestamps + NTP epoch sync,
-mqttcommon.h:29-63). paho isn't in this image, so the broker here is a
-built-in topic-fanout TCP service (``PubSubBroker``); the elements keep the
-reference's semantics:
+Reference: gst/mqtt/ (mqttsink.c / mqttsrc.c, 3404 LoC): arbitrary Gst
+streams ride MQTT PUBLISH messages whose payload is a fixed 1024-byte
+``GstMQTTMessageHdr`` (num_mems, per-memory sizes, base/sent Unix epochs,
+pts/dts/duration, caps string; mqttcommon.h:29-63) followed by the raw
+memory bytes; publisher clocks are NTP-synced (ntputil.c) so subscribers on
+other hosts can compute transit latency.
 
-  * ``mqttsink pub-topic=t``  — publishes every buffer (meta + payload + the
-    publisher's wall-clock epoch, the ntputil analog);
-  * ``mqttsrc sub-topic=t``   — subscribes and re-emits buffers, recording
-    ``mqtt_latency_ns`` (receiver epoch − sender epoch) in buffer meta.
+TPU-native build keeps that contract byte-for-byte (query/mqtt.py
+``MessageHdr``) and speaks genuine MQTT 3.1.1 frames, so any standard
+broker (mosquitto, EMQX, …) — or the built-in ``MqttBroker`` — carries the
+stream, and an upstream nnstreamer subscriber can parse our header.
 
-Wire: length-prefixed frames. SUB: {"op":"sub","topic":t}; PUB frames carry
-{"op":"pub","topic":t,...buffer meta...} + payload.
+Elements:
+  * ``mqttsink pub-topic=t host=… port=…`` — publishes every buffer;
+    ``ntp-sync=true`` (+ ``ntp-host``/``ntp-port``) timestamps with an NTP
+    epoch instead of the system clock;
+  * ``mqttsrc sub-topic=t`` — subscribes (MQTT wildcards ``+``/``#`` work)
+    and re-emits buffers, recording ``mqtt_latency_us`` (receiver epoch −
+    sender epoch) in buffer meta.
 """
 
 from __future__ import annotations
 
-import json
-import queue as _q
-import socket
-import struct
-import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
-from ..core.buffer import Buffer
+import numpy as np
+
+from ..core.buffer import Buffer, TensorMemory
 from ..core.log import logger
 from ..core.types import Caps, TensorFormat
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..graph.pipeline import SourceElement
-from .protocol import buffer_to_payload, payload_to_buffer
+from .mqtt import (
+    MessageHdr,
+    MqttBroker,
+    MqttClient,
+    get_epoch_us,
+)
 
 log = logger("pubsub")
 
-_LEN = struct.Struct("<I")
+#: backward-compatible alias (rounds 1-2 exposed the bespoke broker under
+#: this name; it is now a real MQTT 3.1.1 broker)
+PubSubBroker = MqttBroker
 
 
-def _send_frame(sock: socket.socket, meta: Dict[str, Any], payload: bytes = b"") -> None:
-    meta_b = json.dumps(meta, separators=(",", ":")).encode()
-    sock.sendall(_LEN.pack(len(meta_b)) + meta_b + _LEN.pack(len(payload)) + payload)
+class EpochClock:
+    """Per-element epoch source: one SNTP query at element start pins the
+    offset between the NTP epoch and the local monotonic-ish system clock;
+    per-buffer reads are then a local clock read plus the cached offset.
+    (The reference also syncs once per connection, not per message —
+    mqttsink.c via ntputil; querying NTP in the per-buffer hot path would
+    cap FPS at the NTP RTT.)"""
+
+    def __init__(self, ntp_hosts=None):
+        self._offset_us = get_epoch_us(ntp_hosts) - time.time_ns() // 1000
+
+    def now_us(self) -> int:
+        return time.time_ns() // 1000 + self._offset_us
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    out = b""
-    while len(out) < n:
-        chunk = sock.recv(n - len(out))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        out += chunk
-    return out
+def _buffer_to_mqtt(buf: Buffer, base_epoch_us: int,
+                    clock: EpochClock) -> bytes:
+    """Buffer → GstMQTTMessageHdr + raw memory bytes."""
+    from ..graph.parse import caps_to_gst_string
+
+    from ..core.types import TensorsConfig
+
+    blobs = [m.tobytes() for m in buf.memories]
+    config = buf.config
+    if config is None:  # static per-memory infos still describe the frame
+        config = TensorsConfig(buf.tensors_info)
+    caps = caps_to_gst_string(Caps.tensors(config))
+    hdr = MessageHdr(
+        num_mems=len(blobs),
+        size_mems=tuple(len(b) for b in blobs),
+        base_time_epoch=base_epoch_us,
+        sent_time_epoch=clock.now_us(),
+        duration=buf.duration, dts=buf.dts, pts=buf.pts,
+        caps_str=caps)
+    return hdr.pack() + b"".join(blobs)
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], bytes]:
-    (mlen,) = _LEN.unpack(_recv_exact(sock, 4))
-    meta = json.loads(_recv_exact(sock, mlen) or b"{}")
-    (plen,) = _LEN.unpack(_recv_exact(sock, 4))
-    payload = _recv_exact(sock, plen) if plen else b""
-    return meta, payload
+def _mqtt_to_buffer(payload: bytes,
+                    recv_epoch_us: int) -> Buffer:
+    """GstMQTTMessageHdr + raw memories → Buffer (config from caps_str)."""
+    from ..graph.parse import parse_caps_string
 
-
-class PubSubBroker:
-    """Topic-fanout broker: publishers' frames are copied to every current
-    subscriber of the topic (QoS-0 MQTT semantics)."""
-
-    def __init__(self, host: str = "127.0.0.1", port: int = 1883):
-        self._subs: Dict[str, List[socket.socket]] = {}
-        self._lock = threading.Lock()
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
-        self._listener.listen(32)
-        self._listener.settimeout(0.2)
-        self.host, self.port = self._listener.getsockname()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> "PubSubBroker":
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
-                                        name="pubsub-broker")
-        self._thread.start()
-        return self
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(target=self._conn_loop, args=(conn,),
-                             daemon=True).start()
-
-    def _conn_loop(self, conn: socket.socket) -> None:
-        subscribed: List[str] = []
+    hdr = MessageHdr.unpack(payload)
+    off = 1024
+    config = None
+    infos = None
+    if hdr.caps_str:
         try:
-            while not self._stop.is_set():
-                meta, payload = _recv_frame(conn)
-                op = meta.get("op")
-                topic = str(meta.get("topic", ""))
-                if op == "sub":
-                    with self._lock:
-                        self._subs.setdefault(topic, []).append(conn)
-                    subscribed.append(topic)
-                elif op == "pub":
-                    with self._lock:
-                        targets = list(self._subs.get(topic, []))
-                    dead = []
-                    for s in targets:
-                        try:
-                            _send_frame(s, meta, payload)
-                        except OSError:
-                            dead.append(s)
-                    if dead:
-                        with self._lock:
-                            for s in dead:
-                                for subs in self._subs.values():
-                                    if s in subs:
-                                        subs.remove(s)
-        except (ConnectionError, OSError):
-            pass
-        finally:
-            with self._lock:
-                for t in subscribed:
-                    if conn in self._subs.get(t, []):
-                        self._subs[t].remove(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            caps = parse_caps_string(hdr.caps_str)
+            if caps.media_type == "other/tensors" \
+                    and caps.get("dims") is not None:
+                config = caps.to_config()
+                infos = list(config.info)
+        except (ValueError, KeyError):
+            log.warning("unparsable caps in MQTT header: %r", hdr.caps_str)
+    mems: List[TensorMemory] = []
+    for i, size in enumerate(hdr.size_mems):
+        blob = payload[off:off + size]
+        if len(blob) != size:
+            raise ValueError(
+                f"MQTT payload truncated: memory {i} wants {size} bytes, "
+                f"{len(blob)} left")
+        off += size
+        if infos is not None and i < len(infos):
+            mems.append(TensorMemory.from_bytes(blob, infos[i]))
+        else:
+            mems.append(TensorMemory(np.frombuffer(
+                bytearray(blob), np.uint8)))
+    buf = Buffer(mems, pts=hdr.pts, dts=hdr.dts, duration=hdr.duration,
+                 config=config)
+    buf.meta["mqtt_latency_us"] = recv_epoch_us - hdr.sent_time_epoch
+    buf.meta["mqtt_base_epoch_us"] = hdr.base_time_epoch
+    return buf
 
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+
+def _parse_ntp_hosts(el: Any) -> Optional[Sequence[Tuple[str, int]]]:
+    if not getattr(el, "ntp_sync", False):
+        return None
+    return [(str(el.ntp_host), int(el.ntp_port))]
 
 
 @register_element
@@ -147,28 +136,37 @@ class MqttSink(Element):
         self.host = "127.0.0.1"
         self.port = 1883
         self.pub_topic = "nns/stream"
+        self.client_id = ""
+        self.keep_alive = 60
+        self.ntp_sync = False
+        self.ntp_host = "pool.ntp.org"
+        self.ntp_port = 123
         super().__init__(name, **props)
         self.add_sink_pad()
-        self._sock: Optional[socket.socket] = None
+        self._client: Optional[MqttClient] = None
+        self._base_epoch_us = 0
+        self._clock: Optional[EpochClock] = None
 
     def start(self) -> None:
-        self._sock = socket.create_connection((self.host, int(self.port)),
-                                              timeout=5)
+        cid = self.client_id or f"nns_tpu_sink_{id(self) & 0xFFFF:04x}"
+        self._client = MqttClient(self.host, int(self.port), cid,
+                                  int(self.keep_alive))
+        self._clock = EpochClock(_parse_ntp_hosts(self))
+        self._base_epoch_us = self._clock.now_us()
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        meta, payload = buffer_to_payload(buf)
-        meta.update({"op": "pub", "topic": self.pub_topic,
-                     "sent_epoch_ns": time.time_ns()})
-        _send_frame(self._sock, meta, payload)
+        payload = _buffer_to_mqtt(buf, self._base_epoch_us, self._clock)
+        try:
+            self._client.publish(self.pub_topic, payload)
+        except OSError as e:
+            log.error("mqttsink publish failed: %s", e)
+            return FlowReturn.ERROR
         return FlowReturn.OK
 
     def stop(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
 
 
 @register_element
@@ -179,36 +177,41 @@ class MqttSrc(SourceElement):
         self.host = "127.0.0.1"
         self.port = 1883
         self.sub_topic = "nns/stream"
+        self.client_id = ""
+        self.keep_alive = 60
+        self.ntp_sync = False
+        self.ntp_host = "pool.ntp.org"
+        self.ntp_port = 123
         super().__init__(name, **props)
-        self._sock: Optional[socket.socket] = None
+        self._client: Optional[MqttClient] = None
+        self._clock: Optional[EpochClock] = None
 
     def negotiate(self) -> Caps:
-        self._sock = socket.create_connection((self.host, int(self.port)),
-                                              timeout=5)
-        _send_frame(self._sock, {"op": "sub", "topic": self.sub_topic})
-        self._sock.settimeout(0.2)
+        cid = self.client_id or f"nns_tpu_src_{id(self) & 0xFFFF:04x}"
+        self._client = MqttClient(self.host, int(self.port), cid,
+                                  int(self.keep_alive))
+        self._client.subscribe(self.sub_topic)
+        self._clock = EpochClock(_parse_ntp_hosts(self))
         return Caps.tensors(format=TensorFormat.FLEXIBLE)
 
     def create(self) -> Optional[Buffer]:
         while not self._stop_flag.is_set():
             try:
-                meta, payload = _recv_frame(self._sock)
-            except socket.timeout:
-                continue
+                got = self._client.recv_publish(timeout=0.2)
             except (ConnectionError, OSError):
                 return None
-            buf = payload_to_buffer(meta, payload)
-            sent = meta.get("sent_epoch_ns")
-            if sent is not None:
-                buf.meta["mqtt_latency_ns"] = time.time_ns() - sent
-            return buf
+            if got is None:
+                continue
+            _topic, payload = got
+            try:
+                return _mqtt_to_buffer(payload, self._clock.now_us())
+            except ValueError as e:
+                log.warning("mqttsrc dropped malformed message: %s", e)
+                continue
         return None
 
     def stop(self) -> None:
         super().stop()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None

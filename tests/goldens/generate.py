@@ -1,0 +1,157 @@
+"""Golden fixture generator for bit-exact decoder tests.
+
+Mirrors the reference's golden-compare SSAT discipline
+(tests/nnstreamer_decoder_boundingbox/runTest.sh: decode a frozen input,
+byte-compare the rendered output). Inputs are seeded-deterministic; outputs
+are the decoders' exact RGBA/text bytes at generation time, committed as
+``goldens.npz``. The test re-decodes and byte-compares — any silent
+draw/NMS/palette/scaling regression breaks it.
+
+Regenerate (ONLY after an intentional, reviewed behavior change):
+    python tests/goldens/generate.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_cases():
+    """[(name, mode, options, input_arrays, config)] — all host-path."""
+    from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+
+    rng = np.random.default_rng(20260729)
+    cases = []
+
+    # -- bounding_box: mobilenet-ssd (priors + raw head) -------------------- #
+    priors_path = os.path.join(HERE, "box_priors_96.txt")
+    n_anchors = write_box_priors(priors_path, size=96)
+    labels_path = os.path.join(HERE, "labels6.txt")
+    with open(labels_path, "w") as f:
+        f.write("\n".join(f"class{i}" for i in range(6)))
+    locs = rng.normal(size=(1, n_anchors, 4)).astype(np.float32)
+    scores = (rng.normal(size=(1, n_anchors, 6)) * 4).astype(np.float32)
+    cases.append((
+        "bbox_mobilenet_ssd", "bounding_box",
+        {1: "mobilenet-ssd", 2: labels_path, 3: priors_path,
+         4: "96:96", 5: "96:96"},
+        [locs, scores],
+        TensorsConfig(TensorsInfo.from_strings(
+            f"4:{n_anchors}:1,6:{n_anchors}:1", "float32,float32"))))
+
+    # -- bounding_box: mobilenet-ssd-postprocess ---------------------------- #
+    boxes = rng.uniform(0, 0.6, size=(1, 8, 4)).astype(np.float32)
+    boxes[..., 2:] += 0.3
+    classes = rng.integers(0, 6, (1, 8)).astype(np.float32)
+    det_scores = rng.uniform(0.3, 0.95, (1, 8)).astype(np.float32)
+    count = np.asarray([6], np.float32)
+    cases.append((
+        "bbox_postprocess", "bounding_box",
+        {1: "mobilenet-ssd-postprocess", 2: labels_path, 4: "128:128",
+         5: "128:128"},
+        [boxes, classes, det_scores, count],
+        TensorsConfig(TensorsInfo.from_strings(
+            "4:8:1,8:1,8:1,1", "float32,float32,float32,float32"))))
+
+    # -- bounding_box: ov-person-detection ---------------------------------- #
+    rows = np.zeros((1, 4, 7), np.float32)
+    for i in range(4):
+        x0, y0 = rng.uniform(0, 0.5, 2)
+        rows[0, i] = [0, i % 3, 0.4 + 0.15 * i, x0, y0, x0 + 0.3, y0 + 0.4]
+    rows[0, 3, 0] = -1  # terminator row (image_id < 0)
+    cases.append((
+        "bbox_ov_person", "bounding_box",
+        {1: "ov-person-detection", 2: labels_path, 4: "96:96", 5: "96:96"},
+        [rows],
+        TensorsConfig(TensorsInfo.from_strings("7:4:1", "float32"))))
+
+    # -- image_segment: all three schemes ----------------------------------- #
+    seg_logits = rng.normal(size=(1, 24, 32, 5)).astype(np.float32)
+    cases.append((
+        "segment_tflite_deeplab", "image_segment", {1: "tflite-deeplab"},
+        [seg_logits],
+        TensorsConfig(TensorsInfo.from_strings("5:32:24:1", "float32"))))
+    seg_ids = rng.integers(0, 5, (1, 24, 32)).astype(np.uint8)
+    cases.append((
+        "segment_snpe_deeplab", "image_segment", {1: "snpe-deeplab"},
+        [seg_ids],
+        TensorsConfig(TensorsInfo.from_strings("32:24:1", "uint8"))))
+    depth = rng.uniform(0.5, 4.0, (1, 24, 32)).astype(np.float32)
+    cases.append((
+        "segment_snpe_depth", "image_segment", {1: "snpe-depth"},
+        [depth],
+        TensorsConfig(TensorsInfo.from_strings("32:24:1", "float32"))))
+
+    # -- pose_estimation: plain + heatmap-offset ---------------------------- #
+    hm = rng.normal(size=(1, 9, 9, 17)).astype(np.float32)
+    cases.append((
+        "pose_plain", "pose_estimation", {1: "96:96", 2: "33:33"},
+        [hm],
+        TensorsConfig(TensorsInfo.from_strings("17:9:9:1", "float32"))))
+    off = rng.normal(size=(1, 9, 9, 34)).astype(np.float32) * 2
+    cases.append((
+        "pose_heatmap_offset", "pose_estimation",
+        {1: "96:96", 2: "33:33", 4: "heatmap-offset"},
+        [hm, off],
+        TensorsConfig(TensorsInfo.from_strings(
+            "17:9:9:1,34:9:9:1", "float32,float32"))))
+
+    # -- image_labeling ------------------------------------------------------ #
+    lab_scores = rng.normal(size=(1, 6)).astype(np.float32)
+    cases.append((
+        "labeling", "image_labeling", {1: labels_path},
+        [lab_scores],
+        TensorsConfig(TensorsInfo.from_strings("6:1", "float32"))))
+
+    # -- font ---------------------------------------------------------------- #
+    text = np.frombuffer(b"hello nns 42", np.uint8).copy()
+    cases.append((
+        "font", "font", {1: "128:32"},
+        [text],
+        TensorsConfig(TensorsInfo.from_strings("12", "uint8"))))
+
+    # -- direct_video -------------------------------------------------------- #
+    vid = rng.integers(0, 255, (1, 8, 12, 3)).astype(np.uint8)
+    cases.append((
+        "direct_video", "direct_video", {},
+        [vid],
+        TensorsConfig(TensorsInfo.from_strings("3:12:8:1", "uint8"))))
+    return cases
+
+
+def decode_case(mode, options, arrays, config):
+    from nnstreamer_tpu.core.buffer import Buffer
+    from nnstreamer_tpu.decoders.base import find_decoder
+
+    d = find_decoder(mode)()
+    d.init(options)
+    return d.decode(Buffer.of(*arrays), config)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {}
+    for name, mode, options, arrays, config in build_cases():
+        decoded = decode_case(mode, options, arrays, config)
+        for i, a in enumerate(arrays):
+            out[f"{name}__in{i}"] = a
+        out[f"{name}__out"] = decoded.memories[0].host()
+    path = os.path.join(HERE, "goldens.npz")
+    np.savez_compressed(path, **out)
+    print(f"wrote {path}: {len(out)} arrays, "
+          f"{os.path.getsize(path) / 1024:.0f} KiB")
+
+
+if __name__ == "__main__":
+    main()

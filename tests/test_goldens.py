@@ -1,0 +1,60 @@
+"""Bit-exact golden decoder tests (VERDICT r2 missing #4).
+
+Reference model: golden-compare SSAT tests
+(tests/nnstreamer_decoder_boundingbox/runTest.sh — decode frozen inputs,
+byte-compare rendered output). Frozen inputs + expected outputs live in
+tests/goldens/goldens.npz (generated once by tests/goldens/generate.py);
+every decode here must reproduce the stored bytes EXACTLY — a silent
+draw/NMS/palette/scaling change fails the suite.
+
+The device submit/complete paths are separately asserted equal to the host
+path (test_model_pipelines.py), so these goldens pin both.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
+NPZ = os.path.join(HERE, "goldens.npz")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from goldens.generate import build_cases, decode_case  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    assert os.path.isfile(NPZ), \
+        "tests/goldens/goldens.npz missing — run tests/goldens/generate.py"
+    return np.load(NPZ)
+
+
+_CASES = build_cases()
+
+
+@pytest.mark.parametrize("case", _CASES, ids=[c[0] for c in _CASES])
+def test_decoder_bit_exact(case, goldens):
+    name, mode, options, arrays, config = case
+    # frozen inputs must equal the committed ones (generator drift guard)
+    for i, a in enumerate(arrays):
+        np.testing.assert_array_equal(
+            a, goldens[f"{name}__in{i}"],
+            err_msg=f"{name}: generated input {i} drifted — generate.py is "
+                    "no longer deterministic")
+    decoded = decode_case(mode, options, arrays, config)
+    got = decoded.memories[0].host()
+    want = goldens[f"{name}__out"]
+    assert got.dtype == want.dtype and got.shape == want.shape, \
+        f"{name}: output {got.dtype}{got.shape} != golden {want.dtype}{want.shape}"
+    np.testing.assert_array_equal(
+        got, want, err_msg=f"{name}: decode output no longer bit-exact")
+
+
+def test_goldens_cover_all_visual_decoders():
+    """Every draw/palette-producing decoder mode has at least one golden."""
+    modes = {c[1] for c in _CASES}
+    assert {"bounding_box", "image_segment", "pose_estimation",
+            "image_labeling", "font", "direct_video"} <= modes

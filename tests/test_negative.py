@@ -1,0 +1,378 @@
+"""Negative-path sweep: malformed inputs must fail loudly, not corrupt.
+
+Mirrors the reference's SSAT expect-fail discipline — e.g.
+tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:74-80 asserts that bad
+properties make the pipeline REFUSE to run (`gstTest ... expect-fail`), and
+unittest_common's parser suites reject malformed dim/type strings. Every
+case here asserts a specific exception type (and usually message) — a
+change that silently accepts garbage breaks this suite.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.core.types import TensorDType, TensorInfo, parse_dimension
+from nnstreamer_tpu.graph import Pipeline, PipelineError
+from nnstreamer_tpu.graph.parse import parse_caps_string, parse_pipeline
+
+
+# --------------------------------------------------------------------------- #
+# type-system parsers (reference unittest_common negative cases)
+# --------------------------------------------------------------------------- #
+
+class TestTypeSystemRejects:
+    @pytest.mark.parametrize("dim", [
+        "", "abc", "3:abc", "3::2", "-1", "3:-2", "0", "3:0:2",
+        ":".join(["2"] * 17),  # above the rank limit (8, TPU-native)
+    ])
+    def test_bad_dimension_strings(self, dim):
+        with pytest.raises((ValueError, TypeError)):
+            parse_dimension(dim)
+
+    @pytest.mark.parametrize("t", ["", "float128", "complex64", "int7",
+                                   "uint128", "bogus"])
+    def test_bad_dtype_names(self, t):
+        with pytest.raises((ValueError, KeyError, TypeError)):
+            TensorDType.parse(t)
+
+    def test_tensor_count_mismatch(self):
+        # a single type broadcasts over N dims (convenience); a >1
+        # mismatched count is an error
+        with pytest.raises(ValueError, match="count mismatch"):
+            TensorsInfo.from_strings("3:2,4:4", "uint8,uint8,uint8")
+
+    def test_more_than_16_tensors_rejected(self):
+        dims = ",".join(["2:2"] * 17)
+        types = ",".join(["uint8"] * 17)
+        with pytest.raises(ValueError):
+            TensorsInfo.from_strings(dims, types)
+
+    def test_from_bytes_wrong_size(self):
+        info = TensorInfo.from_shape((2, 3), np.float32)
+        from nnstreamer_tpu.core.buffer import TensorMemory
+
+        with pytest.raises(ValueError):
+            TensorMemory.from_bytes(b"\x00" * 5, info)
+
+
+# --------------------------------------------------------------------------- #
+# caps / pipeline-string parser
+# --------------------------------------------------------------------------- #
+
+class TestParserRejects:
+    @pytest.mark.parametrize("s", [
+        "video/x-raw,format",            # field without value
+        "other/tensors,dims=3:2",        # static needs types too (to_config)
+    ])
+    def test_bad_caps_strings(self, s):
+        with pytest.raises(ValueError):
+            parse_caps_string(s).to_config()
+
+    @pytest.mark.parametrize("desc", [
+        "",                                     # empty pipeline
+        "nosuchelement ! tensor_sink",          # unknown element
+        "videotestsrc ! nosuchelement",         # unknown downstream
+        "videotestsrc bogus_prop=1 ! tensor_sink",  # unknown property
+        "videotestsrc !",                       # dangling link
+        "! tensor_sink",                        # leading link
+        "videotestsrc ! tee name=t t. ! tensor_sink t2. ! fakesink",  # bad ref
+    ])
+    def test_bad_pipeline_strings(self, desc):
+        with pytest.raises((ValueError, KeyError)):
+            parse_pipeline(desc)
+
+    def test_unlinked_pad_refused_at_run(self):
+        p = Pipeline()
+        p.add_new("videotestsrc", num_buffers=1)
+        p.add_new("tensor_sink")  # never linked
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=10)
+
+
+# --------------------------------------------------------------------------- #
+# tensor_filter property validation
+# --------------------------------------------------------------------------- #
+
+class TestFilterRejects:
+    def test_unknown_framework(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=8, height=8, num_buffers=1)
+        conv = p.add_new("tensor_converter")
+        filt = p.add_new("tensor_filter", framework="tensorrt",
+                         model="x.engine")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, filt, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
+
+    def test_missing_model(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=8, height=8, num_buffers=1)
+        conv = p.add_new("tensor_converter")
+        filt = p.add_new("tensor_filter", framework="xla-tpu")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, filt, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
+
+    def test_nonexistent_model_file(self):
+        from nnstreamer_tpu.filters.xla import resolve_model
+
+        with pytest.raises((ValueError, FileNotFoundError)):
+            resolve_model("/nonexistent/model.jaxexport")
+
+    def test_unknown_zoo_model(self):
+        from nnstreamer_tpu.models.zoo import get_model
+
+        with pytest.raises(ValueError, match="unknown zoo model"):
+            get_model("zoo://not_a_model")
+
+    def test_accelerator_unknown_device_falls_back(self):
+        # reference parse_accl_hw semantics: unknown accelerators fall back
+        # to a default device rather than failing the pipeline
+        # (nnstreamer_plugin_api_filter.h:547-568)
+        from nnstreamer_tpu.filters.base import AcceleratorSpec
+
+        dev = AcceleratorSpec.parse("true:gpu.9999").pick_device()
+        assert dev is not None
+
+    def test_bucket_mixed_shapes_rejected(self):
+        from nnstreamer_tpu.core.buffer import TensorMemory
+        from nnstreamer_tpu.filters.base import FilterProps
+        from nnstreamer_tpu.filters.xla import XLAFilter
+
+        f = XLAFilter()
+        f.open(FilterProps(model="zoo://passthrough", custom="bucket=4"))
+        with pytest.raises(ValueError, match="same-shape"):
+            f.invoke([TensorMemory(np.zeros((2, 2), np.float32)),
+                      TensorMemory(np.zeros((3, 3), np.float32))])
+
+    def test_reload_incompatible_model_rejected(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.filters.base import FilterProps
+        from nnstreamer_tpu.filters.xla import XLAFilter
+
+        f = XLAFilter()
+        f.open(FilterProps(model="zoo://scaler?dims=4:1&types=float32"))
+        f.set_input_info(TensorsInfo.from_strings("4:1", "float32"))
+        with pytest.raises(ValueError, match="reload rejected"):
+            f.reload_model(lambda x: jnp.concatenate([x, x], axis=-1))
+
+    def test_py_model_without_make_model(self, tmp_path):
+        from nnstreamer_tpu.filters.xla import resolve_model
+
+        bad = tmp_path / "m.py"
+        bad.write_text("x = 1\n")
+        with pytest.raises(ValueError, match="make_model"):
+            resolve_model(str(bad))
+
+
+# --------------------------------------------------------------------------- #
+# converter / decoder option validation
+# --------------------------------------------------------------------------- #
+
+class TestBoundaryRejects:
+    def test_decoder_without_mode(self):
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=8, height=8, num_buffers=1)
+        conv = p.add_new("tensor_converter")
+        dec = p.add_new("tensor_decoder")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, dec, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
+
+    def test_decoder_unknown_mode(self):
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+        d = TensorDecoder(mode="not_a_decoder")
+        with pytest.raises(ValueError, match="unknown mode"):
+            d.start()
+
+    def test_bounding_box_requires_priors(self):
+        from nnstreamer_tpu.decoders.base import find_decoder
+
+        d = find_decoder("bounding_box")()
+        d.init({1: "mobilenet-ssd"})
+        cfg = TensorsConfig(TensorsInfo.from_strings(
+            "4:8:1,6:8:1", "float32,float32"))
+        with pytest.raises(ValueError, match="box-priors"):
+            d.decode(Buffer.of(np.zeros((1, 8, 4), np.float32),
+                               np.zeros((1, 8, 6), np.float32)), cfg)
+
+    def test_bounding_box_bad_priors_file(self, tmp_path):
+        from nnstreamer_tpu.decoders.bounding_box import load_box_priors
+
+        f = tmp_path / "p.txt"
+        f.write_text("1 2 3\n")  # needs 4 rows
+        with pytest.raises(ValueError, match="4 rows"):
+            load_box_priors(str(f))
+        with pytest.raises(FileNotFoundError):
+            load_box_priors(str(tmp_path / "nope.txt"))
+
+    def test_image_segment_unknown_scheme(self):
+        from nnstreamer_tpu.decoders.base import find_decoder
+
+        d = find_decoder("image_segment")()
+        d.init({1: "bogus-scheme"})
+        cfg = TensorsConfig(TensorsInfo.from_strings("5:8:8:1", "float32"))
+        with pytest.raises(ValueError, match="unknown scheme"):
+            d.decode(Buffer.of(np.zeros((1, 8, 8, 5), np.float32)), cfg)
+
+    def test_labeling_missing_label_file(self):
+        from nnstreamer_tpu.decoders.base import find_decoder
+
+        d = find_decoder("image_labeling")()
+        with pytest.raises(FileNotFoundError):
+            d.init({1: "/nonexistent/labels.txt"})
+
+    def test_converter_rejects_unknown_video_format(self):
+        p = Pipeline()
+        from fractions import Fraction
+
+        src = p.add_new(
+            "appsrc",
+            caps=Caps("video/x-raw", {"format": "YUY2", "width": 4,
+                                      "height": 4,
+                                      "framerate": Fraction(0, 1)}),
+            data=[np.zeros((4, 4, 2), np.uint8)])
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, conv, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
+
+    def test_transform_bad_mode_option(self):
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        tr = TensorTransform(mode="arithmetic", option="frobnicate:9")
+        with pytest.raises(ValueError):
+            tr.start()
+
+    def test_transform_unknown_mode(self):
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        with pytest.raises(ValueError):
+            tr = TensorTransform(mode="warp", option="x")
+            tr.start()
+
+    def test_flexbuf_truncated_payload(self):
+        pytest.importorskip("flatbuffers")
+        from nnstreamer_tpu.converters.fb_io import (
+            flexbuf_to_frame, frame_to_flexbuf)
+
+        good = frame_to_flexbuf(Buffer.of(np.arange(8, dtype=np.uint8)))
+        with pytest.raises(Exception):
+            flexbuf_to_frame(good[: len(good) // 2])
+
+    def test_flatbuf_payload_size_mismatch(self):
+        pytest.importorskip("flatbuffers")
+        from nnstreamer_tpu.converters import fb_io
+
+        # declare float32 2:2 (16 bytes) but ship 4 bytes
+        import flatbuffers
+
+        b = flatbuffers.Builder(256)
+        name = b.CreateString("")
+        data = b.CreateByteVector(b"\x00" * 4)
+        b.StartVector(4, 4, 4)
+        for d in reversed([2, 2, 1, 1]):
+            b.PrependUint32(d)
+        dims = b.EndVector()
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(0, name, 0)
+        b.PrependInt32Slot(1, 7, 10)  # NNS_FLOAT32
+        b.PrependUOffsetTRelativeSlot(2, dims, 0)
+        b.PrependUOffsetTRelativeSlot(3, data, 0)
+        t = b.EndObject()
+        b.StartVector(4, 1, 4)
+        b.PrependUOffsetTRelative(t)
+        tv = b.EndVector()
+        b.StartObject(4)
+        b.PrependInt32Slot(0, 1, 0)
+        b.PrependUOffsetTRelativeSlot(2, tv, 0)
+        b.Finish(b.EndObject())
+        with pytest.raises(ValueError, match="payload bytes"):
+            fb_io.flatbuf_to_frame(bytes(b.Output()))
+
+    def test_sparse_decode_garbage(self):
+        from nnstreamer_tpu.elements.sparse import sparse_decode
+
+        with pytest.raises(Exception):
+            sparse_decode(b"not a sparse tensor")
+
+    def test_flex_meta_garbage(self):
+        from nnstreamer_tpu.core.meta import unwrap_flex
+
+        with pytest.raises(ValueError):
+            unwrap_flex(b"\x00" * 16)  # too short for the 128-byte header
+
+
+# --------------------------------------------------------------------------- #
+# element property / wiring validation
+# --------------------------------------------------------------------------- #
+
+class TestElementRejects:
+    def test_unknown_property(self):
+        with pytest.raises((ValueError, TypeError)):
+            Pipeline().add_new("videotestsrc", not_a_prop=3)
+
+    def test_aggregator_bad_dims(self):
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+
+        agg = TensorAggregator(frames_out=0)
+        with pytest.raises(ValueError):
+            agg.start()
+
+    def test_mux_bad_sync_mode(self):
+        p = Pipeline()
+        mux = p.add_new("tensor_mux", sync_mode="sometimes")
+        from fractions import Fraction
+
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("2:1", "float32"),
+                            Fraction(30, 1))),
+                        data=[np.zeros((1, 2), np.float32)])
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, mux, sink)
+        with pytest.raises((PipelineError, ValueError, KeyError)):
+            p.run(timeout=30)
+
+    def test_demux_bad_tensorpick(self):
+        from nnstreamer_tpu.elements.mux_demux import TensorDemux
+
+        d = TensorDemux(tensorpick="9")  # out of range for 2-tensor stream
+        cfg = TensorsConfig(TensorsInfo.from_strings("2:1,2:1",
+                                                     "float32,float32"))
+        caps = Caps.tensors(cfg)
+        with pytest.raises((ValueError, IndexError)):
+            d.on_caps(d.sink_pads[0], caps)
+            d.chain(d.sink_pads[0],
+                    Buffer.of(np.zeros((1, 2), np.float32),
+                              np.zeros((1, 2), np.float32)))
+
+    def test_rate_bad_framerate(self):
+        from nnstreamer_tpu.elements.rate import TensorRate
+
+        with pytest.raises((ValueError, ZeroDivisionError)):
+            r = TensorRate(framerate="abc")
+            r.start()
+
+    def test_crop_without_info_pad_data(self):
+        # tensor_crop with only the raw pad linked must refuse negotiation
+        p = Pipeline()
+        from fractions import Fraction
+
+        src = p.add_new("appsrc",
+                        caps=Caps.tensors(TensorsConfig(
+                            TensorsInfo.from_strings("3:8:8:1", "uint8"),
+                            Fraction(30, 1))),
+                        data=[np.zeros((1, 8, 8, 3), np.uint8)])
+        crop = p.add_new("tensor_crop")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, crop, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
