@@ -24,13 +24,17 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 from __future__ import annotations
 
+import faulthandler
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+faulthandler.register(signal.SIGUSR1)  # live stack dump for debugging
 
 #: env overrides let the harness be validated on CPU with a tiny model;
 #: the driver's TPU run uses the defaults
@@ -148,6 +152,7 @@ def _extra_benches(tmpdir: str) -> dict:
     out = {}
     for key, (spec, size, mode, opts) in configs.items():
         try:
+            _mark(f"extra bench {key} starting")
             peak, med = _pipeline_fps(spec, size, mode, opts)
             out[key] = round(peak, 2)
             out[key.replace("_fps", "_fps_median")] = round(med, 2)
@@ -157,7 +162,7 @@ def _extra_benches(tmpdir: str) -> dict:
     return out
 
 
-def _batched_bench() -> dict:
+def _batched_bench(labels_path: str) -> dict:
     """Batched serving (VERDICT r2 #4): same model at batch=8 via the
     converter's frames-per-tensor regrouping; FPS counts source frames."""
     import traceback
@@ -175,7 +180,7 @@ def _batched_bench() -> dict:
         filt = p.add_new("tensor_filter", framework="xla-tpu",
                          model=MODEL + ("&" if "?" in MODEL else "?") + f"batch={batch}")
         dec = p.add_new("tensor_decoder", mode="image_labeling",
-                        async_depth=depth)
+                        option1=labels_path, async_depth=depth)
         sink = p.add_new("tensor_sink")
         arrivals = []
         sink.new_data = lambda buf: arrivals.append(time.monotonic())
@@ -216,6 +221,16 @@ def _cpu_reference() -> float:
     return float("nan")
 
 
+def _mark(msg: str) -> None:
+    import time as _t
+
+    print(f"[bench +{_t.monotonic() - _T0:.0f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+_T0 = time.monotonic()
+
+
 def main() -> None:
     _enable_compile_cache()
     cpu_child = os.environ.get("BENCH_CPU_CHILD") == "1"
@@ -234,6 +249,7 @@ def main() -> None:
         f.write("\n".join(f"label{i}" for i in range(CLASSES)))
         labels_path = f.name
 
+    _mark("latency run (sync) starting")
     # -- latency run (synchronous invokes, per-frame timing) ----------------- #
     lat_frames = [frames[i % len(frames)] for i in range(n_warmup + 64)]
     p, filt, _ = build_pipeline(lat_frames, labels_path, sync=True)
@@ -243,6 +259,7 @@ def main() -> None:
     p.run(timeout=600)
     p50_us = float(np.percentile(np.asarray(lats[n_warmup:]) / 1000.0, 50))
 
+    _mark("throughput run starting")
     # -- throughput run (async dispatch, end-to-end pipeline FPS) ------------ #
     tp_frames = [frames[i % len(frames)] for i in range(n_warmup + n_frames)]
     p2, filt2, sink2 = build_pipeline(tp_frames, labels_path, sync=False)
@@ -259,6 +276,7 @@ def main() -> None:
 
     device = jax.devices()[0]
 
+    _mark("phase-split probes starting")
     # -- instrumentation: per-phase split + MFU ------------------------------ #
     split = flops = mfu_val = None
     try:
@@ -290,6 +308,7 @@ def main() -> None:
         result["mfu"] = round(mfu_val, 6)
 
     if not cpu_child and os.environ.get("BENCH_CPU_REF", "1") != "0":
+        _mark("same-host CPU reference starting")
         cpu_fps = _cpu_reference()
         if np.isfinite(cpu_fps) and cpu_fps > 0:
             result["cpu_reference_fps"] = round(cpu_fps, 2)
@@ -307,7 +326,8 @@ def main() -> None:
 
             with _tf.TemporaryDirectory() as td:
                 result.update(_extra_benches(td))
-            result.update(_batched_bench())
+            _mark("batched bench starting")
+            result.update(_batched_bench(labels_path))
             if flops and result.get("batch8_fps_median"):
                 result["batch8_mfu"] = round(
                     probes.mfu(flops, result["batch8_fps_median"], device)
@@ -317,6 +337,7 @@ def main() -> None:
 
             traceback.print_exc(file=sys.stderr)
         try:
+            _mark("smoke lane starting")
             smoke = probes.tpu_smoke(device)
             result["smoke"] = smoke
             if device.platform != "cpu":

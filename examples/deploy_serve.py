@@ -1,0 +1,44 @@
+"""Train→export→serve deployment flow (the tflite-file analog, TPU-native).
+
+Process A (training side) exports a serialized XLA artifact; process B
+(serving side) loads it by path in a pipeline string — no model Python
+source, no zoo access, no checkpoint surgery at serving time.
+
+Run: python examples/deploy_serve.py
+"""
+
+import os
+import tempfile
+
+from nnstreamer_tpu.graph.parse import parse_pipeline
+from nnstreamer_tpu.models import export_model, get_model
+
+
+def main() -> None:
+    td = tempfile.mkdtemp()
+    path = os.path.join(td, "classifier.jaxexport")
+
+    # --- "training" process: build + export -------------------------------- #
+    bundle = get_model("zoo://mobilenet_v2?width=0.25&size=96&num_classes=10"
+                       "&dtype=float32")
+    export_model(path, bundle)  # cpu+tpu platforms by default
+    print(f"exported {os.path.getsize(path)/1e3:.0f} kB -> {path}")
+
+    # --- "serving" process: pipeline string by file path ------------------- #
+    labels = os.path.join(td, "labels.txt")
+    with open(labels, "w") as f:
+        f.write("\n".join(f"class{i}" for i in range(10)))
+    p = parse_pipeline(
+        f"videotestsrc width=96 height=96 num_buffers=8 pattern=random ! "
+        f"tensor_converter ! "
+        f"tensor_filter framework=xla-tpu model={path} ! "
+        f"tensor_decoder mode=image_labeling option1={labels} ! "
+        f"tensor_sink name=out store=true")
+    p.run(timeout=300)
+    out = p.get_by_name("out")
+    print(f"served {out.num_buffers} frames; "
+          f"first label: {out.buffers[0].meta['label']}")
+
+
+if __name__ == "__main__":
+    main()

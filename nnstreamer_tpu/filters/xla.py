@@ -50,9 +50,22 @@ from .base import FilterFramework, FilterProps, register_filter
 log = logger("xla")
 
 
+#: custom= keys consumed by the filter itself, not by model factories;
+#: stripped before model resolution so identical model specs memoize to one
+#: bundle (and thus one compile) regardless of filter-level settings
+_FILTER_ONLY_OPTS = frozenset(
+    {"sync", "precision", "donate", "bucket", "resize", "arch"})
+
+
+def _model_options(options: Dict[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in options.items()
+            if k not in _FILTER_ONLY_OPTS and not k.startswith("arch_")}
+
+
 def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> ModelBundle:
     """Normalize any accepted model form into a ModelBundle."""
-    options = options or {}
+    raw_options = options or {}
+    options = _model_options(raw_options)
     if isinstance(model, ModelBundle):
         return model
     if isinstance(model, (list, tuple)) and len(model) == 2 and callable(model[0]):
@@ -73,18 +86,18 @@ def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> Model
 
         if model.startswith("zoo://") or not os.path.sep in model and not os.path.exists(model) \
                 and not model.endswith(".py") and not deploy.is_deployable_path(model):
-            return get_model(model, **options)
+            return get_model(model, **options)  # options pre-stripped
         if model.endswith(".py"):
             return _bundle_from_pyfile(model, options)
         if model.lower().endswith(deploy.EXPORT_EXTS):
             return deploy.load_exported(model)
         if model.lower().endswith(deploy.CKPT_EXTS) or os.path.isdir(model):
-            arch = options.get("arch")
+            arch = raw_options.get("arch")
             if not arch:
                 raise ValueError(
                     f"checkpoint model {model!r} needs custom=\"arch=...\" "
                     "(a zoo:// spec or make_model .py) to restore into")
-            arch_opts = {k[5:]: v for k, v in options.items()
+            arch_opts = {k[5:]: v for k, v in raw_options.items()
                          if k.startswith("arch_")}
             return deploy.load_checkpointed(model, arch, **arch_opts)
         raise ValueError(f"xla-tpu: unsupported model file {model!r} "
@@ -190,11 +203,24 @@ class XLAFilter(FilterFramework):
         self._build_jit()
 
     def _build_jit(self) -> None:
+        """Compile (or reuse) the bundle's XLA program. The jit cache
+        lives ON the bundle (metadata) so filters over the same resolved
+        model — e.g. a latency and a throughput pipeline over one
+        memoized zoo spec — share one compile, and the cache dies with
+        the bundle (reload_model swaps bundles; nothing pins old params
+        or executables)."""
         import jax
 
         fn = self._bundle.fn()
         precision = self._precision
         pre = getattr(self, "_fused_pre", None)
+        cache = self._bundle.metadata.setdefault("_jit_cache", {})
+        cache_key = (precision, self._donate,
+                     id(pre) if pre is not None else None)
+        hit = cache.get(cache_key)
+        if hit is not None:
+            self._jitted = hit
+            return
 
         def wrapped(*xs):
             if pre is not None:
@@ -212,6 +238,7 @@ class XLAFilter(FilterFramework):
         if self._donate:
             kw["donate_argnums"] = tuple(range(8))
         self._jitted = jax.jit(wrapped, **kw)
+        cache[cache_key] = self._jitted
 
     def close(self) -> None:
         self._jitted = None

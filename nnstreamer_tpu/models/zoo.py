@@ -55,14 +55,58 @@ class ModelBundle:
 
 
 def init_variables(module: Any, seed: int, *dummies: Any) -> Any:
-    """One-dispatch model init: the whole flax ``init`` traces into a
-    single compiled XLA program. Eager init runs hundreds of tiny device
-    ops — minutes over a high-RTT TPU tunnel; jitted it is one compile +
-    one execute."""
+    """Fast zoo-model initialization.
+
+    On CPU this is flax's exact ``init`` compiled into ONE XLA program
+    (eager init is hundreds of tiny dispatches).  On an accelerator —
+    especially a high-RTT TPU tunnel where even the init *compile* costs
+    minutes — the param pytree comes from ``jax.eval_shape`` (a pure
+    trace: zero device ops) and the values are synthesized host-side with
+    flax-like statistics (lecun-normal kernels, ones for scales/vars,
+    zeros for biases/means).  Zoo weights are untrained placeholders
+    either way; checkpoints (``custom="arch=..."``) replace them for real
+    serving, so value-level init fidelity is not load-bearing while init
+    latency very much is.
+    """
     import jax
 
-    fn = jax.jit(lambda key: module.init(key, *dummies))
-    return fn(jax.random.PRNGKey(int(seed)))
+    key = jax.random.PRNGKey(int(seed))
+    if jax.default_backend() == "cpu":
+        return jax.jit(lambda k: module.init(k, *dummies))(key)
+    shapes = jax.eval_shape(lambda k: module.init(k, *dummies), key)
+    return synthesize_variables(shapes, int(seed))
+
+
+def synthesize_variables(shape_tree: Any, seed: int) -> Any:
+    """ShapeDtypeStruct pytree → numpy params with flax-like statistics,
+    deterministically from ``seed`` (host-side; no device ops)."""
+    import jax
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        shape_tree)
+    out = []
+    for path, leaf in leaves_with_paths:
+        shape = tuple(leaf.shape)
+        dtype = np.dtype(leaf.dtype)
+        name = ""
+        for p in reversed(path):
+            key_attr = getattr(p, "key", None) or getattr(p, "name", None)
+            if isinstance(key_attr, str):
+                name = key_attr.lower()
+                break
+        if "kernel" in name or "embedding" in name:
+            fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else \
+                max(shape[0] if shape else 1, 1)
+            arr = rng.normal(0.0, 1.0 / np.sqrt(max(fan_in, 1)),
+                             shape).astype(dtype)
+        elif "scale" in name or "var" in name:
+            arr = np.ones(shape, dtype)
+        else:  # bias, mean, and anything unrecognized: zeros
+            arr = np.zeros(shape, dtype)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def register_model(name: str, factory: Callable[..., ModelBundle]) -> None:
@@ -76,8 +120,17 @@ def model_names() -> List[str]:
         return sorted(_factories)
 
 
+#: resolved-bundle memo: repeated ``zoo://`` specs (e.g. a latency and a
+#: throughput pipeline over the same model) share one bundle — and through
+#: the filter's jit cache, ONE compile. Skipped when an option references a
+#: filesystem path (checkpoints may change between loads).
+_bundle_memo: Dict[Any, ModelBundle] = {}
+
+
 def get_model(spec: str, **overrides: Any) -> ModelBundle:
     """Resolve "zoo://name?opt=val" or bare "name"."""
+    import os
+
     _ensure_builtin_models()
     s = spec
     if s.startswith("zoo://"):
@@ -92,7 +145,21 @@ def get_model(spec: str, **overrides: Any) -> ModelBundle:
         factory = _factories.get(s.lower())
     if factory is None:
         raise ValueError(f"unknown zoo model {spec!r}; known: {model_names()}")
-    return factory(**opts)
+    cacheable = all(isinstance(v, str) and not os.path.exists(v)
+                    for v in opts.values())
+    key = (s.lower(), tuple(sorted(opts.items()))) if cacheable else None
+    if key is not None:
+        with _lock:
+            hit = _bundle_memo.get(key)
+        if hit is not None:
+            return hit
+    bundle = factory(**opts)
+    if key is not None:
+        with _lock:
+            if len(_bundle_memo) > 64:
+                _bundle_memo.clear()
+            _bundle_memo[key] = bundle
+    return bundle
 
 
 _builtins_loaded = False

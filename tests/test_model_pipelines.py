@@ -305,3 +305,92 @@ def test_bounding_box_device_reduce_overflow_candidates(tmp_path):
     for a, b in zip(h, d):
         assert a["class"] == b["class"]
         np.testing.assert_allclose(a["box"], b["box"], rtol=1e-4, atol=1e-5)
+
+
+def test_batched_serving_frames_per_tensor(tmp_path):
+    """Micro-batched serving (VERDICT r2 #4): converter frames-per-tensor
+    regroups N frames into one (N,...) tensor, the model runs batch=N on
+    one invoke, and image_labeling emits one label per frame."""
+    labels = tmp_path / "l.txt"
+    labels.write_text("\n".join(f"c{i}" for i in range(7)))
+    batch = 4
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=32, height=32,
+                    num_buffers=3 * batch, pattern="random")
+    conv = p.add_new("tensor_converter", frames_per_tensor=batch)
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model="zoo://mobilenet_v2?width=0.25&size=32"
+                           f"&num_classes=7&dtype=float32&batch={batch}")
+    dec = p.add_new("tensor_decoder", mode="image_labeling",
+                    option1=str(labels), async_depth=2)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=180)
+    assert sink.num_buffers == 3
+    for b in sink.buffers:
+        assert len(b.meta["labels"]) == batch
+        assert len(b.meta["label_scores"]) == batch
+
+
+def test_synthesized_init_matches_flax_shapes():
+    """Accelerator-path init (eval_shape + host synthesis) must produce the
+    exact param pytree structure/shapes/dtypes flax init would."""
+    import jax
+
+    from nnstreamer_tpu.models.mobilenet_v2 import MobileNetV2
+    from nnstreamer_tpu.models.zoo import synthesize_variables
+
+    model = MobileNetV2(num_classes=5, width=0.25, dtype=np.float32)
+    key = jax.random.PRNGKey(0)
+    dummy = np.zeros((1, 32, 32, 3), np.float32)
+    real = model.init(key, dummy)
+    shapes = jax.eval_shape(lambda k: model.init(k, dummy), key)
+    synth = synthesize_variables(shapes, 0)
+    real_flat = jax.tree_util.tree_flatten_with_path(real)[0]
+    synth_flat = jax.tree_util.tree_flatten_with_path(synth)[0]
+    assert len(real_flat) == len(synth_flat)
+    for (rp, rv), (sp, sv) in zip(real_flat, synth_flat):
+        assert rp == sp
+        assert np.shape(rv) == np.shape(sv)
+        assert np.asarray(rv).dtype == np.asarray(sv).dtype
+    # kernels have sane scale (not all-zero), norms are identity-ish
+    out = jax.jit(model.apply)(synth, dummy)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_get_model_memoizes_pure_specs(tmp_path):
+    from nnstreamer_tpu.models.zoo import get_model
+
+    a = get_model("zoo://scaler?dims=4:1&types=float32&scale=2")
+    b = get_model("zoo://scaler?dims=4:1&types=float32&scale=2")
+    assert a is b
+    c = get_model("zoo://scaler?dims=4:1&types=float32&scale=3")
+    assert c is not a
+
+
+def test_filter_only_options_do_not_fork_bundles():
+    """custom= options the filter consumes (sync/precision/donate/...) must
+    not leak into model resolution — a latency (sync=true) and a
+    throughput pipeline over the same spec share one bundle and one jit."""
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter, resolve_model
+
+    a = resolve_model("zoo://scaler?dims=4:1&types=float32&scale=2",
+                      {"sync": "true"})
+    b = resolve_model("zoo://scaler?dims=4:1&types=float32&scale=2", {})
+    assert a is b
+    fa, fb = XLAFilter(), XLAFilter()
+    fa.open(FilterProps(model="zoo://scaler?dims=4:1&types=float32&scale=2",
+                        custom="sync=true"))
+    fb.open(FilterProps(model="zoo://scaler?dims=4:1&types=float32&scale=2"))
+    assert fa._jitted is fb._jitted, "jit not shared across filters"
+
+
+def test_get_model_non_string_override_still_resolves():
+    """Non-str overrides (programmatic callers) bypass the memo without
+    crashing on key construction."""
+    from nnstreamer_tpu.models.zoo import get_model
+
+    a = get_model("zoo://scaler?dims=4:1&types=float32", scale=2.5)
+    b = get_model("zoo://scaler?dims=4:1&types=float32", scale=2.5)
+    assert a is not b  # float override -> uncacheable -> fresh bundle

@@ -1,0 +1,163 @@
+"""Exhaustive property sweeps for tensor_transform and tensor_if.
+
+Mirrors the reference's unittest_plugins breadth (per-element property
+matrices: every typecast dtype pair, every arithmetic op, every dimchg
+position pair, every tensor_if operator — gst/nnstreamer/tensor_transform
++ gsttensorif.c), asserted against numpy oracles.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.ops.transform_ops import build
+
+_DTYPES = ["uint8", "int8", "uint16", "int16", "uint32", "int32",
+           "float32", "float64", "int64", "uint64"]
+
+
+class TestTypecastSweep:
+    @pytest.mark.parametrize("src", _DTYPES)
+    @pytest.mark.parametrize("dst", _DTYPES)
+    def test_all_dtype_pairs(self, src, dst):
+        """Reference SSAT typecast sweep: every (src,dst) tensor_type pair
+        must match numpy's astype semantics exactly."""
+        rng = np.random.default_rng(hash((src, dst)) % 2**32)
+        x = (rng.uniform(0, 100, (3, 4))).astype(src)
+        tr = build("typecast", dst)
+        got = np.asarray(tr.fn(x))
+        np.testing.assert_array_equal(got, x.astype(dst))
+        assert got.dtype == np.dtype(dst)
+
+
+class TestArithmeticSweep:
+    @pytest.mark.parametrize("op,expr", [
+        ("add:7", lambda x: x + 7),
+        ("add:-3.5", lambda x: x + np.float32(-3.5)),
+        ("mul:2", lambda x: x * 2),
+        ("mul:0.5", lambda x: x * np.float32(0.5)),
+        ("div:4", lambda x: x / np.float32(4)),
+        ("sub:10", lambda x: x - 10),
+    ])
+    def test_single_ops_float(self, op, expr):
+        x = np.linspace(-5, 5, 12, dtype=np.float32).reshape(3, 4)
+        tr = build("arithmetic", f"typecast:float32,{op}")
+        np.testing.assert_allclose(np.asarray(tr.fn(x)), expr(x), rtol=1e-6)
+
+    @pytest.mark.parametrize("chain,fn", [
+        ("typecast:float32,add:-127.5,div:127.5",
+         lambda x: (x.astype(np.float32) - 127.5) / 127.5),
+        ("typecast:float32,mul:2,add:1,div:3",
+         lambda x: (x.astype(np.float32) * 2 + 1) / 3),
+        ("typecast:float64,sub:1,mul:-1",
+         lambda x: (x.astype(np.float64) - 1) * -1),
+    ])
+    def test_chains(self, chain, fn):
+        x = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+        tr = build("arithmetic", chain)
+        np.testing.assert_allclose(np.asarray(tr.fn(x)), fn(x), rtol=1e-6)
+
+    def test_per_channel_vector_operands(self):
+        # reference arithmetic supports per-channel constant vectors
+        x = np.ones((2, 2, 3), np.float32)
+        tr = build("arithmetic", "typecast:float32,add:1;2;3")
+        got = np.asarray(tr.fn(x))
+        np.testing.assert_allclose(got[..., 0], 2)
+        np.testing.assert_allclose(got[..., 1], 3)
+        np.testing.assert_allclose(got[..., 2], 4)
+
+
+class TestDimchgSweep:
+    @pytest.mark.parametrize("a,b", [(0, 1), (0, 2), (1, 0), (2, 0),
+                                     (1, 2), (2, 1)])
+    def test_move_positions(self, a, b):
+        """dimchg a:b moves reference-dim a to position b (innermost-first
+        dim indexing; tensor_transform.h DIMCHG semantics)."""
+        x = np.arange(2 * 3 * 4, dtype=np.float32).reshape(4, 3, 2)
+        tr = build("dimchg", f"{a}:{b}")
+        got = np.asarray(tr.fn(x))
+        # oracle: numpy moveaxis in reference dim space (axis = rank-1-idx)
+        rank = x.ndim
+        na, nb = rank - 1 - a, rank - 1 - b
+        np.testing.assert_array_equal(got, np.moveaxis(x, na, nb))
+
+    def test_identity(self):
+        x = np.zeros((2, 2), np.float32)
+        np.testing.assert_array_equal(np.asarray(build("dimchg", "0:0").fn(x)), x)
+
+
+class TestTransposeSweep:
+    @pytest.mark.parametrize("perm", ["0:1:2", "1:0:2", "2:1:0", "0:2:1",
+                                      "2:0:1", "1:2:0"])
+    def test_rank3_perms(self, perm):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        tr = build("transpose", perm)
+        idx = [int(v) for v in perm.split(":")]
+        rank = x.ndim
+        np_axes = tuple(rank - 1 - idx[rank - 1 - ax] for ax in range(rank))
+        np.testing.assert_array_equal(np.asarray(tr.fn(x)),
+                                      np.transpose(x, np_axes))
+
+
+class TestStandClampSweep:
+    def test_stand_default_zero_std(self):
+        x = np.full((4, 4), 3.0, np.float32)  # zero variance
+        got = np.asarray(build("stand", "default").fn(x))
+        assert np.all(np.isfinite(got))
+
+    def test_stand_dc_average(self):
+        x = np.arange(8, dtype=np.float32)
+        got = np.asarray(build("stand", "dc-average").fn(x))
+        np.testing.assert_allclose(got, x - x.mean(), rtol=1e-6)
+
+    @pytest.mark.parametrize("lo,hi", [(0, 1), (-1, 1), (10, 20)])
+    def test_clamp_ranges(self, lo, hi):
+        x = np.linspace(-50, 50, 21, dtype=np.float32)
+        got = np.asarray(build("clamp", f"{lo}:{hi}").fn(x))
+        np.testing.assert_allclose(got, np.clip(x, lo, hi))
+
+
+class TestTensorIfOperatorSweep:
+    """All 10 reference operators (gsttensorif.c) through the element."""
+
+    @staticmethod
+    def run_if(value: float, operator: str, option: str):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        p = Pipeline()
+        src = p.add_new(
+            "appsrc",
+            caps=Caps.tensors(TensorsConfig(
+                TensorsInfo.from_strings("4:1", "float32"),
+                Fraction(30, 1))),
+            data=[np.full((1, 4), value, np.float32)])
+        cond = p.add_new("tensor_if", compared_value="TENSOR_AVERAGE_VALUE",
+                         compared_value_option="0", operator=operator,
+                         supplied_value=option, then="PASSTHROUGH",
+                         **{"else": "SKIP"})
+        then_sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, cond, then_sink)
+        p.run(timeout=30)
+        return then_sink.num_buffers == 1
+
+    @pytest.mark.parametrize("op,sv,value,expect", [
+        ("EQ", "5", 5.0, True), ("EQ", "5", 4.0, False),
+        ("NE", "5", 4.0, True), ("NE", "5", 5.0, False),
+        ("GT", "5", 6.0, True), ("GT", "5", 5.0, False),
+        ("GE", "5", 5.0, True), ("GE", "5", 4.9, False),
+        ("LT", "5", 4.0, True), ("LT", "5", 5.0, False),
+        ("LE", "5", 5.0, True), ("LE", "5", 5.1, False),
+        ("RANGE_INCLUSIVE", "2:8", 2.0, True),
+        ("RANGE_INCLUSIVE", "2:8", 9.0, False),
+        ("RANGE_EXCLUSIVE", "2:8", 2.0, False),
+        ("RANGE_EXCLUSIVE", "2:8", 3.0, True),
+        ("NOT_IN_RANGE_INCLUSIVE", "2:8", 9.0, True),
+        ("NOT_IN_RANGE_INCLUSIVE", "2:8", 5.0, False),
+        ("NOT_IN_RANGE_EXCLUSIVE", "2:8", 2.0, True),
+        ("NOT_IN_RANGE_EXCLUSIVE", "2:8", 5.0, False),
+    ])
+    def test_operator_matrix(self, op, sv, value, expect):
+        assert self.run_if(value, op, sv) is expect
