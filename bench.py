@@ -36,6 +36,32 @@ import numpy as np
 
 faulthandler.register(signal.SIGUSR1)  # live stack dump for debugging
 
+#: partial results, flushed by the watchdog if a phase wedges (a stuck TPU
+#: tunnel must degrade the bench to partial numbers, not to rc=124 silence)
+_partial: dict = {}
+
+
+def _arm_watchdog() -> None:
+    budget = float(os.environ.get("BENCH_BUDGET_SECS", "1200"))
+    if budget <= 0:
+        return
+
+    import threading
+
+    def fire() -> None:
+        _partial.setdefault("metric", "mobilenet_v2_224_pipeline_fps")
+        _partial.setdefault("value", None)
+        _partial.setdefault("unit", "frames/sec")
+        _partial.setdefault("vs_baseline", None)
+        _partial["watchdog_timeout_s"] = budget
+        print(json.dumps(_partial), flush=True)
+        faulthandler.dump_traceback(file=sys.stderr)
+        os._exit(3)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+
 #: env overrides let the harness be validated on CPU with a tiny model;
 #: the driver's TPU run uses the defaults
 SIZE = int(os.environ.get("BENCH_SIZE", "224"))
@@ -232,6 +258,7 @@ _T0 = time.monotonic()
 
 
 def main() -> None:
+    _arm_watchdog()
     _enable_compile_cache()
     cpu_child = os.environ.get("BENCH_CPU_CHILD") == "1"
     if cpu_child:
@@ -291,7 +318,8 @@ def main() -> None:
 
         traceback.print_exc(file=sys.stderr)
 
-    result = {
+    result = _partial
+    result.update({
         "metric": f"mobilenet_v2_{SIZE}_pipeline_fps",
         "value": round(fps, 2),
         "unit": "frames/sec",
@@ -299,7 +327,7 @@ def main() -> None:
         "p50_invoke_us": round(p50_us, 1),
         "frames": n_frames,
         "device": str(device),
-    }
+    })
     if split is not None:
         result["split"] = split
     if flops:

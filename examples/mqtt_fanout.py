@@ -53,9 +53,11 @@ def main() -> None:
     rec_p.stop()
     det_p.stop()
     broker.stop()
-    lat = rec_sink.buffers[-1].meta["mqtt_latency_us"]
     print(f"recorder got {rec_sink.num_buffers}, detector got "
-          f"{det_sink.num_buffers}; last transit latency {lat} µs")
+          f"{det_sink.num_buffers}")
+    if rec_sink.buffers:
+        lat = rec_sink.buffers[-1].meta["mqtt_latency_us"]
+        print(f"last transit latency {lat} µs")
 
 
 if __name__ == "__main__":

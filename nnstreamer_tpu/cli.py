@@ -5,7 +5,9 @@
                 tensor_decoder mode=image_labeling option1=labels.txt ! \
                 tensor_sink"
 
-Options: -t/--time limit, -v verbose bus messages, --list-elements.
+Options: -t/--time limit, -v verbose bus messages, --list-elements,
+--inspect ELEMENT (gst-inspect-1.0 analog: pads + properties with their
+defaults, plus registered subplugin modes for filter/decoder/converter).
 """
 
 from __future__ import annotations
@@ -24,6 +26,8 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print bus messages")
     ap.add_argument("--list-elements", action="store_true")
+    ap.add_argument("--inspect", metavar="ELEMENT",
+                    help="describe an element: pads, properties, defaults")
     args = ap.parse_args(argv)
 
     if args.list_elements:
@@ -32,6 +36,8 @@ def main(argv=None) -> int:
         for n in all_element_names():
             print(n)
         return 0
+    if args.inspect:
+        return inspect_element(args.inspect)
     if not args.pipeline:
         ap.error("pipeline description required")
 
@@ -59,6 +65,64 @@ def main(argv=None) -> int:
         p.stop()
     if args.verbose:
         print(f"ran {time.monotonic() - t0:.2f}s", file=sys.stderr)
+    return 0
+
+
+
+
+def inspect_element(name: str) -> int:
+    """gst-inspect-1.0 analog: instantiate the element and report its pads
+    and settable properties with defaults (properties ARE instance
+    attributes here, like GObject props are on the reference elements)."""
+    from .graph.element import Element, element_class
+
+    cls = element_class(name)
+    if cls is None:
+        print(f"unknown element {name!r}", file=sys.stderr)
+        return 1
+    print(f"{name}  ({cls.__module__}.{cls.__qualname__})")
+    doc = (cls.__doc__ or "").strip().splitlines()
+    if doc:
+        print(f"  {doc[0]}")
+    try:
+        el = cls()
+    except Exception as e:  # elements requiring props at construction
+        print(f"  (cannot instantiate without properties: {e})")
+        return 0
+    print("  pads:")
+    for pad in el.sink_pads:
+        print(f"    sink: {pad.name}")
+    for pad in el.src_pads:
+        print(f"    src:  {pad.name}")
+    base = set(dir(Element(name="probe"))) | {"ELEMENT_NAME", "MAX_OPTIONS"}
+    print("  properties:")
+    for attr in sorted(vars(el)):
+        if attr.startswith("_") or attr in base:
+            continue
+        val = getattr(el, attr)
+        if callable(val):
+            continue
+        print(f"    {attr.replace('_', '-')} = {val!r}")
+    from .core.registry import SubpluginType, get_all_subplugins
+
+    if name == "tensor_filter":
+        from .filters.base import find_filter
+
+        find_filter("xla-tpu")  # force built-in registration
+        print("  frameworks: "
+              + ", ".join(sorted(get_all_subplugins(SubpluginType.FILTER))))
+    if name == "tensor_decoder":
+        from .decoders.base import find_decoder
+
+        find_decoder("image_labeling")
+        print("  modes: "
+              + ", ".join(sorted(get_all_subplugins(SubpluginType.DECODER))))
+    if name == "tensor_converter":
+        from .decoders import _ensure_builtin_decoders
+
+        _ensure_builtin_decoders()  # registers serialization converter pairs
+        print("  converter modes: "
+              + ", ".join(sorted(get_all_subplugins(SubpluginType.CONVERTER))))
     return 0
 
 

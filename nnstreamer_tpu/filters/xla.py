@@ -214,13 +214,17 @@ class XLAFilter(FilterFramework):
         fn = self._bundle.fn()
         precision = self._precision
         pre = getattr(self, "_fused_pre", None)
-        cache = self._bundle.metadata.setdefault("_jit_cache", {})
-        cache_key = (precision, self._donate,
-                     id(pre) if pre is not None else None)
-        hit = cache.get(cache_key)
-        if hit is not None:
-            self._jitted = hit
-            return
+        # fused-preprocess programs are per-pipeline objects: caching them
+        # on a (memoized, process-lifetime) bundle would leak one compiled
+        # executable per pipeline construction and never actually share
+        cache = None if pre is not None \
+            else self._bundle.metadata.setdefault("_jit_cache", {})
+        cache_key = (precision, self._donate)
+        if cache is not None:
+            hit = cache.get(cache_key)
+            if hit is not None:
+                self._jitted = hit
+                return
 
         def wrapped(*xs):
             if pre is not None:
@@ -238,7 +242,8 @@ class XLAFilter(FilterFramework):
         if self._donate:
             kw["donate_argnums"] = tuple(range(8))
         self._jitted = jax.jit(wrapped, **kw)
-        cache[cache_key] = self._jitted
+        if cache is not None:
+            cache[cache_key] = self._jitted
 
     def close(self) -> None:
         self._jitted = None

@@ -1,0 +1,95 @@
+"""tensor_converter media-type matrices (reference tensor_converter.c
+parsers: video :1385, audio :1480, text :1564, octet :1634 + SSAT
+nnstreamer_converter groups)."""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.core.types import AUDIO_FORMATS, VIDEO_FORMATS
+from nnstreamer_tpu.graph import Pipeline
+
+
+def run_conv(caps, data, **conv_props):
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps, data=data)
+    conv = p.add_new("tensor_converter", **conv_props)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, sink)
+    p.run(timeout=30)
+    return sink
+
+
+class TestVideoFormatMatrix:
+    @pytest.mark.parametrize("fmt", sorted(VIDEO_FORMATS))
+    def test_every_video_format(self, fmt):
+        ch, dt = VIDEO_FORMATS[fmt]
+        w, h = 6, 4
+        frame = (np.arange(h * w * ch) % 251).astype(dt).reshape(h, w, ch)
+        caps = Caps("video/x-raw", {"format": fmt, "width": w, "height": h,
+                                    "framerate": Fraction(30, 1)})
+        sink = run_conv(caps, [frame])
+        out = sink.buffers[0].memories[0].host()
+        # 3/1-channel paths emit (H,W,C); stride-padded 4-channel paths go
+        # through the padding-removal reshape and emit (1,H,W,C) — both
+        # carry dims C:W:H
+        np.testing.assert_array_equal(out.reshape(h, w, ch), frame)
+        cfg = sink.sink_pad.caps.to_config()
+        # dims innermost-first: C:W:H (batch handled by frames-per-tensor)
+        assert cfg.info[0].dims[0] == ch
+        assert cfg.info[0].dtype.np_dtype == dt
+
+    def test_frames_per_tensor_video(self):
+        w, h = 4, 4
+        frames = [np.full((h, w, 3), i, np.uint8) for i in range(6)]
+        caps = Caps("video/x-raw", {"format": "RGB", "width": w, "height": h,
+                                    "framerate": Fraction(30, 1)})
+        sink = run_conv(caps, frames, frames_per_tensor=3)
+        assert sink.num_buffers == 2
+        got = sink.buffers[0].memories[0].host()
+        assert got.shape == (3, h, w, 3)
+        for i in range(3):
+            np.testing.assert_array_equal(got[i], frames[i])
+
+
+class TestAudioFormatMatrix:
+    @pytest.mark.parametrize("fmt", sorted(AUDIO_FORMATS))
+    def test_every_audio_format(self, fmt):
+        dt = AUDIO_FORMATS[fmt]
+        samples = np.arange(32, dtype=dt).reshape(32, 1)
+        caps = Caps("audio/x-raw", {"format": fmt, "rate": 16000,
+                                    "channels": 1})
+        sink = run_conv(caps, [samples])
+        out = sink.buffers[0].memories[0].host()
+        np.testing.assert_array_equal(out.reshape(-1), samples.reshape(-1))
+        assert out.dtype == dt
+
+    def test_stereo_channels(self):
+        samples = np.arange(16, dtype=np.int16).reshape(8, 2)
+        caps = Caps("audio/x-raw", {"format": "S16LE", "rate": 8000,
+                                    "channels": 2})
+        sink = run_conv(caps, [samples])
+        cfg = sink.sink_pad.caps.to_config()
+        assert cfg.info[0].dims[0] == 2  # channels innermost
+
+
+class TestTextAndOctet:
+    def test_text_fixed_size_padding(self):
+        caps = Caps("text/x-raw", {"format": "utf8"})
+        sink = run_conv(caps, [np.frombuffer(b"hi", np.uint8)],
+                        input_dim="8")
+        out = sink.buffers[0].memories[0].host()
+        assert out.size == 8  # zero-padded to the fixed text size
+        assert bytes(out.reshape(-1)[:2].tobytes()) == b"hi"
+
+    def test_octet_typed_reinterpret(self):
+        payload = np.frombuffer(np.arange(6, dtype=np.float32).tobytes(),
+                                np.uint8)
+        caps = Caps("application/octet-stream")
+        sink = run_conv(caps, [payload], input_dim="3:2",
+                        input_type="float32")
+        out = sink.buffers[0].memories[0].host()
+        np.testing.assert_array_equal(out.reshape(-1),
+                                      np.arange(6, dtype=np.float32))

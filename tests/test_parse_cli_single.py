@@ -148,3 +148,32 @@ class TestSingleShot:
             out, = single.invoke(np.ones((1, 2), np.float32))
             np.testing.assert_array_equal(np.asarray(out),
                                           np.full((1, 2), 7.0, np.float32))
+
+
+class TestInspect:
+    def test_inspect_element_lists_props_and_modes(self, capsys):
+        from nnstreamer_tpu.cli import inspect_element
+
+        assert inspect_element("tensor_decoder") == 0
+        out = capsys.readouterr().out
+        assert "async-depth" in out
+        assert "modes:" in out and "bounding_box" in out
+
+    def test_inspect_filter_lists_frameworks(self, capsys):
+        from nnstreamer_tpu.cli import inspect_element
+
+        assert inspect_element("tensor_filter") == 0
+        out = capsys.readouterr().out
+        assert "xla-tpu" in out
+
+    def test_inspect_converter_lists_modes(self, capsys):
+        from nnstreamer_tpu.cli import inspect_element
+
+        assert inspect_element("tensor_converter") == 0
+        out = capsys.readouterr().out
+        assert "converter modes:" in out and "flexbuf" in out
+
+    def test_inspect_unknown_element(self, capsys):
+        from nnstreamer_tpu.cli import inspect_element
+
+        assert inspect_element("no_such_thing") == 1
