@@ -15,7 +15,10 @@ stream, and an upstream nnstreamer subscriber can parse our header.
 Elements:
   * ``mqttsink pub-topic=t host=… port=…`` — publishes every buffer;
     ``ntp-sync=true`` (+ ``ntp-host``/``ntp-port``) timestamps with an NTP
-    epoch instead of the system clock;
+    epoch instead of the system clock; ``sparse=true`` ships each memory
+    sparse-encoded under ``format=sparse`` caps (the reference's
+    tensor_sparse link compression, §2.5 — pays off on mostly-zero
+    tensors crossing slow links);
   * ``mqttsrc sub-topic=t`` — subscribes (MQTT wildcards ``+``/``#`` work)
     and re-emits buffers, recording ``mqtt_latency_us`` (receiver epoch −
     sender epoch) in buffer meta.
@@ -63,17 +66,23 @@ class EpochClock:
 
 
 def _buffer_to_mqtt(buf: Buffer, base_epoch_us: int,
-                    clock: EpochClock) -> bytes:
-    """Buffer → GstMQTTMessageHdr + raw memory bytes."""
+                    clock: EpochClock, sparse: bool = False) -> bytes:
+    """Buffer → GstMQTTMessageHdr + raw (or sparse-encoded) memory bytes."""
+    from ..core.types import TensorFormat as _TF
+    from ..core.types import TensorsConfig
     from ..graph.parse import caps_to_gst_string
 
-    from ..core.types import TensorsConfig
-
-    blobs = [m.tobytes() for m in buf.memories]
     config = buf.config
     if config is None:  # static per-memory infos still describe the frame
         config = TensorsConfig(buf.tensors_info)
-    caps = caps_to_gst_string(Caps.tensors(config))
+    if sparse:
+        from ..elements.sparse import sparse_encode
+
+        blobs = [sparse_encode(m.host(), m.info) for m in buf.memories]
+        caps = caps_to_gst_string(Caps.tensors(format=_TF.SPARSE))
+    else:
+        blobs = [m.tobytes() for m in buf.memories]
+        caps = caps_to_gst_string(Caps.tensors(config))
     hdr = MessageHdr(
         num_mems=len(blobs),
         size_mems=tuple(len(b) for b in blobs),
@@ -93,13 +102,17 @@ def _mqtt_to_buffer(payload: bytes,
     off = 1024
     config = None
     infos = None
+    is_sparse = False
     if hdr.caps_str:
         try:
             caps = parse_caps_string(hdr.caps_str)
-            if caps.media_type == "other/tensors" \
-                    and caps.get("dims") is not None:
-                config = caps.to_config()
-                infos = list(config.info)
+            if caps.media_type == "other/tensors":
+                from ..core.types import TensorFormat as _TF
+
+                is_sparse = caps.get("format") is _TF.SPARSE
+                if caps.get("dims") is not None:
+                    config = caps.to_config()
+                    infos = list(config.info)
         except (ValueError, KeyError):
             log.warning("unparsable caps in MQTT header: %r", hdr.caps_str)
     mems: List[TensorMemory] = []
@@ -110,7 +123,12 @@ def _mqtt_to_buffer(payload: bytes,
                 f"MQTT payload truncated: memory {i} wants {size} bytes, "
                 f"{len(blob)} left")
         off += size
-        if infos is not None and i < len(infos):
+        if is_sparse:
+            from ..elements.sparse import sparse_decode
+
+            arr, info = sparse_decode(bytes(blob))
+            mems.append(TensorMemory(arr, info))
+        elif infos is not None and i < len(infos):
             mems.append(TensorMemory.from_bytes(blob, infos[i]))
         else:
             mems.append(TensorMemory(np.frombuffer(
@@ -141,6 +159,7 @@ class MqttSink(Element):
         self.ntp_sync = False
         self.ntp_host = "pool.ntp.org"
         self.ntp_port = 123
+        self.sparse = False
         super().__init__(name, **props)
         self.add_sink_pad()
         self._client: Optional[MqttClient] = None
@@ -155,7 +174,8 @@ class MqttSink(Element):
         self._base_epoch_us = self._clock.now_us()
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        payload = _buffer_to_mqtt(buf, self._base_epoch_us, self._clock)
+        payload = _buffer_to_mqtt(buf, self._base_epoch_us, self._clock,
+                                  sparse=bool(self.sparse))
         try:
             self._client.publish(self.pub_topic, payload)
         except OSError as e:

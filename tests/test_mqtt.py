@@ -291,3 +291,52 @@ class TestHeaderLimits:
     def test_pack_rejects_too_many_memories(self):
         with pytest.raises(ValueError, match="GST_MQTT_MAX_NUM_MEMS"):
             mqtt.MessageHdr(num_mems=17, size_mems=tuple(range(17))).pack()
+
+
+class TestSparseLink:
+    def test_sparse_compressed_stream(self):
+        """mqttsink sparse=true ships sparse-encoded memories under
+        format=sparse caps (reference tensor_sparse link compression);
+        subscriber transparently decodes to dense."""
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        broker = mqtt.MqttBroker(port=0).start()
+        try:
+            rp = Pipeline("rx")
+            msrc = rp.add_new("mqttsrc", port=broker.port, sub_topic="s")
+            rsink = rp.add_new("tensor_sink", store=True)
+            Pipeline.link(msrc, rsink)
+            rp.start()
+            time.sleep(0.3)
+
+            dense = np.zeros((64, 64), np.float32)
+            dense[3, 7] = 42.0
+            watcher = mqtt.MqttClient(broker.host, broker.port, "w")
+            watcher.subscribe("s")
+            tp = Pipeline("tx")
+            caps = Caps.tensors(TensorsConfig(
+                TensorsInfo.from_strings("64:64", "float32"),
+                Fraction(30, 1)))
+            src = tp.add_new("appsrc", caps=caps, data=[dense])
+            msink = tp.add_new("mqttsink", port=broker.port, pub_topic="s",
+                               sparse=True)
+            Pipeline.link(src, msink)
+            tp.run(timeout=30)
+
+            got = watcher.recv_publish(timeout=5)
+            assert got is not None
+            hdr = mqtt.MessageHdr.unpack(got[1])
+            assert "sparse" in hdr.caps_str
+            assert hdr.size_mems[0] < dense.nbytes // 4  # compressed
+            deadline = time.monotonic() + 10
+            while rsink.num_buffers < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            rp.stop()
+            np.testing.assert_array_equal(
+                rsink.buffers[0].memories[0].host(), dense)
+            watcher.close()
+        finally:
+            broker.stop()
