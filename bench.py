@@ -175,7 +175,7 @@ def _extra_benches(tmpdir: str) -> dict:
             dict(option1="514:514", option2="257:257",
                  option4="heatmap-offset")),
     }
-    out = _partial  # stream rows in as they land (watchdog-visible)
+    out = {}
     for key, (spec, size, mode, opts) in configs.items():
         try:
             _mark(f"extra bench {key} starting")
@@ -185,6 +185,7 @@ def _extra_benches(tmpdir: str) -> dict:
         except Exception:
             traceback.print_exc(file=sys.stderr)
             out[key] = None
+        _partial.update(out)  # stream rows as they land (watchdog-visible)
     return out
 
 
@@ -215,10 +216,10 @@ def _batched_bench(labels_path: str) -> dict:
         peak, med = _windowed_fps(arrivals, warm, depth, window=16)
         if not np.isfinite(peak):
             return {}
-        _partial.update({"batch8_fps": round(peak * batch, 2),
-                         "batch8_fps_median": round(med * batch, 2)})
-        return {"batch8_fps": round(peak * batch, 2),
-                "batch8_fps_median": round(med * batch, 2)}
+        row = {"batch8_fps": round(peak * batch, 2),
+               "batch8_fps_median": round(med * batch, 2)}
+        _partial.update(row)
+        return row
     except Exception:
         traceback.print_exc(file=sys.stderr)
         return {}

@@ -66,20 +66,25 @@ class EpochClock:
 
 
 def _buffer_to_mqtt(buf: Buffer, base_epoch_us: int,
-                    clock: EpochClock, sparse: bool = False) -> bytes:
+                    clock: EpochClock, sparse: bool = False,
+                    stream_config: Optional[Any] = None) -> bytes:
     """Buffer → GstMQTTMessageHdr + raw (or sparse-encoded) memory bytes."""
     from ..core.types import TensorFormat as _TF
     from ..core.types import TensorsConfig
     from ..graph.parse import caps_to_gst_string
 
-    config = buf.config
+    config = buf.config or stream_config
     if config is None:  # static per-memory infos still describe the frame
         config = TensorsConfig(buf.tensors_info)
     if sparse:
         from ..elements.sparse import sparse_encode
 
         blobs = [sparse_encode(m.host(), m.info) for m in buf.memories]
-        caps = caps_to_gst_string(Caps.tensors(format=_TF.SPARSE))
+        # keep the full stream config (dims/types/rate of the DENSE
+        # tensors) and mark only the payload encoding as sparse
+        c = Caps.tensors(config)
+        c.fields["format"] = _TF.SPARSE
+        caps = caps_to_gst_string(c)
     else:
         blobs = [m.tobytes() for m in buf.memories]
         caps = caps_to_gst_string(Caps.tensors(config))
@@ -108,10 +113,17 @@ def _mqtt_to_buffer(payload: bytes,
             caps = parse_caps_string(hdr.caps_str)
             if caps.media_type == "other/tensors":
                 from ..core.types import TensorFormat as _TF
+                from ..core.types import TensorsConfig as _TC
+                from ..core.types import TensorsInfo as _TI
 
                 is_sparse = caps.get("format") is _TF.SPARSE
                 if caps.get("dims") is not None:
-                    config = caps.to_config()
+                    if is_sparse:  # dims/types describe the dense tensors
+                        info = _TI.from_strings(str(caps.get("dims")),
+                                                str(caps.get("types")))
+                        config = _TC(info, caps.get("framerate") or 0)
+                    else:
+                        config = caps.to_config()
                     infos = list(config.info)
         except (ValueError, KeyError):
             log.warning("unparsable caps in MQTT header: %r", hdr.caps_str)
@@ -165,6 +177,15 @@ class MqttSink(Element):
         self._client: Optional[MqttClient] = None
         self._base_epoch_us = 0
         self._clock: Optional[EpochClock] = None
+        self._stream_config = None
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        if caps.media_type == "other/tensors" \
+                and caps.get("dims") is not None:
+            # negotiated stream config rides the wire header even when
+            # individual buffers don't carry one
+            self._stream_config = caps.to_config()
 
     def start(self) -> None:
         cid = self.client_id or f"nns_tpu_sink_{id(self) & 0xFFFF:04x}"
@@ -175,7 +196,8 @@ class MqttSink(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         payload = _buffer_to_mqtt(buf, self._base_epoch_us, self._clock,
-                                  sparse=bool(self.sparse))
+                                  sparse=bool(self.sparse),
+                                  stream_config=self._stream_config)
         try:
             self._client.publish(self.pub_topic, payload)
         except OSError as e:
@@ -225,7 +247,10 @@ class MqttSrc(SourceElement):
             _topic, payload = got
             try:
                 return _mqtt_to_buffer(payload, self._clock.now_us())
-            except ValueError as e:
+            except Exception as e:  # noqa: BLE001 - untrusted network
+                # input: a corrupt message (bad header, codes, or sparse
+                # indices raising Index/KeyError deep in the codec) must
+                # be dropped, never end the subscription
                 log.warning("mqttsrc dropped malformed message: %s", e)
                 continue
         return None

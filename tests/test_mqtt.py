@@ -340,3 +340,54 @@ class TestSparseLink:
             watcher.close()
         finally:
             broker.stop()
+
+    def test_sparse_preserves_config_and_survives_corruption(self):
+        """Sparse wire carries the dense dims/types/rate; a corrupt sparse
+        message is dropped, not fatal to the subscription."""
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        broker = mqtt.MqttBroker(port=0).start()
+        try:
+            rp = Pipeline("rx")
+            msrc = rp.add_new("mqttsrc", port=broker.port, sub_topic="s2")
+            rsink = rp.add_new("tensor_sink", store=True)
+            Pipeline.link(msrc, rsink)
+            rp.start()
+            time.sleep(0.3)
+
+            # 1: corrupt sparse message straight to the topic
+            evil = mqtt.MqttClient(broker.host, broker.port, "evil")
+            hdr = mqtt.MessageHdr(
+                num_mems=1, size_mems=(16,), sent_time_epoch=1,
+                caps_str='other/tensors,format=(string)sparse,'
+                         'dimensions=(string)4:4,types=(string)float32')
+            evil.publish("s2", hdr.pack() + b"\xff" * 16)
+
+            # 2: then a valid sparse frame from the element
+            dense = np.zeros((4, 4), np.float32)
+            dense[1, 2] = 5.0
+            tp = Pipeline("tx")
+            caps = Caps.tensors(TensorsConfig(
+                TensorsInfo.from_strings("4:4", "float32"),
+                Fraction(25, 1)))
+            src = tp.add_new("appsrc", caps=caps, data=[dense])
+            msink = tp.add_new("mqttsink", port=broker.port,
+                               pub_topic="s2", sparse=True)
+            Pipeline.link(src, msink)
+            tp.run(timeout=30)
+
+            deadline = time.monotonic() + 10
+            while rsink.num_buffers < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            rp.stop()
+            assert rsink.num_buffers == 1  # corrupt one dropped, good kept
+            b = rsink.buffers[0]
+            np.testing.assert_array_equal(b.memories[0].host(), dense)
+            assert b.config is not None
+            assert b.config.rate == Fraction(25, 1)
+            evil.close()
+        finally:
+            broker.stop()
