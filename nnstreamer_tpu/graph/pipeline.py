@@ -278,6 +278,10 @@ class Pipeline:
             el.pipeline = self
         return elements[0] if len(elements) == 1 else elements
 
+    def get_by_name(self, name: str) -> Optional["Element"]:
+        """Look up an element by its name (gst_bin_get_by_name analog)."""
+        return self.elements.get(name)
+
     def add_new(self, kind: str, name: Optional[str] = None, **props: Any) -> Element:
         el = make_element(kind, element_name=name, **props)
         self.add(el)

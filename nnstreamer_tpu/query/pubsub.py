@@ -82,9 +82,8 @@ def _buffer_to_mqtt(buf: Buffer, base_epoch_us: int,
         blobs = [sparse_encode(m.host(), m.info) for m in buf.memories]
         # keep the full stream config (dims/types/rate of the DENSE
         # tensors) and mark only the payload encoding as sparse
-        c = Caps.tensors(config)
-        c.fields["format"] = _TF.SPARSE
-        caps = caps_to_gst_string(c)
+        caps = caps_to_gst_string(
+            Caps.tensors(config).with_fields(format=_TF.SPARSE))
     else:
         blobs = [m.tobytes() for m in buf.memories]
         caps = caps_to_gst_string(Caps.tensors(config))
@@ -113,17 +112,12 @@ def _mqtt_to_buffer(payload: bytes,
             caps = parse_caps_string(hdr.caps_str)
             if caps.media_type == "other/tensors":
                 from ..core.types import TensorFormat as _TF
-                from ..core.types import TensorsConfig as _TC
-                from ..core.types import TensorsInfo as _TI
 
                 is_sparse = caps.get("format") is _TF.SPARSE
                 if caps.get("dims") is not None:
                     if is_sparse:  # dims/types describe the dense tensors
-                        info = _TI.from_strings(str(caps.get("dims")),
-                                                str(caps.get("types")))
-                        config = _TC(info, caps.get("framerate") or 0)
-                    else:
-                        config = caps.to_config()
+                        caps = caps.with_fields(format=_TF.STATIC)
+                    config = caps.to_config()
                     infos = list(config.info)
         except (ValueError, KeyError):
             log.warning("unparsable caps in MQTT header: %r", hdr.caps_str)

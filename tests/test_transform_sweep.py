@@ -161,3 +161,93 @@ class TestTensorIfOperatorSweep:
     ])
     def test_operator_matrix(self, op, sv, value, expect):
         assert self.run_if(value, op, sv) is expect
+
+
+class TestMergeSplitAggregatorSweep:
+    """Dim sweeps for merge (concat axis modes), split (tensorseg), and
+    aggregator (frames_dim) — reference gsttensormerge.h:45-58 linear
+    first..fourth, tensor_split tensorseg, tensor_aggregator :178-234."""
+
+    @pytest.mark.parametrize("opt,axis", [
+        ("first", 0), ("second", 1), ("third", 2),
+    ])
+    def test_merge_axes(self, opt, axis):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        b = a + 100
+        p = Pipeline()
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("4:3:2", "float32"), Fraction(30, 1)))
+        s1 = p.add_new("appsrc", caps=caps, data=[a])
+        s2 = p.add_new("appsrc", caps=caps, data=[b])
+        merge = p.add_new("tensor_merge", mode="linear", option=opt,
+                          sync_mode="nosync")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(s1, merge)
+        Pipeline.link(s2, merge)
+        Pipeline.link(merge, sink)
+        p.run(timeout=30)
+        got = sink.buffers[0].memories[0].host()
+        # reference dim index axis → numpy axis (innermost-first)
+        np_axis = a.ndim - 1 - axis
+        np.testing.assert_array_equal(got, np.concatenate([a, b], np_axis))
+
+    @pytest.mark.parametrize("seg,nns_axis", [
+        ("1,1", 2), ("1,2", 1), ("2,2", 0),
+    ])
+    def test_split_segments(self, seg, nns_axis):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        sizes = [int(v) for v in seg.split(",")]
+        p = Pipeline()
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("4:3:2", "float32"), Fraction(30, 1)))
+        src = p.add_new("appsrc", caps=caps, data=[x])
+        split = p.add_new("tensor_split", tensorseg=seg,
+                          option=str(nns_axis))
+        sinks = []
+        for i in range(len(sizes)):
+            s = p.add_new("tensor_sink", store=True)
+            sinks.append(s)
+            Pipeline.link(split, s)
+        Pipeline.link(src, split)
+        p.run(timeout=30)
+        np_axis = x.ndim - 1 - nns_axis
+        off = 0
+        for s, size in zip(sinks, sizes):
+            got = s.buffers[0].memories[0].host()
+            sl = [slice(None)] * x.ndim
+            sl[np_axis] = slice(off, off + size)
+            np.testing.assert_array_equal(got, x[tuple(sl)])
+            off += size
+
+    @pytest.mark.parametrize("frames_dim", [0, 1, 2])
+    def test_aggregator_dims(self, frames_dim):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        frames = [np.full((1, 2, 3), i, np.float32) for i in range(4)]
+        p = Pipeline()
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("3:2:1", "float32"), Fraction(30, 1)))
+        src = p.add_new("appsrc", caps=caps, data=frames)
+        agg = p.add_new("tensor_aggregator", frames_out=2,
+                        frames_dim=frames_dim)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, agg, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers == 2
+        got = sink.buffers[0].memories[0].host()
+        np_axis = 3 - 1 - frames_dim
+        np.testing.assert_array_equal(
+            got, np.concatenate([frames[0], frames[1]], axis=np_axis))
