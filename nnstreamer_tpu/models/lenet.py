@@ -1,0 +1,74 @@
+"""LeNet-5 style MNIST CNN — the reference test-model-set parity entry.
+
+Reference analog: tests/test_models/models/{mnist.pb, lenet_iter_9000.caffemodel}
+(tiny classic CNNs the reference's tensorflow/caffe2 filter tests load).
+TPU-native form: a flax module registered as ``zoo://lenet`` so the same
+image-classification pipelines the reference runs over mnist.pb run here —
+and export_model() produces the deployable artifact form.
+
+Input: GRAY8 or float [1:W:H:1] (dims C:W:H innermost-first, default 28×28);
+output: [num_classes:1] logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.tanh(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.tanh(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def make_lenet(size: str = "28", num_classes: str = "10", batch: str = "1",
+               seed: str = "0", dtype: str = "float32",
+               checkpoint: str = "", **_: Any) -> ModelBundle:
+    hw, nc, b = int(size), int(num_classes), int(batch)
+    model = LeNet5(num_classes=nc,
+                   dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    from .zoo import init_variables
+
+    variables = init_variables(model, int(seed),
+                               jnp.zeros((b, hw, hw, 1), jnp.float32))
+    if checkpoint:
+        from ..utils import checkpoints
+
+        variables = checkpoints.load_variables(checkpoint, variables)
+
+    def apply(params, x):
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        if x.ndim == 3:  # (H, W, C) single frame
+            x = x[None]
+        return model.apply(params, x)
+
+    return ModelBundle(
+        "lenet", apply, params=variables,
+        in_info=TensorsInfo.from_strings(f"1:{hw}:{hw}:{b}", "uint8"),
+        out_info=TensorsInfo.from_strings(f"{nc}:{b}", "float32"))
+
+
+register_model("lenet", make_lenet)
+# alias matching the reference test-model name; resolves to the same
+# canonical bundle (one memo entry, one compile)
+register_model("mnist", make_lenet, alias_of="lenet")

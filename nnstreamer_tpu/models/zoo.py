@@ -109,15 +109,25 @@ def synthesize_variables(shape_tree: Any, seed: int) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def register_model(name: str, factory: Callable[..., ModelBundle]) -> None:
+_aliases: Dict[str, str] = {}
+
+
+def register_model(name: str, factory: Callable[..., ModelBundle],
+                   alias_of: Optional[str] = None) -> None:
+    """Register a zoo factory; ``alias_of`` maps an alternate name onto a
+    canonical one so the bundle memo (and thus the filters' jit cache)
+    collapses identical models requested under either name."""
     with _lock:
-        _factories[name.lower()] = factory
+        if alias_of is not None:
+            _aliases[name.lower()] = alias_of.lower()
+        else:
+            _factories[name.lower()] = factory
 
 
 def model_names() -> List[str]:
     _ensure_builtin_models()
     with _lock:
-        return sorted(_factories)
+        return sorted(set(_factories) | set(_aliases))
 
 
 #: resolved-bundle memo: repeated ``zoo://`` specs (e.g. a latency and a
@@ -142,12 +152,13 @@ def get_model(spec: str, **overrides: Any) -> ModelBundle:
         opts = {}
     opts.update(overrides)
     with _lock:
-        factory = _factories.get(s.lower())
+        s = _aliases.get(s.lower(), s.lower())
+        factory = _factories.get(s)
     if factory is None:
         raise ValueError(f"unknown zoo model {spec!r}; known: {model_names()}")
     cacheable = all(isinstance(v, str) and not os.path.exists(v)
                     for v in opts.values())
-    key = (s.lower(), tuple(sorted(opts.items()))) if cacheable else None
+    key = (s, tuple(sorted(opts.items()))) if cacheable else None
     if key is not None:
         with _lock:
             hit = _bundle_memo.get(key)
@@ -176,4 +187,5 @@ def _ensure_builtin_models() -> None:
     from . import deeplab  # noqa: F401
     from . import posenet  # noqa: F401
     from . import lstm  # noqa: F401
+    from . import lenet  # noqa: F401
     from . import stream_transformer  # noqa: F401

@@ -12,7 +12,8 @@ from nnstreamer_tpu.models.zoo import get_model, model_names
 def test_zoo_catalog_complete():
     names = model_names()
     for required in ["mobilenet_v2", "ssd_mobilenet_v2", "deeplab_v3",
-                     "posenet", "lstm_cell", "passthrough", "scaler"]:
+                     "posenet", "lstm_cell", "lenet", "mnist",
+                     "passthrough", "scaler"]:
         assert required in names
 
 
@@ -394,3 +395,44 @@ def test_get_model_non_string_override_still_resolves():
     a = get_model("zoo://scaler?dims=4:1&types=float32", scale=2.5)
     b = get_model("zoo://scaler?dims=4:1&types=float32", scale=2.5)
     assert a is not b  # float override -> uncacheable -> fresh bundle
+
+
+def test_lenet_mnist_pipeline(tmp_path):
+    """GRAY8 stream → zoo://lenet → image_labeling (the reference's
+    mnist.pb classification pipeline shape, tests/test_models parity)."""
+    from fractions import Fraction
+
+    from nnstreamer_tpu.core import Caps
+
+    labels = tmp_path / "digits.txt"
+    labels.write_text("\n".join(str(i) for i in range(10)))
+    p = Pipeline()
+    frames = [np.random.default_rng(i).integers(0, 255, (28, 28, 1))
+              .astype(np.uint8) for i in range(3)]
+    src = p.add_new("appsrc", caps=Caps("video/x-raw", {
+        "format": "GRAY8", "width": 28, "height": 28,
+        "framerate": Fraction(0, 1)}), data=frames)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model="zoo://lenet")
+    dec = p.add_new("tensor_decoder", mode="image_labeling",
+                    option1=str(labels))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=120)
+    assert sink.num_buffers == 3
+    assert sink.buffers[0].meta["label"] in [str(i) for i in range(10)]
+
+
+def test_lenet_exports_and_redeploys(tmp_path):
+    from nnstreamer_tpu.models import export_model, get_model, load_exported
+
+    bundle = get_model("zoo://mnist")
+    assert bundle is get_model("zoo://lenet")  # alias shares the memo entry
+    path = str(tmp_path / "mnist.jaxexport")
+    export_model(path, bundle)
+    back = load_exported(path)
+    x = np.random.default_rng(0).integers(0, 255, (1, 28, 28, 1)).astype(np.uint8)
+    np.testing.assert_allclose(
+        np.asarray(bundle.fn()(x)), np.asarray(back.fn()(x)[0]),
+        rtol=1e-5, atol=1e-6)
