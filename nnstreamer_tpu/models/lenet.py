@@ -18,7 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..core.types import TensorsInfo
-from .zoo import ModelBundle, register_model
+from .zoo import ModelBundle, register_alias, register_model
 
 
 class LeNet5(nn.Module):
@@ -71,4 +71,4 @@ def make_lenet(size: str = "28", num_classes: str = "10", batch: str = "1",
 register_model("lenet", make_lenet)
 # alias matching the reference test-model name; resolves to the same
 # canonical bundle (one memo entry, one compile)
-register_model("mnist", make_lenet, alias_of="lenet")
+register_alias("mnist", "lenet")

@@ -112,16 +112,25 @@ def synthesize_variables(shape_tree: Any, seed: int) -> Any:
 _aliases: Dict[str, str] = {}
 
 
-def register_model(name: str, factory: Callable[..., ModelBundle],
-                   alias_of: Optional[str] = None) -> None:
-    """Register a zoo factory; ``alias_of`` maps an alternate name onto a
-    canonical one so the bundle memo (and thus the filters' jit cache)
-    collapses identical models requested under either name."""
+def register_model(name: str, factory: Callable[..., ModelBundle]) -> None:
+    """Register a zoo factory. A direct registration always wins: it drops
+    any alias previously installed under the same name (user factories must
+    never be silently shadowed by built-in aliases)."""
     with _lock:
-        if alias_of is not None:
-            _aliases[name.lower()] = alias_of.lower()
-        else:
-            _factories[name.lower()] = factory
+        _factories[name.lower()] = factory
+        _aliases.pop(name.lower(), None)
+
+
+def register_alias(alias: str, canonical: str) -> None:
+    """Map ``alias`` onto an existing canonical model name so both resolve
+    to the same memoized bundle (one compile). The target is validated
+    eagerly; a direct factory under ``alias`` keeps precedence."""
+    with _lock:
+        target = _aliases.get(canonical.lower(), canonical.lower())
+        if target not in _factories:
+            raise ValueError(
+                f"register_alias: unknown canonical model {canonical!r}")
+        _aliases[alias.lower()] = target
 
 
 def model_names() -> List[str]:
@@ -152,7 +161,9 @@ def get_model(spec: str, **overrides: Any) -> ModelBundle:
         opts = {}
     opts.update(overrides)
     with _lock:
-        s = _aliases.get(s.lower(), s.lower())
+        s = s.lower()
+        if s not in _factories:  # direct registrations beat aliases
+            s = _aliases.get(s, s)
         factory = _factories.get(s)
     if factory is None:
         raise ValueError(f"unknown zoo model {spec!r}; known: {model_names()}")

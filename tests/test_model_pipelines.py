@@ -436,3 +436,24 @@ def test_lenet_exports_and_redeploys(tmp_path):
     np.testing.assert_allclose(
         np.asarray(bundle.fn()(x)), np.asarray(back.fn()(x)[0]),
         rtol=1e-5, atol=1e-6)
+
+
+def test_user_factory_beats_builtin_alias():
+    """register_model under an aliased name must win over the alias (user
+    extension point; silent shadowing would swap in the wrong model)."""
+    from nnstreamer_tpu.models.zoo import (
+        _aliases, _factories, get_model, register_alias, register_model)
+    from nnstreamer_tpu.models.zoo import ModelBundle
+
+    import pytest
+
+    marker = ModelBundle("user_mnist", lambda x: x)
+    register_model("mnist", lambda **_: marker)
+    try:
+        assert get_model("zoo://mnist") is marker
+        with pytest.raises(ValueError, match="unknown canonical"):
+            register_alias("foo", "no_such_model")
+    finally:
+        # restore the builtin alias
+        _factories.pop("mnist", None)
+        register_alias("mnist", "lenet")
