@@ -143,6 +143,10 @@ def _bundle_from_pyfile(path: str, options: Dict[str, str]) -> ModelBundle:
     return bundle
 
 
+def _as_tuple(out: Any) -> Tuple[Any, ...]:
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
 def _coerce_info(v: Any) -> Optional[TensorsInfo]:
     if v is None or isinstance(v, TensorsInfo):
         return v
@@ -174,7 +178,7 @@ class XLAFilter(FilterFramework):
         super().open(props)
         opts = props.custom_dict()
         self._bundle = resolve_model(props.model, opts)
-        self._device = props.accelerator.pick_device()
+        self._refresh_device()
         self._sync = opts.get("sync", "false").lower() in ("1", "true", "yes")
         self._precision = opts.get("precision", "")
         self._donate = opts.get("donate", "false").lower() in ("1", "true", "yes")
@@ -196,6 +200,17 @@ class XLAFilter(FilterFramework):
         log.info("xla-tpu opened model=%s device=%s sync=%s",
                  self._bundle.name, self._device, self._sync)
 
+    def _refresh_device(self) -> None:
+        """Input placement target: mesh-sharded bundles
+        (parallel.sharded_bundle) carry the input sharding inputs must be
+        placed with — jax.device_put accepts a Sharding wherever a Device
+        goes, so it simply replaces the single-device target. Re-derived
+        on open AND reload (a hot swap to/from a sharded bundle must not
+        leave a stale placement)."""
+        sharding = self._bundle.metadata.get("input_sharding")
+        self._device = sharding if sharding is not None \
+            else self.props.accelerator.pick_device()
+
     def set_fused_preprocess(self, pre) -> None:
         """Install a jax-traceable per-tensor preprocessing stage compiled
         into the same XLA program (ops.fusion pass)."""
@@ -214,6 +229,32 @@ class XLAFilter(FilterFramework):
         fn = self._bundle.fn()
         precision = self._precision
         pre = getattr(self, "_fused_pre", None)
+        if self._bundle.metadata.get("jit") is False:
+            # bundle fn is already a compiled/pjit program (sharded
+            # serving): an outer jit would re-stage it against the wrong
+            # device assignment. Fused preprocess + precision cast still
+            # apply — as their own (sharding-preserving) jitted stage.
+            if self._donate:
+                log.warning("donate=true ignored for pre-compiled (jit "
+                            "False) bundle %s", self._bundle.name)
+            if pre is not None or precision in ("bf16", "bfloat16"):
+                def stage(x):
+                    if pre is not None:
+                        x = pre(x)
+                    if precision in ("bf16", "bfloat16"):
+                        import jax.numpy as jnp
+
+                        if np.issubdtype(np.dtype(str(x.dtype)),
+                                         np.floating):
+                            x = x.astype(jnp.bfloat16)
+                    return x
+
+                stage_jit = jax.jit(stage)
+                self._jitted = lambda *xs: _as_tuple(
+                    fn(*(stage_jit(x) for x in xs)))
+            else:
+                self._jitted = lambda *xs: _as_tuple(fn(*xs))
+            return
         # fused-preprocess programs are per-pipeline objects: caching them
         # on a (memoized, process-lifetime) bundle would leak one compiled
         # executable per pipeline construction and never actually share
@@ -235,8 +276,7 @@ class XLAFilter(FilterFramework):
                 xs = tuple(x.astype(jnp.bfloat16)
                            if np.issubdtype(np.dtype(str(x.dtype)), np.floating) else x
                            for x in xs)
-            out = fn(*xs)
-            return out if isinstance(out, (tuple, list)) else (out,)
+            return _as_tuple(fn(*xs))
 
         kw: Dict[str, Any] = {}
         if self._donate:
@@ -369,6 +409,7 @@ class XLAFilter(FilterFramework):
         new_bundle = resolve_model(model, opts)
         old_in, old_out = self._in_info, self._out_info
         self._bundle = new_bundle
+        self._refresh_device()
         self._build_jit()
         if old_in is not None:
             new_out = self._infer_out_info(old_in)

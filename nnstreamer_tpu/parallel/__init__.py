@@ -6,10 +6,11 @@ from .train import (
     cross_entropy_loss,
     make_sharded_infer_step,
     make_sharded_train_step,
+    sharded_bundle,
 )
 
 __all__ = [
-    "auto_mesh_2d", "batch_sharding", "make_mesh", "replicated",
+    "auto_mesh_2d", "batch_sharding", "make_mesh", "replicated", "sharded_bundle",
     "param_shardings", "param_spec", "shard_params",
     "cross_entropy_loss", "make_sharded_infer_step", "make_sharded_train_step",
 ]

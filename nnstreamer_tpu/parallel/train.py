@@ -81,3 +81,24 @@ def make_sharded_infer_step(apply_fn: Callable[..., Any], params: Any,
         out_shardings=batch_sharding(mesh),
     )
     return jitted, sharded
+
+
+def sharded_bundle(base: Any, mesh: Mesh) -> Any:
+    """Wrap a ModelBundle for mesh-sharded serving inside a pipeline:
+    ``tensor_filter model=sharded_bundle(b, mesh)`` fans each request batch
+    over the mesh's 'data' axis with params laid out over 'model' (the
+    query-server pod-slice offload path, SURVEY §7 step 7).
+
+    The returned bundle carries ``input_sharding`` (the filter places
+    incoming host tensors with it — jax.device_put accepts a Sharding) and
+    ``jit: False`` (the fn is already a pjit program; an outer jit would
+    re-stage it onto a single device)."""
+    from ..models.zoo import ModelBundle
+
+    infer, params = make_sharded_infer_step(base.apply, base.params, mesh)
+    return ModelBundle(
+        f"{base.name}@{'x'.join(str(v) for v in mesh.shape.values())}",
+        lambda x: infer(params, x),
+        in_info=base.in_info, out_info=base.out_info,
+        metadata={**base.metadata, "input_sharding": batch_sharding(mesh),
+                  "jit": False})

@@ -135,3 +135,85 @@ class TestSequenceParallel:
 
         out = f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
         assert out.shape == q.shape
+
+
+def test_query_offload_to_mesh_sharded_server():
+    """SURVEY §7 step 7: the query server pipeline serves with a
+    MESH-SHARDED model — remote clients offload frames; the server invoke
+    fans each batch over the dp axis of an 8-device mesh (the pod-slice
+    offload path, TPU-native replacement for per-buffer TCP offload
+    alone)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.graph import Pipeline
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import auto_mesh_2d
+
+    from nnstreamer_tpu.parallel import sharded_bundle
+
+    base = get_model("zoo://mobilenet_v2?width=0.25&size=16&num_classes=8"
+                     "&batch=8&dtype=float32")
+    mesh = auto_mesh_2d(8)
+    served = sharded_bundle(base, mesh)
+
+    sp = Pipeline("server")
+    ssrc = sp.add_new("tensor_query_serversrc", port=0, id=0,
+                      dims="3:16:16:8", types="uint8")
+    filt = sp.add_new("tensor_filter", model=served)
+    ssink = sp.add_new("tensor_query_serversink", id=0)
+    Pipeline.link(ssrc, filt, ssink)
+    sp.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not hasattr(ssrc, "bound_port") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert hasattr(ssrc, "bound_port"), "server did not bind within 10s"
+        port = ssrc.bound_port
+
+        cp = Pipeline("client")
+        batches = [np.random.default_rng(i).integers(
+            0, 255, (8, 16, 16, 3)).astype(np.uint8) for i in range(3)]
+        src = cp.add_new("appsrc",
+                         caps=Caps.tensors(TensorsConfig(
+                             TensorsInfo.from_strings("3:16:16:8",
+                                                      "uint8"), 0)),
+                         data=batches)
+        qc = cp.add_new("tensor_query_client", port=port)
+        sink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(src, qc, sink)
+        cp.run(timeout=120)
+        assert sink.num_buffers == 3
+        # results must equal the unsharded model's outputs
+        ref_fn = jax.jit(base.fn())
+        for buf, x in zip(sink.buffers, batches):
+            np.testing.assert_allclose(
+                buf.memories[0].host(), np.asarray(ref_fn(x)),
+                rtol=2e-4, atol=2e-5)
+    finally:
+        sp.stop()
+
+
+def test_sharded_bundle_honors_fused_preprocess_and_bf16():
+    """jit:False bundles must still apply a fused preprocess stage and the
+    precision cast (silently dropping a transform chain's math would give
+    wrong results with no error)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nnstreamer_tpu.core.buffer import TensorMemory
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.models.zoo import ModelBundle
+
+    served = ModelBundle("pre_sum", lambda x: x.sum(axis=-1),
+                         metadata={"jit": False})
+    f = XLAFilter()
+    f.open(FilterProps(model=served, custom="precision=bf16"))
+    f.set_fused_preprocess(lambda x: x * 2.0 + 1.0)
+    x = np.ones((2, 4), np.float32)
+    out = f.invoke([TensorMemory(x)])[0].host()
+    np.testing.assert_allclose(out, np.full((2,), 12.0), rtol=1e-2)
