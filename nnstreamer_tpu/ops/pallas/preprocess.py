@@ -38,7 +38,13 @@ def _on_tpu() -> bool:
 # --------------------------------------------------------------------------- #
 
 def _normalize_kernel(x_ref, o_ref, *, scale: float, bias: float, out_dtype):
-    x = x_ref[...].astype(jnp.float32)
+    x = x_ref[...]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        # Mosaic has no direct uint8→float32 cast; hop through int32
+        # (free on the VPU, verified on v5e). Float inputs must NOT take
+        # this hop — it would truncate fractions.
+        x = x.astype(jnp.int32)
+    x = x.astype(jnp.float32)
     o_ref[...] = (x * scale + bias).astype(out_dtype)
 
 
@@ -88,7 +94,8 @@ def normalize_u8(x: jax.Array, scale: float = 1.0 / 127.5,
 def _quantize_kernel(x_ref, o_ref, *, inv_scale: float, zero_point: int):
     x = x_ref[...].astype(jnp.float32)
     q = jnp.round(x * inv_scale) + zero_point
-    o_ref[...] = jnp.clip(q, 0, 255).astype(jnp.uint8)
+    # float32→uint8 is unsupported on Mosaic; clamp then hop through int32
+    o_ref[...] = jnp.clip(q, 0, 255).astype(jnp.int32).astype(jnp.uint8)
 
 
 def quantize_affine_reference(x: jax.Array, scale: float,
