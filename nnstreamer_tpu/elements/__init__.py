@@ -10,6 +10,7 @@ from . import decoder  # noqa: F401
 from . import mux_demux  # noqa: F401
 from . import merge_split  # noqa: F401
 from . import aggregator  # noqa: F401
+from . import batch  # noqa: F401
 from . import crop  # noqa: F401
 from . import cond  # noqa: F401
 from . import rate  # noqa: F401

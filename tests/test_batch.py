@@ -1,0 +1,270 @@
+"""tensor_batch / tensor_unbatch — adaptive micro-batching serving path.
+
+No reference equivalent (the converter's frames-per-tensor is static and
+leaves the stream batched); this is the TPU serving capability that
+amortizes per-frame H2D transfer overhead. Covered here:
+group-and-restore exactness, partial-group EOS flush, budget-deadline
+flush, PTS/offset restoration, device-resident unbatch slices, and the
+full converter→batch→filter→unbatch→decoder pipeline.
+"""
+
+import time
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+
+def _tensor_caps(dims: str, types: str, rate=Fraction(30, 1)) -> Caps:
+    return Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings(dims, types), rate))
+
+
+def _frames(n, shape=(1, 4, 4, 3), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=shape).astype(np.float32) for _ in range(n)]
+
+
+def _scaler(max_batch, hw=4):
+    return (f"zoo://scaler?scale=2&dims=3:{hw}:{hw}:{max_batch}"
+            "&types=float32")
+
+
+def run_batched(frames, max_batch, budget_ms=1000.0, model=None):
+    model = model or _scaler(max_batch)
+    p = Pipeline()
+    dims = ":".join(str(d) for d in reversed(frames[0].shape))
+    src = p.add_new("appsrc", caps=_tensor_caps(dims, "float32"),
+                    data=frames)
+    bat = p.add_new("tensor_batch", max_batch=max_batch, budget_ms=budget_ms)
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=model)
+    unb = p.add_new("tensor_unbatch")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, bat, filt, unb, sink)
+    p.run(timeout=60)
+    return sink
+
+
+class TestBatchUnbatch:
+    def test_full_groups_exact_and_per_frame(self):
+        frames = _frames(12)
+        sink = run_batched(frames, max_batch=4)
+        assert sink.num_buffers == 12
+        for i, buf in enumerate(sink.buffers):
+            np.testing.assert_allclose(
+                buf.memories[0].host(), frames[i] * 2, rtol=1e-6)
+
+    def test_partial_group_flushed_at_eos(self):
+        frames = _frames(10)
+        sink = run_batched(frames, max_batch=4)
+        # 4+4+2: the trailing partial group must be flushed, pad dropped
+        assert sink.num_buffers == 10
+        np.testing.assert_allclose(
+            sink.buffers[-1].memories[0].host(), frames[-1] * 2, rtol=1e-6)
+
+    def test_single_frame_stream(self):
+        frames = _frames(1)
+        sink = run_batched(frames, max_batch=8)
+        assert sink.num_buffers == 1
+        np.testing.assert_allclose(
+            sink.buffers[0].memories[0].host(), frames[0] * 2, rtol=1e-6)
+
+    def test_budget_deadline_flushes_partial_group(self):
+        frames = _frames(6)
+
+        def trickle():
+            yield from frames[:2]
+            time.sleep(0.6)  # well past the 150 ms budget
+            yield from frames[2:]
+
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("3:4:4:1", "float32"),
+                        data=trickle())
+        bat = p.add_new("tensor_batch", max_batch=4, budget_ms=150.0)
+        filt = p.add_new("tensor_filter", framework="xla-tpu",
+                         model=_scaler(4))
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        arrivals = []
+        sink.new_data = lambda buf: arrivals.append(time.monotonic())
+        Pipeline.link(src, bat, filt, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 6
+        # first two frames must arrive well before the post-sleep batch:
+        # the budget deadline, not EOS, flushed them
+        assert arrivals[1] - arrivals[0] < 0.3
+        assert arrivals[2] - arrivals[1] > 0.2
+        for i, buf in enumerate(sink.buffers):
+            np.testing.assert_allclose(
+                buf.memories[0].host(), frames[i] * 2, rtol=1e-6)
+
+    def test_pts_and_offset_restored(self):
+        frames = _frames(6)
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("3:4:4:1", "float32"),
+                        data=frames, framerate=Fraction(30, 1))
+        bat = p.add_new("tensor_batch", max_batch=3, budget_ms=1000.0)
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, bat, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 6
+        pts = [b.pts for b in sink.buffers]
+        assert pts == sorted(pts) and len(set(pts)) == 6
+        assert pts[1] - pts[0] == pytest.approx(1e9 / 30, rel=1e-3)
+
+    def test_unbatch_slices_stay_device_resident(self):
+        frames = _frames(4)
+        sink = run_batched(frames, max_batch=4)
+        assert all(b.memories[0].is_device for b in sink.buffers), \
+            "unbatch must slice on device, not round-trip through host"
+
+    def test_batched_buffer_metadata(self):
+        frames = _frames(5)
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("3:4:4:1", "float32"),
+                        data=frames)
+        bat = p.add_new("tensor_batch", max_batch=4, budget_ms=1000.0)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, bat, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 2
+        full, partial = sink.buffers
+        assert full.meta["batch_n"] == 4 and full.meta["batch_frames"] == 4
+        assert partial.meta["batch_n"] == 1 and partial.meta["batch_frames"] == 4
+        # padded group still carries the full static shape
+        assert partial.memories[0].host().shape == (4, 4, 4, 3)
+        np.testing.assert_allclose(partial.memories[0].host()[:1], frames[4])
+
+    def test_unbatch_passthrough_without_metadata(self):
+        frames = _frames(3)
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("3:4:4:1", "float32"),
+                        data=frames)
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 3
+
+    def test_invalid_max_batch_rejected(self):
+        with pytest.raises(ValueError):
+            Pipeline().add_new("tensor_batch", max_batch=0)
+
+    def test_caps_renegotiation_flushes_pending_group(self):
+        """A mid-stream caps change must flush the old-shape partial group
+        under the OLD config before the new caps reach downstream."""
+        from nnstreamer_tpu.core.buffer import Buffer
+        from nnstreamer_tpu.graph.element import make_element
+        from nnstreamer_tpu.graph.events import Event
+
+        bat = make_element("tensor_batch", max_batch=4, budget_ms=10000.0)
+        sink = make_element("tensor_sink", store=True)
+        Pipeline.link(bat, sink)
+        sink.start()
+        bat.start()
+        try:
+            caps_a = _tensor_caps("3:4:4:1", "float32")
+            bat._event_entry(bat.sink_pad, Event.caps(caps_a))
+            old = [np.full((1, 4, 4, 3), i, np.float32) for i in range(2)]
+            for f in old:
+                bat._chain_entry(bat.sink_pad, Buffer.of(f))
+            caps_b = _tensor_caps("3:8:8:1", "float32")
+            bat._event_entry(bat.sink_pad, Event.caps(caps_b))
+            bat._chain_entry(bat.sink_pad,
+                             Buffer.of(np.full((1, 8, 8, 3), 9, np.float32)))
+            bat._event_entry(bat.sink_pad, Event.eos())
+            deadline = time.monotonic() + 10
+            while sink.num_buffers < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sink.num_buffers == 2
+            first, second = sink.buffers
+            # old-shape group flushed with old dims, before the new stream
+            assert first.memories[0].host().shape == (4, 4, 4, 3)
+            assert first.meta["batch_n"] == 2
+            assert first.config.info[0].shape == (4, 4, 4, 3)
+            assert second.memories[0].host().shape == (4, 8, 8, 3)
+            assert second.config.info[0].shape == (4, 8, 8, 3)
+        finally:
+            bat.stop()
+
+    def test_unbatch_caps_renegotiation_refreshes_config(self):
+        from nnstreamer_tpu.core.buffer import Buffer
+        from nnstreamer_tpu.graph.element import make_element
+        from nnstreamer_tpu.graph.events import Event
+
+        unb = make_element("tensor_unbatch")
+        sink = make_element("tensor_sink", store=True)
+        Pipeline.link(unb, sink)
+
+        def batched(shape, n):
+            arr = np.zeros(shape, np.float32)
+            return Buffer.of(arr, meta={"batch_frames": 2, "batch_n": n,
+                                        "batch_pts": [0] * n})
+
+        unb._event_entry(unb.sink_pad, Event.caps(_tensor_caps("3:4:4:2",
+                                                               "float32")))
+        unb._chain_entry(unb.sink_pad, batched((2, 4, 4, 3), 2))
+        assert sink.buffers[-1].config.info[0].shape == (1, 4, 4, 3)
+        unb._event_entry(unb.sink_pad, Event.caps(_tensor_caps("3:8:8:2",
+                                                               "float32")))
+        unb._chain_entry(unb.sink_pad, batched((2, 8, 8, 3), 1))
+        assert sink.num_buffers == 3
+        assert sink.buffers[-1].config.info[0].shape == (1, 8, 8, 3), \
+            "per-frame config must refresh after renegotiation"
+
+    def test_unbatch_passthrough_forwards_caps(self):
+        frames = _frames(3)
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("3:4:4:1", "float32"),
+                        data=frames)
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 3
+        assert sink.sink_pad.caps is not None, \
+            "passthrough must still forward caps downstream"
+
+
+class TestBatchedServingPipeline:
+    def test_video_to_labels_end_to_end(self, tmp_path):
+        """converter → batch → model → unbatch → decoder: per-frame labels
+        equal the unbatched pipeline's output."""
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"l{i}" for i in range(16)))
+        rng = np.random.default_rng(7)
+        frames = [rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+                  for _ in range(10)]
+        video_caps = Caps("video/x-raw", {
+            "format": "RGB", "width": 32, "height": 32,
+            "framerate": Fraction(0, 1)})
+        results = {}
+        for key, batched in (("ref", False), ("batched", True)):
+            p = Pipeline()
+            src = p.add_new("appsrc", caps=video_caps, data=frames)
+            conv = p.add_new("tensor_converter")
+            chain = [src, conv]
+            if batched:
+                chain.append(p.add_new("tensor_batch", max_batch=4,
+                                       budget_ms=1000.0))
+            chain.append(p.add_new(
+                "tensor_filter", framework="xla-tpu",
+                model="zoo://mobilenet_v2?width=0.25&size=32&num_classes=16"
+                      f"&dtype=float32&batch={4 if batched else 1}"))
+            if batched:
+                chain.append(p.add_new("tensor_unbatch"))
+            chain.append(p.add_new("tensor_decoder", mode="image_labeling",
+                                   option1=str(labels)))
+            sink = p.add_new("tensor_sink", store=True)
+            chain.append(sink)
+            Pipeline.link(*chain)
+            p.run(timeout=120)
+            results[key] = [bytes(b.memories[0].host().tobytes())
+                            for b in sink.buffers]
+        assert len(results["batched"]) == 10
+        assert results["batched"] == results["ref"]
