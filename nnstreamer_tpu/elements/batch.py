@@ -154,19 +154,23 @@ class TensorBatch(Element):
                         self._cv.wait(0.1)
             try:
                 if item is _FLUSH:
-                    self._emit(group)
+                    if self._emit(group) is not FlowReturn.OK:
+                        return  # downstream EOS: stop consuming
                     group, deadline = [], None
                 elif isinstance(item, Buffer):
                     group.append(item)
                     if len(group) == 1:
                         deadline = time.monotonic() + self.budget_ms / 1000.0
                     if len(group) >= self.max_batch:
-                        self._emit(group)
+                        if self._emit(group) is not FlowReturn.OK:
+                            return
                         group, deadline = [], None
                 elif isinstance(item, Event):
                     if item.type in (EventType.EOS, EventType.STREAM_START,
                                      EventType.CAPS) and group:
                         # flush under the OLD config before the boundary
+                        # (push result deliberately not terminal here: the
+                        # EOS event below must still propagate)
                         self._emit(group)
                         group, deadline = [], None
                     if item.type is EventType.EOS:
@@ -180,7 +184,7 @@ class TensorBatch(Element):
                 self.post_error(f"batching failed: {e}", exc=e)
                 return
 
-    def _emit(self, group: List[Buffer]) -> None:
+    def _emit(self, group: List[Buffer]) -> FlowReturn:
         n = len(group)
         # pad by repeating the last frame: ONE static shape downstream
         frames = group + [group[-1]] * (self.max_batch - n)
@@ -202,7 +206,12 @@ class TensorBatch(Element):
                   "batch_pts": [b.pts for b in group],
                   "batch_offsets": [b.offset for b in group],
                   "batch_durations": [b.duration for b in group]})
-        self.push(out)
+        ret = self.push(out)
+        if ret is FlowReturn.ERROR:
+            # unlinked/failed downstream: surface instead of consuming
+            # forever (a chain exception already posted its own error)
+            raise RuntimeError("downstream returned ERROR")
+        return FlowReturn.OK if ret is None else ret
 
 
 @register_element

@@ -1,7 +1,20 @@
-"""SPMD parallel layer: device meshes, GSPMD shardings, sharded steps."""
+"""SPMD parallel layer: device meshes, GSPMD shardings, sharded steps,
+pipeline stages (pp), and expert parallelism (ep)."""
 
 from .mesh import auto_mesh_2d, batch_sharding, make_mesh, replicated
+from .moe import (
+    init_moe_params,
+    make_expert_parallel_moe,
+    moe_apply,
+    moe_shardings,
+)
 from .sharding import param_shardings, param_spec, shard_params
+from .stages import (
+    make_gpipe_apply,
+    sequential_apply,
+    shard_stage_params,
+    stack_stage_params,
+)
 from .train import (
     cross_entropy_loss,
     make_sharded_infer_step,
@@ -13,4 +26,8 @@ __all__ = [
     "auto_mesh_2d", "batch_sharding", "make_mesh", "replicated", "sharded_bundle",
     "param_shardings", "param_spec", "shard_params",
     "cross_entropy_loss", "make_sharded_infer_step", "make_sharded_train_step",
+    "make_gpipe_apply", "sequential_apply", "shard_stage_params",
+    "stack_stage_params",
+    "init_moe_params", "make_expert_parallel_moe", "moe_apply",
+    "moe_shardings",
 ]

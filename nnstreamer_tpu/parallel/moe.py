@@ -1,0 +1,117 @@
+"""Expert parallelism (ep): switch-routed mixture-of-experts over a mesh.
+
+No reference analog (NNStreamer has no training or large-model sharding;
+SURVEY §2.5 records its distribution as pipeline offload). This module adds
+the GShard/Switch pattern TPU-natively: a learned top-1 router assigns each
+token to one of E experts; tokens are dispatched into per-expert capacity
+buffers with one-hot einsums; expert FFNs run batched over a leading expert
+axis sharded on the ``expert`` mesh axis. Dispatch/combine einsums contract
+the token axis against expert-sharded operands, so GSPMD lowers them to
+all-to-alls over ICI — no manual collectives.
+
+Exactness contract: the expert-sharded jit equals the single-device apply
+(tests/test_parallel.py) — sharding is layout, not math.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_hidden: int,
+                    n_experts: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+    """Router (D,E) + expert FFN stacks w1 (E,D,H), w2 (E,H,D)."""
+    kr, k1, k2 = jax.random.split(rng, 3)
+    s_in = 1.0 / math.sqrt(d_model)
+    s_hid = 1.0 / math.sqrt(d_hidden)
+    return {
+        "router": jax.random.normal(kr, (d_model, n_experts), dtype) * s_in,
+        "w1": jax.random.normal(k1, (n_experts, d_model, d_hidden),
+                                dtype) * s_in,
+        "w2": jax.random.normal(k2, (n_experts, d_hidden, d_model),
+                                dtype) * s_hid,
+    }
+
+
+def moe_apply(params: Dict[str, jax.Array], x: jax.Array,
+              capacity_factor: float = 1.25
+              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-1 (switch) MoE FFN. ``x``: (B, S, D) → (B, S, D).
+
+    Tokens over capacity are dropped (standard switch semantics: their
+    output contribution is zero — the residual connection outside this
+    layer carries them through). Returns aux with the load-balancing loss
+    (Switch Transformer eq. 4) and per-expert token counts.
+    """
+    b, s, d = x.shape
+    e = params["router"].shape[-1]
+    n = b * s
+    cap = int(np.ceil(n / e * capacity_factor))
+    xf = x.reshape(n, d)
+
+    logits = xf @ params["router"]          # (N, E)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(gates, axis=-1)     # (N,)
+    gate = jnp.max(gates, axis=-1)          # (N,)
+
+    # routing bookkeeping stays float32 regardless of x.dtype: a bf16
+    # cumsum rounds above 256 and would collide capacity slots silently
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # (N, E)
+    pos = (jnp.sum(jnp.cumsum(onehot, axis=0) * onehot,
+                   axis=-1) - 1).astype(jnp.int32)             # (N,) slot
+    keep = (pos < cap).astype(jnp.float32)
+    dispatch = ((onehot * keep[:, None])[:, :, None] *
+                jax.nn.one_hot(pos, cap, dtype=jnp.float32)[:, None, :]
+                ).astype(x.dtype)                              # (N, E, C)
+
+    # token→expert all-to-all (GSPMD inserts it from the shardings)
+    xin = jnp.einsum("nec,nd->ecd", dispatch, xf)              # (E, C, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, params["w1"]))
+    yexp = jnp.einsum("ech,ehd->ecd", h, params["w2"])         # (E, C, D)
+    # expert→token combine, gate-weighted
+    yf = jnp.einsum("nec,ecd->nd",
+                    dispatch * gate[:, None, None].astype(x.dtype), yexp)
+
+    counts = jnp.sum(onehot, axis=0)                           # (E,)
+    importance = jnp.mean(gates, axis=0)                       # (E,)
+    aux = {
+        "load_balance_loss": e * jnp.sum(importance *
+                                         (counts / n)),
+        "expert_counts": counts,
+        "dropped": n - jnp.sum(onehot * keep[:, None]),
+    }
+    return yf.reshape(b, s, d), aux
+
+
+def moe_shardings(params: Dict[str, jax.Array], mesh: Mesh,
+                  ep_axis: str = "expert") -> Dict[str, NamedSharding]:
+    """Router replicated; expert stacks sharded over the expert axis."""
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w1": NamedSharding(mesh, P(ep_axis)),
+        "w2": NamedSharding(mesh, P(ep_axis)),
+    }
+
+
+def make_expert_parallel_moe(params: Dict[str, jax.Array], mesh: Mesh,
+                             ep_axis: str = "expert",
+                             dp_axis: Optional[str] = "data",
+                             capacity_factor: float = 1.25):
+    """(jitted_apply, placed_params): tokens sharded over ``dp_axis``
+    (if present in the mesh), expert weights over ``ep_axis``; XLA emits
+    the dispatch/combine all-to-alls over ICI."""
+    shardings = moe_shardings(params, mesh, ep_axis)
+    placed = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    x_spec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
+    jitted = jax.jit(
+        lambda p, x: moe_apply(p, x, capacity_factor),
+        in_shardings=(shardings, NamedSharding(mesh, x_spec)),
+        out_shardings=(NamedSharding(mesh, x_spec), None),
+    )
+    return jitted, placed

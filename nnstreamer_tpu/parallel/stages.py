@@ -1,0 +1,117 @@
+"""Pipeline parallelism (pp): GPipe-style staged execution over a mesh axis.
+
+The reference's only "pipeline parallelism" is GStreamer stream threads —
+elements on different threads of ONE host (SURVEY §2.5 "stream parallelism
+primitives"). The TPU-native upgrade partitions a model's *layers* across
+devices on a ``stage`` mesh axis and streams microbatches through them:
+device s holds stage s's params, computes its stage each tick, and hands
+activations to device s+1 over ICI via ``lax.ppermute`` — the classic
+schedule with (S-1) bubble ticks around M microbatch ticks.
+
+Written with ``shard_map`` (per-device code, explicit collective) because
+pipelining is control-flow over *time*, not a data layout — GSPMD sharding
+annotations cannot express it.
+
+Exactness contract: ``make_gpipe_apply(stage_fn, mesh)(params, x)`` equals
+the sequential ``scan`` of stages on one device (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring import _shard_map
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack S per-stage pytrees into one pytree with a leading stage axis
+    (what pp shards: leaf shape (S, ...) over the 'stage' mesh axis)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def sequential_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     stacked_params: Any, x: jax.Array) -> jax.Array:
+    """Single-device oracle: fold x through all S stages in order."""
+    def body(h, params):
+        return stage_fn(params, h), None
+
+    out, _ = jax.lax.scan(body, x, stacked_params)
+    return out
+
+
+def make_gpipe_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     mesh: Mesh, axis: str = "stage",
+                     n_microbatches: Optional[int] = None):
+    """Build ``pipelined(stacked_params, x) -> y`` running stages over
+    ``mesh.shape[axis]`` devices.
+
+    ``stage_fn(stage_params, h) -> h`` must preserve the activation shape
+    (classic homogeneous-stage pipelining). ``stacked_params`` leaves carry
+    a leading S axis; ``x`` is the global batch ``(B, ...)``, internally
+    split into M microbatches (default M = S, the minimum that fills the
+    pipeline; more microbatches shrink the relative bubble).
+    """
+    n_stages = mesh.shape[axis]
+
+    def pipelined(stacked_params: Any, x: jax.Array) -> jax.Array:
+        m = n_microbatches or n_stages
+        if x.shape[0] % m:
+            raise ValueError(
+                f"pp: batch {x.shape[0]} not divisible into {m} microbatches")
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != n_stages:
+                # a divisible mismatch (e.g. 8 stages on a 4-device axis)
+                # would otherwise silently run only every k-th stage
+                raise ValueError(
+                    f"pp: stacked params carry {leaf.shape[0]} stages but "
+                    f"mesh axis {axis!r} has {n_stages} devices")
+        micro = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+
+        def per_device(params: Any, xloc: jax.Array) -> jax.Array:
+            # params leaves: (1, ...) stage slice; xloc: (M, mb, ...) replicated
+            p = jax.tree_util.tree_map(lambda a: a[0], params)
+            idx = jax.lax.axis_index(axis)
+            n_ticks = m + n_stages - 1
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+            def tick(carry, t):
+                state, outbuf = carry
+                # stage 0 injects microbatch t (clamped past the end: the
+                # result never reaches the collection window)
+                h = jnp.where(idx == 0,
+                              xloc[jnp.minimum(t, m - 1)], state)
+                y = stage_fn(p, h)
+                o = t - (n_stages - 1)
+                collected = outbuf.at[jnp.clip(o, 0, m - 1)].set(y)
+                outbuf = jnp.where((idx == n_stages - 1) & (o >= 0),
+                                   collected, outbuf)
+                state = jax.lax.ppermute(y, axis, perm)
+                return (state, outbuf), None
+
+            init = (jnp.zeros_like(xloc[0]), jnp.zeros_like(xloc))
+            (_, outbuf), _ = jax.lax.scan(
+                tick, init, jnp.arange(n_ticks))
+            # only the last stage holds results; psum replicates them
+            return jax.lax.psum(
+                jnp.where(idx == n_stages - 1, outbuf, 0), axis)
+
+        out = _shard_map(per_device, mesh,
+                         in_specs=(P(axis), P()), out_specs=P())(
+            stacked_params, micro)
+        return out.reshape((-1,) + out.shape[2:])
+
+    return pipelined
+
+
+def shard_stage_params(stacked_params: Any, mesh: Mesh,
+                       axis: str = "stage") -> Any:
+    """Place stacked stage params with the leading axis over ``axis``."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), stacked_params)

@@ -217,3 +217,162 @@ def test_sharded_bundle_honors_fused_preprocess_and_bf16():
     x = np.ones((2, 4), np.float32)
     out = f.invoke([TensorMemory(x)])[0].host()
     np.testing.assert_allclose(out, np.full((2,), 12.0), rtol=1e-2)
+
+
+class TestPipelineParallel:
+    """GPipe staged execution (parallel/stages.py): exactness vs the
+    sequential single-device oracle on the 8-device CPU mesh."""
+
+    def _stages(self, n_stages, d=8, seed=0):
+        from nnstreamer_tpu.parallel import stack_stage_params
+
+        rng = np.random.default_rng(seed)
+        per_stage = [
+            {"w": jnp.asarray(rng.normal(size=(d, d)).astype(np.float32)
+                              / np.sqrt(d)),
+             "b": jnp.asarray(rng.normal(size=(d,)).astype(np.float32))}
+            for _ in range(n_stages)]
+        return stack_stage_params(per_stage)
+
+    @staticmethod
+    def _stage_fn(params, h):
+        return jnp.tanh(h @ params["w"] + params["b"])
+
+    @pytest.mark.parametrize("n_micro", [None, 8, 16])
+    def test_gpipe_exact(self, n_micro):
+        from nnstreamer_tpu.parallel import (
+            make_gpipe_apply, make_mesh, sequential_apply,
+            shard_stage_params)
+
+        mesh = make_mesh({"stage": 8})
+        stacked = self._stages(8)
+        x = jnp.asarray(np.random.default_rng(1).normal(
+            size=(16, 8)).astype(np.float32))
+        want = np.asarray(sequential_apply(self._stage_fn, stacked, x))
+        pp = make_gpipe_apply(self._stage_fn, mesh, n_microbatches=n_micro)
+        got = np.asarray(jax.jit(pp)(shard_stage_params(stacked, mesh), x))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    def test_gpipe_2x4_mixed_mesh(self):
+        """pp composes with dp on a 2D mesh (stage axis only is pipelined)."""
+        from nnstreamer_tpu.parallel import (
+            make_gpipe_apply, make_mesh, sequential_apply,
+            shard_stage_params)
+
+        mesh = make_mesh({"stage": 4, "data": 2})
+        stacked = self._stages(4)
+        x = jnp.asarray(np.random.default_rng(2).normal(
+            size=(8, 8)).astype(np.float32))
+        want = np.asarray(sequential_apply(self._stage_fn, stacked, x))
+        pp = make_gpipe_apply(self._stage_fn, mesh)
+        got = np.asarray(pp(shard_stage_params(stacked, mesh), x))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    def test_gpipe_rejects_indivisible_batch(self):
+        from nnstreamer_tpu.parallel import make_gpipe_apply, make_mesh
+
+        mesh = make_mesh({"stage": 8})
+        pp = make_gpipe_apply(self._stage_fn, mesh, n_microbatches=8)
+        with pytest.raises(ValueError, match="microbatch"):
+            pp(self._stages(8), jnp.zeros((12, 8)))
+
+
+class TestExpertParallel:
+    def _setup(self, b=2, s=16, d=8, h=16, e=4, seed=0):
+        from nnstreamer_tpu.parallel import init_moe_params
+
+        params = init_moe_params(jax.random.PRNGKey(seed), d, h, e)
+        x = jnp.asarray(np.random.default_rng(seed).normal(
+            size=(b, s, d)).astype(np.float32))
+        return params, x
+
+    def test_moe_sharded_equals_single_device(self):
+        from nnstreamer_tpu.parallel import (
+            make_expert_parallel_moe, make_mesh, moe_apply)
+
+        params, x = self._setup()
+        want, aux_want = moe_apply(params, x)
+        mesh = make_mesh({"data": 2, "expert": 4})
+        jitted, placed = make_expert_parallel_moe(params, mesh)
+        got, aux = jitted(placed, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(aux["expert_counts"]),
+                                   np.asarray(aux_want["expert_counts"]))
+
+    def test_moe_routing_properties(self):
+        from nnstreamer_tpu.parallel import moe_apply
+
+        params, x = self._setup(b=4, s=32)
+        out, aux = moe_apply(params, x, capacity_factor=1.25)
+        n = 4 * 32
+        counts = np.asarray(aux["expert_counts"])
+        assert counts.sum() == n  # every token routed somewhere
+        assert 0 <= float(aux["dropped"]) < n  # capacity drops bounded
+        assert out.shape == x.shape
+        assert float(aux["load_balance_loss"]) >= 1.0 - 1e-3  # min at uniform
+
+    def test_moe_capacity_drops_tokens(self):
+        """capacity_factor < 1 forces drops; dropped tokens contribute 0."""
+        from nnstreamer_tpu.parallel import moe_apply
+
+        params, x = self._setup(b=2, s=32)
+        _, aux_tight = moe_apply(params, x, capacity_factor=0.25)
+        _, aux_loose = moe_apply(params, x, capacity_factor=4.0)
+        assert float(aux_tight["dropped"]) > 0
+        assert float(aux_loose["dropped"]) == 0
+
+    def test_moe_bf16_routing_exact(self):
+        """Routing bookkeeping must not round in bf16: with >256 tokens on
+        one expert, slot positions would collide and corrupt outputs. The
+        oracle reuses the SAME bf16 routing decisions but does the
+        capacity bookkeeping in exact numpy arithmetic."""
+        from nnstreamer_tpu.parallel import init_moe_params, moe_apply
+
+        d, e, cf = 8, 4, 2.0
+        params = init_moe_params(jax.random.PRNGKey(0), d, 16, e,
+                                 dtype=jnp.bfloat16)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(4, 512, d)), dtype=jnp.bfloat16)  # ~512 tokens/expert
+        out, aux = moe_apply(params, x, capacity_factor=cf)
+        n = 4 * 512
+        assert np.asarray(aux["expert_counts"]).sum() == n
+
+        # identical routing decisions (same jax ops), exact bookkeeping
+        xf = np.asarray(x, np.float64).reshape(n, d)
+        logits = jnp.asarray(x.reshape(n, d)) @ params["router"]
+        gates = np.asarray(jax.nn.softmax(
+            logits.astype(jnp.float32), axis=-1), np.float64)
+        expert = np.argmax(gates, -1)
+        gate = np.max(gates, -1)
+        cap = int(np.ceil(n / e * cf))
+        slots = np.zeros(e, np.int64)
+        want = np.zeros_like(xf)
+        w1 = np.asarray(params["w1"], np.float64)
+        w2 = np.asarray(params["w2"], np.float64)
+        for i in range(n):
+            ee = expert[i]
+            if slots[ee] < cap:
+                slots[ee] += 1
+                h = xf[i] @ w1[ee]
+                h = 0.5 * h * (1 + np.vectorize(__import__("math").erf)(
+                    h / np.sqrt(2)))
+                want[i] = gate[i] * (h @ w2[ee])
+        got = np.asarray(out, np.float32).reshape(n, d)
+        # bf16 einsum tolerance; collisions would blow past this wholesale
+        np.testing.assert_allclose(got, want, rtol=0.2, atol=0.2)
+
+
+
+def test_gpipe_rejects_stage_count_mismatch():
+    """8 stacked stages on a 4-device axis must error, not silently run
+    every other stage."""
+    from nnstreamer_tpu.parallel import (
+        make_gpipe_apply, make_mesh, stack_stage_params)
+
+    mesh = make_mesh({"stage": 4, "data": 2})
+    stacked = stack_stage_params(
+        [{"w": jnp.eye(4)} for _ in range(8)])
+    pp = make_gpipe_apply(lambda p, h: h @ p["w"], mesh)
+    with pytest.raises(ValueError, match="stages"):
+        pp(stacked, jnp.zeros((8, 4)))
