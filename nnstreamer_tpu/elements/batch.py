@@ -130,6 +130,14 @@ class TensorBatch(Element):
             self._dq.append(item)
             self._cv.notify_all()
 
+    def _quit_worker(self) -> None:
+        """Mark the element flushing before the worker exits early, so
+        producers blocked in _enqueue's backpressure wait are released
+        (they would otherwise wedge until pipeline teardown)."""
+        with self._cv:
+            self._flushing = True
+            self._cv.notify_all()
+
     # -- worker ----------------------------------------------------------------- #
     def _drain(self) -> None:
         group: List[Buffer] = []
@@ -155,7 +163,8 @@ class TensorBatch(Element):
             try:
                 if item is _FLUSH:
                     if self._emit(group) is not FlowReturn.OK:
-                        return  # downstream EOS: stop consuming
+                        self._quit_worker()  # downstream EOS: stop consuming
+                        return
                     group, deadline = [], None
                 elif isinstance(item, Buffer):
                     group.append(item)
@@ -163,6 +172,7 @@ class TensorBatch(Element):
                         deadline = time.monotonic() + self.budget_ms / 1000.0
                     if len(group) >= self.max_batch:
                         if self._emit(group) is not FlowReturn.OK:
+                            self._quit_worker()
                             return
                         group, deadline = [], None
                 elif isinstance(item, Event):
@@ -182,6 +192,7 @@ class TensorBatch(Element):
                         self.push_event_all(item)
             except Exception as e:  # noqa: BLE001
                 self.post_error(f"batching failed: {e}", exc=e)
+                self._quit_worker()
                 return
 
     def _emit(self, group: List[Buffer]) -> FlowReturn:

@@ -1,0 +1,171 @@
+"""MoE streaming transformer — expert-parallel long-sequence model family.
+
+Beyond-reference capability (the reference has no large-model sharding;
+its models are opaque single-device files, SURVEY §2.3): a streaming
+transformer whose MLPs are switch-routed mixture-of-experts layers
+(parallel/moe.py). Serving fans the expert stacks over an ``expert`` mesh
+axis — dispatch/combine einsums become GSPMD all-to-alls over ICI — while
+attention can still run sequence-parallel (parallel/ring.py), so BOTH the
+context length and the parameter count scale with chips.
+
+Zoo entry: ``zoo://moe_transformer?layers=2&dim=128&heads=8&experts=8``
+(every second block is MoE, Switch-Transformer style). For mesh serving
+use ``make_ep_infer(bundle, mesh)`` or wrap with ``parallel.sharded_bundle``
+semantics via the returned jit.
+
+Router metrics (load-balance loss, per-expert counts) are sown into the
+``moe_metrics`` flax collection: training code applies with
+``mutable=["moe_metrics"]`` to read them; plain serving ignores them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.types import TensorsInfo
+from ..parallel.moe import moe_apply
+from .stream_transformer import Block
+from .zoo import ModelBundle, register_model
+
+
+class MoEBlock(Block):
+    """Transformer block with a switch-MoE MLP. Shares Block's attention
+    half; only the MLP vmethod differs."""
+
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def _mlp_residual(self, x):
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        e, hidden = self.n_experts, d * self.mlp_ratio
+        params = {
+            "router": self.param(
+                "router", nn.initializers.normal(1.0 / np.sqrt(d)),
+                (d, e), jnp.float32),
+            "w1": self.param(
+                "w1", nn.initializers.normal(1.0 / np.sqrt(d)),
+                (e, d, hidden), jnp.float32),
+            "w2": self.param(
+                "w2", nn.initializers.normal(1.0 / np.sqrt(hidden)),
+                (e, hidden, d), jnp.float32),
+        }
+        cast = {key: val.astype(self.dtype) if key != "router" else val
+                for key, val in params.items()}
+        y, aux = moe_apply(cast, h.astype(self.dtype),
+                           capacity_factor=self.capacity_factor)
+        self.sow("moe_metrics", "load_balance_loss",
+                 aux["load_balance_loss"])
+        self.sow("moe_metrics", "expert_counts", aux["expert_counts"])
+        return x + y.astype(self.dtype)
+
+
+class MoEStreamTransformer(nn.Module):
+    """Alternating dense/MoE blocks (Switch-style: odd blocks are MoE)."""
+
+    layers: int = 2
+    dim: int = 128
+    heads: int = 8
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        if x.shape[-1] != self.dim:
+            x = nn.Dense(self.dim, dtype=self.dtype, name="embed")(x)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.layers):
+            if i % 2 == 1:
+                x = MoEBlock(self.dim, self.heads,
+                             n_experts=self.n_experts,
+                             capacity_factor=self.capacity_factor,
+                             dtype=self.dtype,
+                             attention_fn=self.attention_fn,
+                             name=f"moe_block_{i}")(x)
+            else:
+                x = Block(self.dim, self.heads, dtype=self.dtype,
+                          attention_fn=self.attention_fn,
+                          name=f"block_{i}")(x)
+        return nn.LayerNorm(dtype=self.dtype)(x).astype(jnp.float32)
+
+
+def make_moe_transformer(layers: str = "2", dim: str = "128",
+                         heads: str = "8", experts: str = "8",
+                         seq: str = "256", in_dim: str = "",
+                         batch: str = "1", seed: str = "0",
+                         capacity_factor: str = "1.25",
+                         dtype: str = "bfloat16", **_: Any) -> ModelBundle:
+    L, D, B, E = int(seq), int(dim), int(batch), int(experts)
+    d_in = int(in_dim) if in_dim else D
+    model = MoEStreamTransformer(
+        layers=int(layers), dim=D, heads=int(heads), n_experts=E,
+        capacity_factor=float(capacity_factor),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    from .zoo import init_variables
+
+    params = init_variables(model, int(seed),
+                            jnp.zeros((B, L, d_in), jnp.float32))
+    # drop the sown moe_metrics collection picked up during init: serving
+    # never reads it, and it must not ride along into sharded placement
+    params = {"params": params["params"]} if "params" in params else params
+    return ModelBundle(
+        "moe_transformer", lambda p, x: model.apply(p, x), params=params,
+        in_info=TensorsInfo.from_strings(f"{d_in}:{L}:{B}", "float32"),
+        out_info=TensorsInfo.from_strings(f"{D}:{L}:{B}", "float32"),
+        metadata={"layers": int(layers), "dim": D, "heads": int(heads),
+                  "experts": E, "seq": L})
+
+
+def ep_param_shardings(params: Any, mesh, n_experts: int,
+                       ep_axis: str = "expert") -> Any:
+    """Sharding pytree for the param tree: expert weight stacks (leaves
+    named w1/w2 under a moe block, leading dim == expert count) shard over
+    ``ep_axis``; everything else replicates. Keyed on the param PATH, not
+    shape alone, so an unrelated leaf that happens to have a matching
+    leading dim is never expert-sharded."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        segs = [str(getattr(p, "key", p)) for p in path]
+        shape = np.shape(leaf)
+        is_expert_stack = (
+            ep_axis in mesh.shape
+            and segs and segs[-1] in ("w1", "w2")
+            and any(s.startswith("moe") for s in segs)
+            and shape and shape[0] == n_experts)
+        out.append(NamedSharding(mesh, P(ep_axis) if is_expert_stack
+                                 else P()))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_ep_infer(bundle: ModelBundle, mesh, ep_axis: str = "expert",
+                  dp_axis: str = "data"):
+    """(infer_fn, placed_params) with expert stacks sharded over
+    ``ep_axis`` and the token batch over ``dp_axis`` (when present)."""
+    n_experts = bundle.metadata["experts"]
+    shardings = ep_param_shardings(bundle.params, mesh, n_experts, ep_axis)
+    placed = jax.tree_util.tree_map(jax.device_put, bundle.params, shardings)
+    dp = mesh.shape.get(dp_axis, 1) if dp_axis else 1
+    x_spec = P(dp_axis) if dp > 1 else P()
+    apply = bundle.apply
+    jitted = jax.jit(
+        lambda p, x: apply(p, x),
+        in_shardings=(shardings, NamedSharding(mesh, x_spec)),
+        out_shardings=NamedSharding(mesh, x_spec))
+    from ..parallel.moe import dp_guard
+
+    return dp_guard(jitted, dp, dp_axis, what="ep infer"), placed
+
+
+register_model("moe_transformer", make_moe_transformer)

@@ -24,6 +24,10 @@ from .zoo import ModelBundle, register_model
 
 
 class Block(nn.Module):
+    """Transformer block. The MLP half is a vmethod (``_mlp_residual``) so
+    variants (e.g. the MoE block in models/moe_transformer.py) share the
+    attention half instead of copying it."""
+
     dim: int
     heads: int
     mlp_ratio: int = 4
@@ -47,11 +51,14 @@ class Block(nn.Module):
             o = reference_attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, L, D).astype(self.dtype)
         x = x + nn.Dense(D, dtype=self.dtype)(o)
+        return self._mlp_residual(x)
+
+    def _mlp_residual(self, x):
+        D = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(D * self.mlp_ratio, dtype=self.dtype)(h)
         h = nn.gelu(h)
-        x = x + nn.Dense(D, dtype=self.dtype)(h)
-        return x
+        return x + nn.Dense(D, dtype=self.dtype)(h)
 
 
 class StreamTransformer(nn.Module):

@@ -103,8 +103,16 @@ def synthesize_variables(shape_tree: Any, seed: int) -> Any:
                              shape).astype(dtype)
         elif "scale" in name or "var" in name:
             arr = np.ones(shape, dtype)
-        else:  # bias, mean, and anything unrecognized: zeros
+        elif "bias" in name or "mean" in name or len(shape) < 2 or \
+                not np.issubdtype(dtype, np.floating):
             arr = np.zeros(shape, dtype)
+        else:
+            # unrecognized matrix-like float leaf (e.g. MoE router/w1/w2,
+            # pos_embed): fan-in normal — zeros here would silently turn
+            # whole layers into no-ops on accelerator-backend init
+            fan_in = int(np.prod(shape[:-1]))
+            arr = rng.normal(0.0, 1.0 / np.sqrt(max(fan_in, 1)),
+                             shape).astype(dtype)
         out.append(arr)
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -200,3 +208,4 @@ def _ensure_builtin_models() -> None:
     from . import lstm  # noqa: F401
     from . import lenet  # noqa: F401
     from . import stream_transformer  # noqa: F401
+    from . import moe_transformer  # noqa: F401

@@ -99,6 +99,23 @@ def moe_shardings(params: Dict[str, jax.Array], mesh: Mesh,
     }
 
 
+def dp_guard(jitted, dp: int, dp_axis: Optional[str], what: str = "moe"):
+    """Wrap a jitted fn with a clear batch-divisibility error for the data
+    axis (shared by the parallel-layer and model-layer ep entry points)."""
+    if dp <= 1:
+        return jitted
+
+    def infer(p, x):
+        if x.shape[0] % dp:
+            raise ValueError(
+                f"{what}: batch {x.shape[0]} not divisible by the "
+                f"{dp_axis!r} axis size {dp}; pad the batch or pass "
+                f"dp_axis=None")
+        return jitted(p, x)
+
+    return infer
+
+
 def make_expert_parallel_moe(params: Dict[str, jax.Array], mesh: Mesh,
                              ep_axis: str = "expert",
                              dp_axis: Optional[str] = "data",
@@ -108,10 +125,11 @@ def make_expert_parallel_moe(params: Dict[str, jax.Array], mesh: Mesh,
     the dispatch/combine all-to-alls over ICI."""
     shardings = moe_shardings(params, mesh, ep_axis)
     placed = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
-    x_spec = P(dp_axis) if dp_axis and dp_axis in mesh.shape else P()
+    dp = mesh.shape.get(dp_axis, 1) if dp_axis else 1
+    x_spec = P(dp_axis) if dp > 1 else P()
     jitted = jax.jit(
         lambda p, x: moe_apply(p, x, capacity_factor),
         in_shardings=(shardings, NamedSharding(mesh, x_spec)),
         out_shardings=(NamedSharding(mesh, x_spec), None),
     )
-    return jitted, placed
+    return dp_guard(jitted, dp, dp_axis), placed

@@ -1,0 +1,141 @@
+"""tensor_if full operator/action sweep.
+
+Mirrors the reference's unittest_if discipline
+(/root/reference/tests/unittest_if, gsttensorif.c operator table): every
+operator exercised against values below/at/inside/above the comparison
+points, both branch actions, TENSORPICK narrowing, and error paths.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.graph import Pipeline
+
+
+def caps_of(dims, types):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types)))
+
+
+def run_if(values, **if_props):
+    """Push scalar frames through tensor_if; return the values that passed
+    the then-branch."""
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("1", "float32"),
+                    data=[np.full(1, v, np.float32) for v in values])
+    tif = p.add_new("tensor_if", compared_value="TENSOR_AVERAGE_VALUE",
+                    compared_value_option="0", **if_props)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, tif, sink)
+    p.run(timeout=30)
+    return [float(b.memories[0].host()[0]) for b in sink.buffers]
+
+
+VALUES = [2.0, 5.0, 6.0, 7.0, 9.0]
+
+#: operator → (supplied_value, expected survivors of VALUES)
+CASES = {
+    "EQ": ("5", [5.0]),
+    "NE": ("5", [2.0, 6.0, 7.0, 9.0]),
+    "GT": ("5", [6.0, 7.0, 9.0]),
+    "GE": ("5", [5.0, 6.0, 7.0, 9.0]),
+    "LT": ("5", [2.0]),
+    "LE": ("5", [2.0, 5.0]),
+    "RANGE_INCLUSIVE": ("5:7", [5.0, 6.0, 7.0]),
+    "RANGE_EXCLUSIVE": ("5:7", [6.0]),
+    "NOT_IN_RANGE_INCLUSIVE": ("5:7", [2.0, 9.0]),
+    "NOT_IN_RANGE_EXCLUSIVE": ("5:7", [2.0, 5.0, 7.0, 9.0]),
+}
+
+
+@pytest.mark.parametrize("op", sorted(CASES))
+def test_operator(op):
+    supplied, want = CASES[op]
+    got = run_if(VALUES, operator=op, supplied_value=supplied,
+                 then="PASSTHROUGH")
+    assert got == want, f"{op} supplied={supplied}"
+
+
+@pytest.mark.parametrize("op", sorted(CASES))
+def test_operator_else_branch_complement(op):
+    """then=SKIP + else=PASSTHROUGH yields exactly the complement set."""
+    supplied, want = CASES[op]
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("1", "float32"),
+                    data=[np.full(1, v, np.float32) for v in VALUES])
+    tif = p.add_new("tensor_if", compared_value="TENSOR_AVERAGE_VALUE",
+                    compared_value_option="0", operator=op,
+                    supplied_value=supplied, then="SKIP")
+    tif.set_properties(**{"else": "PASSTHROUGH"})
+    tif.add_src_pad("src_else")
+    s_then = p.add_new("tensor_sink", store=True)
+    s_else = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, tif)
+    tif.src_pads[0].link(s_then.sink_pad)
+    tif.src_pads[1].link(s_else.sink_pad)
+    p.run(timeout=30)
+    assert s_then.num_buffers == 0  # SKIP drops the then-branch
+    got_else = [float(b.memories[0].host()[0]) for b in s_else.buffers]
+    assert got_else == [v for v in VALUES if v not in want]
+
+
+def test_tensorpick_then_action():
+    """TENSORPICK narrows the frame to the chosen tensors on the branch."""
+    frames = [[np.full(2, v, np.float32), np.full(3, -v, np.float32)]
+              for v in [1.0, 9.0]]
+    from nnstreamer_tpu.core.buffer import Buffer
+
+    p2 = Pipeline()
+    src = p2.add_new("appsrc", caps=caps_of("2,3", "float32,float32"),
+                     data=[Buffer.from_arrays(f) for f in frames])
+    tif = p2.add_new("tensor_if", compared_value="TENSOR_AVERAGE_VALUE",
+                     compared_value_option="0", operator="GT",
+                     supplied_value="5", then="TENSORPICK", then_option="1")
+    sink = p2.add_new("tensor_sink", store=True)
+    Pipeline.link(src, tif, sink)
+    p2.run(timeout=30)
+    assert sink.num_buffers == 1
+    buf = sink.buffers[0]
+    assert buf.num_tensors == 1
+    np.testing.assert_array_equal(buf.memories[0].host(),
+                                  np.full(3, -9.0, np.float32))
+
+
+def test_a_value_multidim_coordinate():
+    """A_VALUE with innermost-first coords picks one element of tensor 0."""
+    arr0 = np.zeros((2, 3), np.float32)   # dims "3:2"
+    arr0[1, 2] = 8.0                      # coords innermost-first: 2:1
+    arr1 = np.zeros((2, 3), np.float32)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("3:2", "float32"),
+                    data=[arr0, arr1])
+    tif = p.add_new("tensor_if", compared_value="A_VALUE",
+                    compared_value_option="2:1:0", operator="GT",
+                    supplied_value="5")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, tif, sink)
+    p.run(timeout=30)
+    assert sink.num_buffers == 1
+    np.testing.assert_array_equal(sink.buffers[0].memories[0].host(), arr0)
+
+
+@pytest.mark.parametrize("bad", [
+    dict(operator="BOGUS", supplied_value="5"),
+    dict(operator="GT", supplied_value="not-a-number"),
+    dict(compared_value="NOPE", operator="GT", supplied_value="5"),
+])
+def test_invalid_config_fails(bad):
+    from nnstreamer_tpu.graph.pipeline import PipelineError
+
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("1", "float32"),
+                    data=[np.zeros(1, np.float32)])
+    props = dict(compared_value="TENSOR_AVERAGE_VALUE",
+                 compared_value_option="0")
+    props.update(bad)
+    tif = p.add_new("tensor_if", **props)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, tif, sink)
+    with pytest.raises((PipelineError, ValueError, KeyError)):
+        p.run(timeout=30)
