@@ -189,6 +189,102 @@ def _extra_benches(tmpdir: str) -> dict:
     return out
 
 
+def _composite_bench() -> dict:
+    """BASELINE.md composite row: tensor_mux + repo-LSTM loop served
+    behind tensor_query offload; a localhost client measures end-to-end
+    FPS and per-frame round-trip p50 (send→result, matched by offset)."""
+    import socket
+    import traceback
+
+    try:
+        from nnstreamer_tpu.core import Caps
+        from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.elements.repo import reset_repo
+        from nnstreamer_tpu.graph import Pipeline
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        reset_repo()
+        n_frames, warm = 192, 16
+        feats, d_in = 64, 32
+        sp = Pipeline("bench-lstm-server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims=f"{d_in}:1",
+                          types="float32")
+        state = sp.add_new("tensor_reposrc", slot_index=77,
+                           dims=f"{feats}:1,{feats}:1",
+                           types="float32,float32")
+        mux = sp.add_new("tensor_mux", sync_mode="nosync")
+        filt = sp.add_new("tensor_filter", framework="xla-tpu",
+                          model=f"zoo://lstm_cell?features={feats}"
+                                f"&input_size={d_in}")
+        demux = sp.add_new("tensor_demux", tensorpick="0,1:2")
+        qo, qs = sp.add_new("queue"), sp.add_new("queue")
+        ssink = sp.add_new("tensor_query_serversink", id=0, async_depth=32)
+        rsink = sp.add_new("tensor_reposink", slot_index=77)
+        Pipeline.link(ssrc, mux)
+        Pipeline.link(state, mux)
+        Pipeline.link(mux, filt, demux)
+        Pipeline.link(demux, qo, ssink)   # y → back to the client
+        Pipeline.link(demux, qs, rsink)   # (h', c') → loop
+        sp.start()
+        time.sleep(0.3)
+
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings(f"{d_in}:1", "float32")))
+        rng = np.random.default_rng(0)
+
+        # phase 1 — true per-frame round trip: SYNC client (depth=1), so
+        # each measurement is send→result with no queueing delay
+        sync_n = 24
+        rtts: list = []
+        cp = Pipeline("bench-lstm-client-sync")
+        send_t = {"t": 0.0}
+
+        def sync_gen():
+            for _ in range(sync_n):
+                send_t["t"] = time.monotonic()
+                yield rng.normal(size=(1, d_in)).astype(np.float32)
+
+        src = cp.add_new("appsrc", caps=caps, data=sync_gen())
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port)
+        sink = cp.add_new("tensor_sink")
+        sink.new_data = lambda b: rtts.append(time.monotonic() - send_t["t"])
+        Pipeline.link(src, qc, sink)
+        cp.run(timeout=300)
+
+        # phase 2 — throughput: pipelined client+server (async_depth) so
+        # the per-frame device RTT overlaps instead of serializing
+        cp2 = Pipeline("bench-lstm-client")
+        src2 = cp2.add_new("appsrc", caps=caps, data=(
+            rng.normal(size=(1, d_in)).astype(np.float32)
+            for _ in range(n_frames + warm)))
+        qc2 = cp2.add_new("tensor_query_client", host="127.0.0.1",
+                          port=port, async_depth=32)
+        sink2 = cp2.add_new("tensor_sink")
+        arrivals: list = []
+        sink2.new_data = lambda b: arrivals.append(time.monotonic())
+        Pipeline.link(src2, qc2, sink2)
+        cp2.run(timeout=600)
+        sp.stop()
+        if len(arrivals) < warm + 32:
+            return {}
+        peak, med = _windowed_fps(arrivals, warm, 0, window=32)
+        p50 = float(np.percentile(np.asarray(rtts[4:]) * 1e6, 50)) \
+            if len(rtts) > 8 else None
+        row = {"composite_lstm_query_fps": round(peak, 2),
+               "composite_lstm_query_fps_median": round(med, 2),
+               "composite_roundtrip_p50_us":
+                   round(p50, 1) if p50 else None}
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _with_batch(model_spec: str, batch: int) -> str:
     return model_spec + ("&" if "?" in model_spec else "?") + f"batch={batch}"
 
@@ -445,6 +541,8 @@ def main() -> None:
             result.update(_batched_bench(labels_path))
             _mark("adaptive batch bench starting")
             result.update(_adaptive_bench(labels_path))
+            _mark("composite LSTM+query bench starting")
+            result.update(_composite_bench())
             if flops and result.get("adaptive_batch16_fps_median"):
                 result["adaptive_batch16_mfu"] = round(
                     probes.mfu(flops,

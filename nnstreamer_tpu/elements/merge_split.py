@@ -96,7 +96,11 @@ class TensorMerge(CollectingElement):
                 out = jnp.concatenate([m.device() for m in arrays], axis=np_axis)
             else:
                 out = np.concatenate([m.host() for m in arrays], axis=np_axis)
-            r = self.push(Buffer([TensorMemory(out)], pts=pts,
+            meta: dict = {}
+            for p in self.sink_pads:  # first pad wins on conflicts
+                for k, v in frame[p.name].meta.items():
+                    meta.setdefault(k, v)
+            r = self.push(Buffer([TensorMemory(out)], pts=pts, meta=meta,
                                  config=self._out_config))
             if r is FlowReturn.ERROR:
                 ret = r

@@ -66,9 +66,20 @@ class TensorMux(CollectingElement):
         ret = FlowReturn.OK
         for frame, pts in sets:
             mems: List = []
+            meta: dict = {}
+            offset = None
             for p in self.sink_pads:
-                mems.extend(frame[p.name].memories)
-            out = Buffer(mems, pts=pts, config=getattr(self, "_out_config", None))
+                b = frame[p.name]
+                mems.extend(b.memories)
+                # union constituent metadata, first pad wins on conflicts
+                # (e.g. query_client_id must survive a mux in a server
+                # pipeline loop, reference serversink pairing semantics)
+                for k, v in b.meta.items():
+                    meta.setdefault(k, v)
+                if offset is None:
+                    offset = b.offset
+            out = Buffer(mems, pts=pts, offset=offset, meta=meta,
+                         config=getattr(self, "_out_config", None))
             r = self.push(out)
             if r is FlowReturn.ERROR:
                 ret = r

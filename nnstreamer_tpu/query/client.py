@@ -7,11 +7,24 @@ broker-based discovery via tensor_query_hybrid when ``operation`` is set).
 Props: host/port (direct), or ``operation=<topic>`` + broker-host/port for
 hybrid discovery; ``sparse=true`` compresses request payloads;
 ``max-request-retry`` bounds reconnect attempts.
+
+``async_depth=N`` (TPU-first addition, default 1 = reference-equivalent
+synchronous semantics): keep up to N requests in flight on the one TCP
+stream. A server whose filter runs on a high-RTT device (a tunneled TPU)
+costs one device round trip per frame; with N>1 those round trips overlap
+and offload throughput approaches N/RTT instead of 1/RTT — the query-layer
+analog of tensor_decoder's ``async_depth``. Results return in order (the
+stream and the server pipeline are serial), so PTS restoration is a FIFO.
+Retry/reconnect applies to the synchronous path; in pipelined mode a
+connection failure fails the in-flight window (pipeline error) rather than
+silently replaying frames.
 """
 
 from __future__ import annotations
 
+import collections
 import socket
+import threading
 import time
 from typing import Any, Optional
 
@@ -44,11 +57,16 @@ class TensorQueryClient(Element):
         self.sparse = False
         self.max_request_retry = 3
         self.timeout_s = 10.0
+        self.async_depth = 1  # >1: pipelined requests (see module doc)
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
         self.add_src_pad(template=Caps.any_tensors())
         self._sock: Optional[socket.socket] = None
         self._caps_out_sent = False
+        self._pending: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._reader: Optional[threading.Thread] = None
+        self._reader_error: Optional[Exception] = None
 
     # -- connection ---------------------------------------------------------- #
     def _resolve_endpoints(self) -> list:
@@ -104,14 +122,28 @@ class TensorQueryClient(Element):
 
     def start(self) -> None:
         self._caps_out_sent = False
+        self._reader_error = None
 
     def stop(self) -> None:
         if self._sock is not None:
+            try:
+                # shutdown (not just close) unblocks a reader thread
+                # parked in recv; bare close can leave it hanging
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+        r = self._reader
+        if r is not None and r is not threading.current_thread():
+            r.join(timeout=5)
+        self._reader = None
+        with self._cv:
+            self._pending.clear()
+            self._cv.notify_all()
 
     # -- negotiation --------------------------------------------------------- #
     def on_caps(self, pad: Pad, caps: Caps) -> None:
@@ -120,8 +152,87 @@ class TensorQueryClient(Element):
         # flexible; static caps could be fetched from the server in future
         self.send_caps_all(Caps.tensors(format=TensorFormat.FLEXIBLE))
 
+    # -- pipelined dataflow --------------------------------------------------- #
+    def _reader_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                cmd, rmeta, rpayload = recv_message(sock)
+                if cmd is Cmd.ERROR:
+                    raise QueryProtocolError(rmeta.get("error", "server error"))
+                if cmd is not Cmd.RESULT:
+                    raise QueryProtocolError(f"unexpected reply {cmd}")
+                with self._cv:
+                    if not self._pending:
+                        raise QueryProtocolError("unsolicited RESULT")
+                    pts, duration, offset = self._pending[0]
+                out = payload_to_buffer(rmeta, rpayload)
+                out.pts, out.duration, out.offset = pts, duration, offset
+                self.push(out)
+                with self._cv:
+                    # pop only AFTER the push: an EOS drain waiting on the
+                    # window must not race past a result still mid-push
+                    self._pending.popleft()
+                    self._cv.notify_all()
+        except (ConnectionError, OSError, QueryProtocolError) as e:
+            with self._cv:
+                # in-flight frames are lost; surface unless this is a clean
+                # shutdown with nothing outstanding
+                if self._pending or not isinstance(e, OSError):
+                    self._reader_error = e
+                    self.post_error(f"query reader failed with "
+                                    f"{len(self._pending)} in flight: {e}",
+                                    exc=e)
+                self._pending.clear()
+                self._cv.notify_all()
+
+    def _chain_pipelined(self, buf: Buffer, depth: int) -> FlowReturn:
+        meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        if self._reader is not None and not self._reader.is_alive() \
+                and self._reader_error is None:
+            # reader exited cleanly (server closed between streams):
+            # reconnect fresh on the next frame
+            self._reader = None
+            self.stop()
+        sock = self._ensure_conn()
+        if self._reader is None:
+            # the reader blocks in recv indefinitely (stop() unblocks it
+            # via shutdown); the connect timeout must NOT ride along or a
+            # >timeout_s gap between results (e.g. a server-side XLA
+            # compile) would kill the stream
+            sock.settimeout(None)
+            self._reader = threading.Thread(
+                target=self._reader_loop, args=(sock,), daemon=True,
+                name=f"qclient-reader:{self.name}")
+            self._reader.start()
+        with self._cv:
+            while len(self._pending) >= depth and self._reader_error is None:
+                self._cv.wait(0.1)
+            if self._reader_error is not None:
+                return FlowReturn.ERROR  # error already on the bus
+            self._pending.append((buf.pts, buf.duration, buf.offset))
+        try:
+            send_message(sock, Cmd.DATA, meta, payload)
+        except OSError as e:
+            self.post_error(f"query send failed: {e}", exc=e)
+            return FlowReturn.ERROR
+        return FlowReturn.OK
+
+    def _drain_pending(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending and self._reader_error is None \
+                    and time.monotonic() < deadline:
+                self._cv.wait(0.2)
+
+    def on_eos(self) -> None:
+        # all in-flight results must be pushed before EOS propagates
+        self._drain_pending()
+
     # -- dataflow ------------------------------------------------------------- #
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        depth = int(self.async_depth or 1)
+        if depth > 1:
+            return self._chain_pipelined(buf, depth)
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
         for attempt in range(max(int(self.max_request_retry), 1)):
             try:

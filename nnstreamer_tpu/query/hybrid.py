@@ -80,6 +80,7 @@ class DiscoveryBroker:
 
 def _rpc(host: str, port: int, msg: dict, timeout: float = 5.0) -> dict:
     with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.sendall((json.dumps(msg) + "\n").encode())
         data = sock.makefile().readline()
     return json.loads(data or "{}")

@@ -372,6 +372,8 @@ class MqttBroker:
                 continue
             except OSError:
                 return
+            # Nagle + delayed ACK stalls small PUBLISH forwards ~40 ms
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._conn_loop, args=(conn,),
                              daemon=True).start()
 
@@ -458,6 +460,7 @@ class MqttClient:
                  keep_alive: int = 60, timeout: float = 5.0):
         self.keep_alive = int(keep_alive)
         self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.sendall(encode_connect(client_id, self.keep_alive))
         ptype, _, body = read_packet(self.sock)
         if ptype != CONNACK or len(body) < 2 or body[1] != 0:

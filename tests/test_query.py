@@ -572,3 +572,112 @@ time.sleep(30)
         finally:
             srv.kill()
             srv.wait(timeout=10)
+
+
+class TestPipelinedOffload:
+    """async_depth on tensor_query_client/serversink: pipelined offload
+    (TPU-first RTT hiding; default depth=1 keeps reference-sync semantics)."""
+
+    def _server(self, port, depth=8):
+        sp = Pipeline("server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims="4:1", types="float32")
+        filt = sp.add_new("tensor_filter", model=lambda x: x * 10)
+        ssink = sp.add_new("tensor_query_serversink", id=0,
+                           async_depth=depth)
+        Pipeline.link(ssrc, filt, ssink)
+        return sp
+
+    def test_pipelined_roundtrip_order_and_values(self):
+        port = free_port()
+        sp = self._server(port)
+        sp.start()
+        try:
+            time.sleep(0.2)
+            n = 40
+            cp = Pipeline("client")
+            src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                             data=[np.full((1, 4), i, np.float32)
+                                   for i in range(n)])
+            qc = cp.add_new("tensor_query_client", host="127.0.0.1",
+                            port=port, async_depth=8)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            cp.run(timeout=120)
+            assert sink.num_buffers == n  # EOS drained every in-flight frame
+            for i, b in enumerate(sink.buffers):
+                np.testing.assert_array_equal(
+                    b.memories[0].host(),
+                    np.full((1, 4), i * 10, np.float32))
+                assert b.offset == i  # timestamps restored in order
+        finally:
+            sp.stop()
+
+    def test_pipelined_faster_than_sync_with_slow_server(self):
+        """A server with per-frame latency must overlap across the window."""
+        port = free_port()
+        sp = Pipeline("server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims="4:1", types="float32")
+
+        from nnstreamer_tpu.filters.custom import register_custom_easy
+
+        def slow(x):
+            time.sleep(0.05)
+            return x
+
+        register_custom_easy("qtest_slow_echo", slow,
+                             ("4:1", "float32"), ("4:1", "float32"))
+        filt = sp.add_new("tensor_filter", framework="custom-easy",
+                          model="qtest_slow_echo")
+        ssink = sp.add_new("tensor_query_serversink", id=0, async_depth=16)
+        Pipeline.link(ssrc, filt, ssink)
+        sp.start()
+        try:
+            time.sleep(0.2)
+            n = 20
+            cp = Pipeline("client")
+            src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                             data=[np.zeros((1, 4), np.float32)] * n)
+            qc = cp.add_new("tensor_query_client", host="127.0.0.1",
+                            port=port, async_depth=16)
+            sink = cp.add_new("tensor_sink", store=True)
+            Pipeline.link(src, qc, sink)
+            t0 = time.monotonic()
+            cp.run(timeout=120)
+            wall = time.monotonic() - t0
+            assert sink.num_buffers == n
+            # the server filter itself is serial (20 × 50 ms ≥ 1 s), but
+            # client-side send/receive overlap must not ADD per-frame
+            # round trips on top; sync mode costs ≥ n × (invoke + 2 RTT)
+            assert wall < n * 0.05 * 2.5, f"no overlap: {wall:.2f}s"
+        finally:
+            sp.stop()
+
+    def test_reader_failure_surfaces_on_bus(self):
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        port = free_port()
+        sp = self._server(port)
+        sp.start()
+        time.sleep(0.2)
+
+        killed = {}
+
+        def gen():
+            for i in range(100):
+                if i == 25 and not killed:
+                    killed["yes"] = True
+                    sp.stop()  # kill server with frames in flight
+                    time.sleep(0.3)
+                yield np.zeros((1, 4), np.float32)
+
+        cp = Pipeline("client")
+        src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                         data=gen())
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
+                        async_depth=8)
+        sink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(src, qc, sink)
+        with pytest.raises((PipelineError, TimeoutError)):
+            cp.run(timeout=30)
