@@ -268,3 +268,43 @@ class TestBatchedServingPipeline:
                             for b in sink.buffers]
         assert len(results["batched"]) == 10
         assert results["batched"] == results["ref"]
+
+    def test_ssd_detection_batched_equals_per_frame(self, tmp_path):
+        """bounding_box decode after tensor_unbatch (sliced batched model
+        output) must byte-equal the per-frame pipeline's overlays."""
+        from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+
+        priors = tmp_path / "p.txt"
+        write_box_priors(str(priors), size=96)
+        labels = tmp_path / "l.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(6)))
+        spec = ("zoo://ssd_mobilenet_v2?size=96&width=0.25&num_classes=6"
+                "&dtype=float32")
+        opts = dict(option1="mobilenet-ssd", option2=str(labels),
+                    option3=str(priors), option4="96:96", option5="96:96")
+        results = {}
+        for key, batched in (("ref", 0), ("batched", 4)):
+            p = Pipeline()
+            src = p.add_new("videotestsrc", width=96, height=96,
+                            num_buffers=6, pattern="random")
+            conv = p.add_new("tensor_converter")
+            chain = [src, conv]
+            model = spec
+            if batched:
+                chain.append(p.add_new("tensor_batch", max_batch=batched,
+                                       budget_ms=1000.0))
+                model = spec + f"&batch={batched}"
+            chain.append(p.add_new("tensor_filter", framework="xla-tpu",
+                                   model=model))
+            if batched:
+                chain.append(p.add_new("tensor_unbatch"))
+            chain.append(p.add_new("tensor_decoder", mode="bounding_box",
+                                   **opts))
+            sink = p.add_new("tensor_sink", store=True)
+            chain.append(sink)
+            Pipeline.link(*chain)
+            p.run(timeout=180)
+            results[key] = [b.memories[0].host().tobytes()
+                            for b in sink.buffers]
+        assert len(results["batched"]) == 6
+        assert results["batched"] == results["ref"]

@@ -1,0 +1,197 @@
+"""End-to-end tensor_mux / tensor_merge sync-policy sweeps under jittered
+and mismatched-rate timestamps.
+
+Mirrors the reference's mux/merge SSAT groups
+(/root/reference/tests/nnstreamer_mux, nnstreamer_merge, and
+Documentation/synchronization-policies-at-mux-merge.md): two live-paced
+streams at different rates flow through a mux/merge with each policy and
+the emitted PTS/pairings are asserted — not just the CollectPads unit
+behavior (tests/test_graph.py) but the element + threaded-pipeline path.
+"""
+
+import time
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+
+def caps_of(dims, types, rate=Fraction(30, 1)):
+    return Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings(dims, types), rate))
+
+
+def stamped(values, period_ns, jitter_ns=0, seed=0):
+    """Buffers with PTS = i*period + jitter (deterministic)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i, v in enumerate(values):
+        j = int(rng.integers(-jitter_ns, jitter_ns + 1)) if jitter_ns else 0
+        out.append(Buffer.of(np.full((2,), v, np.float32),
+                             pts=max(0, i * period_ns + j),
+                             duration=period_ns))
+    return out
+
+
+def run_mux(fast, slow, sync_mode, sync_option=""):
+    p = Pipeline()
+    s1 = p.add_new("appsrc", caps=caps_of("2", "float32"), data=fast)
+    s2 = p.add_new("appsrc", caps=caps_of("2", "float32"), data=slow)
+    mux = p.add_new("tensor_mux", sync_mode=sync_mode,
+                    sync_option=sync_option)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(s1, mux)
+    Pipeline.link(s2, mux)
+    Pipeline.link(mux, sink)
+    p.run(timeout=60)
+    return sink
+
+
+MS = 1_000_000
+
+
+class TestMuxPolicies:
+    def test_slowest_rate_mismatch(self):
+        """30 Hz + 10 Hz under SLOWEST: output paced by the slow stream,
+        fast-pad values are the freshest not-newer ones."""
+        fast = stamped(range(12), 33 * MS)            # ~30 Hz
+        slow = stamped(range(100, 104), 100 * MS)     # 10 Hz
+        sink = run_mux(fast, slow, "slowest")
+        assert 3 <= sink.num_buffers <= 5
+        for b in sink.buffers:
+            assert b.num_tensors == 2
+            f, s = b.memories[0].host()[0], b.memories[1].host()[0]
+            # paired fast frame: the latest with pts <= the slow frame's
+            # pts (slow period = 3 fast periods, so index ~ 3*(s-100))
+            assert f == pytest.approx(min(int(s - 100) * 3, 11), abs=1)
+
+    def test_slowest_with_jitter_monotonic_pts(self):
+        fast = stamped(range(30), 33 * MS, jitter_ns=5 * MS, seed=1)
+        slow = stamped(range(10), 100 * MS, jitter_ns=5 * MS, seed=2)
+        sink = run_mux(fast, slow, "slowest")
+        pts = [b.pts for b in sink.buffers]
+        assert pts == sorted(pts), "jitter must not reorder output PTS"
+        assert sink.num_buffers >= 8
+
+    def test_nosync_pairs_in_arrival_order(self):
+        a = stamped(range(5), 33 * MS)
+        b = stamped(range(10, 15), 100 * MS)
+        sink = run_mux(a, b, "nosync")
+        assert sink.num_buffers == 5
+        for i, buf in enumerate(sink.buffers):
+            assert buf.memories[0].host()[0] == i
+            assert buf.memories[1].host()[0] == 10 + i
+
+    def test_basepad_window_pairing(self):
+        """BASEPAD on pad 0 with a 40 ms window: every output carries pad
+        0's PTS; pad 1 contributes its closest in-window frame."""
+        base = stamped(range(6), 100 * MS)
+        other = stamped(range(50, 68), 33 * MS)
+        sink = run_mux(base, other, "basepad", sync_option="0:40000000")
+        assert sink.num_buffers >= 4
+        base_pts = {b.pts for b in sink.buffers}
+        want_pts = {i * 100 * MS for i in range(6)}
+        assert base_pts <= want_pts, "basepad output must use base-pad PTS"
+
+    def test_refresh_reuses_stale_pad(self):
+        """REFRESH emits on every arrival, reusing the other pad's last."""
+        a = stamped(range(3), 200 * MS)
+        b = stamped(range(20, 29), 33 * MS)
+        sink = run_mux(a, b, "refresh")
+        # every pushed buffer pairs both pads even when one is stale
+        assert sink.num_buffers >= 9
+        for buf in sink.buffers:
+            assert buf.num_tensors == 2
+
+
+class TestMergePolicies:
+    def test_merge_concat_first_with_sync(self):
+        p = Pipeline()
+        a = stamped([1, 2, 3], 100 * MS)
+        b = stamped([9, 8, 7], 100 * MS)
+        # (2,) tensors -> dims "2"; merge along innermost => (4,)
+        s1 = p.add_new("appsrc", caps=caps_of("2", "float32"), data=a)
+        s2 = p.add_new("appsrc", caps=caps_of("2", "float32"), data=b)
+        mrg = p.add_new("tensor_merge", mode="linear", option="first",
+                        sync_mode="slowest")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(s1, mrg)
+        Pipeline.link(s2, mrg)
+        Pipeline.link(mrg, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 3
+        first = sink.buffers[0].memories[0].host()
+        assert first.shape == (4,)
+        np.testing.assert_array_equal(first, [1, 1, 9, 9])
+
+    def test_merge_concat_second_axis(self):
+        """option=second concatenates along the 2nd-innermost dim: two
+        (3, 2) tensors (dims 2:3) become (6, 2)."""
+        p = Pipeline()
+
+        def bufs(base):
+            return [Buffer.of(
+                np.full((3, 2), base + i, np.float32),
+                pts=i * 100 * MS, duration=100 * MS) for i in range(2)]
+
+        s1 = p.add_new("appsrc", caps=caps_of("2:3", "float32"),
+                       data=bufs(0))
+        s2 = p.add_new("appsrc", caps=caps_of("2:3", "float32"),
+                       data=bufs(10))
+        mrg = p.add_new("tensor_merge", mode="linear", option="second",
+                        sync_mode="slowest")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(s1, mrg)
+        Pipeline.link(s2, mrg)
+        Pipeline.link(mrg, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 2
+        out = sink.buffers[0].memories[0].host()
+        assert out.shape == (6, 2)
+        np.testing.assert_array_equal(
+            out, np.concatenate([np.full((3, 2), 0), np.full((3, 2), 10)]))
+
+    def test_merge_rejects_rank_mismatch(self):
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        p = Pipeline()
+        s1 = p.add_new("appsrc", caps=caps_of("2", "float32"),
+                       data=stamped([1], 33 * MS))
+        s2 = p.add_new("appsrc", caps=caps_of("2:3", "float32"),
+                       data=[Buffer.of(np.zeros((3, 2), np.float32),
+                                       pts=0, duration=33 * MS)])
+        mrg = p.add_new("tensor_merge", mode="linear", option="first",
+                        sync_mode="nosync")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(s1, mrg)
+        Pipeline.link(s2, mrg)
+        Pipeline.link(mrg, sink)
+        with pytest.raises((PipelineError, ValueError)):
+            p.run(timeout=30)
+
+
+class TestMuxThreeStreams:
+    def test_three_pads_slowest(self):
+        """Reference SSAT exercises 3-4 stream muxes; pairing must hold
+        with a third, slowest stream driving the cadence."""
+        p = Pipeline()
+        streams = [stamped(range(9), 33 * MS),
+                   stamped(range(10, 16), 50 * MS),
+                   stamped(range(20, 23), 100 * MS)]
+        mux = p.add_new("tensor_mux", sync_mode="slowest")
+        for st in streams:
+            src = p.add_new("appsrc", caps=caps_of("2", "float32"), data=st)
+            Pipeline.link(src, mux)
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(mux, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers >= 2
+        for b in sink.buffers:
+            assert b.num_tensors == 3
+        pts = [b.pts for b in sink.buffers]
+        assert pts == sorted(pts)
