@@ -124,7 +124,9 @@ def make_moe_transformer(layers: str = "2", dim: str = "128",
         in_info=TensorsInfo.from_strings(f"{d_in}:{L}:{B}", "float32"),
         out_info=TensorsInfo.from_strings(f"{D}:{L}:{B}", "float32"),
         metadata={"layers": int(layers), "dim": D, "heads": int(heads),
-                  "experts": E, "seq": L})
+                  "experts": E, "seq": L,
+                  "capacity_factor": float(capacity_factor),
+                  "dtype": dtype})
 
 
 def ep_param_shardings(params: Any, mesh, n_experts: int,
@@ -166,6 +168,45 @@ def make_ep_infer(bundle: ModelBundle, mesh, ep_axis: str = "expert",
     from ..parallel.moe import dp_guard
 
     return dp_guard(jitted, dp, dp_axis, what="ep infer"), placed
+
+
+def make_sp_ep_infer(bundle: ModelBundle, mesh, sp_axis: str = "sp",
+                     ep_axis: str = "expert", sp_mode: str = "ring"):
+    """(infer_fn, placed_params) composing BOTH long-context and expert
+    scaling on one 2D mesh: attention runs sequence-parallel over
+    ``sp_axis`` (ring ppermute or Ulysses all-to-all — context length
+    scales with that axis) while MoE expert stacks shard over ``ep_axis``
+    (parameter count scales with that axis). Inputs/outputs are
+    globally-shaped with the L axis sharded over ``sp_axis``."""
+    from ..parallel.ring import sp_attention_fn
+
+    meta = bundle.metadata
+    attn = sp_attention_fn(sp_mode, mesh, sp_axis)
+    model = MoEStreamTransformer(
+        layers=meta["layers"], dim=meta["dim"], heads=meta["heads"],
+        n_experts=meta["experts"],
+        capacity_factor=meta.get("capacity_factor", 1.25),
+        dtype=jnp.bfloat16 if meta.get("dtype") == "bfloat16"
+        else jnp.float32,
+        attention_fn=attn)
+    shardings = ep_param_shardings(bundle.params, mesh, meta["experts"],
+                                   ep_axis)
+    placed = jax.tree_util.tree_map(jax.device_put, bundle.params, shardings)
+    x_spec = P(None, sp_axis, None)
+    jitted = jax.jit(
+        lambda p, x: model.apply(p, x),
+        in_shardings=(shardings, NamedSharding(mesh, x_spec)),
+        out_shardings=NamedSharding(mesh, x_spec))
+
+    def infer(p, x):
+        sp = mesh.shape[sp_axis]
+        if x.shape[1] % sp:
+            raise ValueError(
+                f"sp×ep infer: sequence {x.shape[1]} not divisible by the "
+                f"{sp_axis!r} axis size {sp}")
+        return jitted(p, x)
+
+    return infer, placed
 
 
 register_model("moe_transformer", make_moe_transformer)

@@ -109,16 +109,10 @@ def make_sp_apply(bundle: ModelBundle, mesh, mode: str = "ring",
     """Rebuild the bundle's apply with sequence-parallel attention over
     ``mesh``: returns (apply_fn, params). Inputs/outputs are globally-shaped;
     shard the L axis with PartitionSpec(None, axis_name, None)."""
-    from ..parallel.ring import a2a_attention, ring_attention
+    from ..parallel.ring import sp_attention_fn
 
     meta = bundle.metadata
-    if mode == "ring":
-        attn = lambda q, k, v: ring_attention(q, k, v, mesh, axis_name,
-                                              causal=causal)
-    elif mode in ("a2a", "ulysses"):
-        attn = lambda q, k, v: a2a_attention(q, k, v, mesh, axis_name)
-    else:
-        raise ValueError(f"unknown sp mode {mode!r}")
+    attn = sp_attention_fn(mode, mesh, axis_name, causal=causal)
     model = StreamTransformer(layers=meta["layers"], dim=meta["dim"],
                               heads=meta["heads"], dtype=jnp.float32,
                               attention_fn=attn)

@@ -153,3 +153,18 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def sp_attention_fn(mode: str, mesh: Mesh, axis_name: str = "sp",
+                    causal: bool = False):
+    """``(q, k, v) -> o`` attention callable for the requested
+    sequence-parallel mode — the one dispatch point model factories use
+    (stream_transformer.make_sp_apply, moe_transformer.make_sp_ep_infer)."""
+    if mode == "ring":
+        return lambda q, k, v: ring_attention(q, k, v, mesh, axis_name,
+                                              causal=causal)
+    if mode in ("a2a", "ulysses"):
+        if causal:
+            raise ValueError("a2a/ulysses attention has no causal mode")
+        return lambda q, k, v: a2a_attention(q, k, v, mesh, axis_name)
+    raise ValueError(f"unknown sp mode {mode!r}")

@@ -130,3 +130,50 @@ def test_ep_infer_rejects_indivisible_batch():
     infer1, placed1 = make_ep_infer(b, mesh, dp_axis=None)
     out = infer1(placed1, jnp.zeros((1, 16, 32), jnp.float32))
     assert out.shape == (1, 16, 32)
+
+
+@pytest.mark.parametrize("sp_mode", ["ring", "a2a"])
+def test_sp_ep_composed_equals_single_device(sp_mode):
+    """Sequence-parallel attention × expert-parallel MoE on one 2D mesh
+    equals the single-device oracle (long-context + experts composed)."""
+    from nnstreamer_tpu.models.moe_transformer import make_sp_ep_infer
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import make_mesh
+
+    b = get_model(SPEC)  # seq=16, experts=4, float32
+    x = np.random.default_rng(2).normal(size=(1, 16, 32)).astype(np.float32)
+    want = np.asarray(jax.jit(b.fn())(x))
+    mesh = make_mesh({"sp": 2, "expert": 4})
+    infer, placed = make_sp_ep_infer(b, mesh, sp_mode=sp_mode)
+    got = np.asarray(infer(placed, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_sp_ep_rejects_indivisible_sequence():
+    from nnstreamer_tpu.models.moe_transformer import make_sp_ep_infer
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import make_mesh
+
+    b = get_model(SPEC.replace("seq=16", "seq=15"))
+    mesh = make_mesh({"sp": 2, "expert": 4})
+    infer, placed = make_sp_ep_infer(b, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        infer(placed, jnp.zeros((1, 15, 32), np.float32))
+
+
+def test_sp_ep_honors_nondefault_capacity_factor():
+    """The rebuilt sp×ep model must reuse the bundle's capacity_factor —
+    a default-capacity rebuild would drop different tokens than the
+    oracle."""
+    from nnstreamer_tpu.models.moe_transformer import make_sp_ep_infer
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import make_mesh
+
+    spec = SPEC + "&capacity_factor=0.5"
+    b = get_model(spec)
+    x = np.random.default_rng(5).normal(size=(1, 16, 32)).astype(np.float32)
+    want = np.asarray(jax.jit(b.fn())(x))
+    mesh = make_mesh({"sp": 2, "expert": 4})
+    infer, placed = make_sp_ep_infer(b, mesh)
+    got = np.asarray(infer(placed, jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
