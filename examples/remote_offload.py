@@ -1,6 +1,8 @@
 """Remote offload demo: client pipeline sends frames to a server pipeline
 over TCP (run both ends in one process for the demo; they can be separate
-hosts).
+hosts). Both ends use async_depth so remote device round trips overlap
+instead of serializing (~30x throughput on a tunneled TPU server; set
+both to 1 for the reference's strict synchronous per-buffer semantics).
 
     python examples/remote_offload.py
 """
@@ -20,7 +22,7 @@ def main() -> None:
     filt = server.add_new("tensor_filter",
                           model="zoo://mobilenet_v2?width=0.25&size=64"
                                 "&num_classes=10&dtype=float32")
-    ssink = server.add_new("tensor_query_serversink", id=0)
+    ssink = server.add_new("tensor_query_serversink", id=0, async_depth=16)
     Pipeline.link(ssrc, filt, ssink)
     server.start()
     time.sleep(0.3)
@@ -35,7 +37,7 @@ def main() -> None:
             TensorsInfo.from_strings("3:64:64:1", "uint8"), 30)),
         data=[rng.integers(0, 255, (1, 64, 64, 3)).astype(np.uint8)
               for _ in range(10)])
-    qc = client.add_new("tensor_query_client", port=port)
+    qc = client.add_new("tensor_query_client", port=port, async_depth=16)
     sink = client.add_new("tensor_sink",
                           new_data=lambda b: print(
                               f"frame {b.offset}: logits "

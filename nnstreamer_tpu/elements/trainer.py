@@ -13,6 +13,13 @@ loss (xent/mse), checkpoint_path (saved on EOS), report_every (bus messages
 with running loss). Output: passthrough of the input frame with
 ``loss`` in buffer meta (so a sink can monitor), letting trainers sit on a
 tee branch next to the serving filter.
+
+``mesh=`` shards the step over a device mesh (parallel.
+make_sharded_train_step: batch over 'data', params tensor-parallel over
+'model', XLA collectives over ICI). Accepts a jax Mesh, an axes dict,
+or a string like ``"data:4,model:2"``. The per-frame batch must be a
+multiple of the data-axis size — group frames upstream with
+``tensor_batch``/``tensor_aggregator`` for per-frame streams.
 """
 
 from __future__ import annotations
@@ -39,7 +46,10 @@ class TensorTrainer(Element):
         self.loss = "xent"
         self.checkpoint_path: Optional[str] = None
         self.report_every = 0  # frames; 0 = no bus reports
+        self.mesh: Any = None  # Mesh | axes dict | "data:4,model:2"
         super().__init__(name, **props)
+        self._x_sharding = None
+        self._y_sharding = None
         self.add_sink_pad(template=Caps.any_tensors())
         self.add_src_pad(template=Caps.any_tensors())
         self._step = None
@@ -82,29 +92,72 @@ class TensorTrainer(Element):
         else:
             raise ValueError(f"tensor_trainer: unknown loss {self.loss!r}")
 
-        self._params = bundle.params
-        self._opt_state = opt.init(self._params)
         self._bundle = bundle
+        self._x_sharding = self._y_sharding = None  # restart w/ mesh=None
+        if self.mesh is not None:
+            from ..parallel import batch_sharding, make_sharded_train_step
 
-        def step(params, opt_state, x, y):
-            def objective(p):
-                return loss_fn(apply_fn(p, x), y)
+            mesh = self._resolve_mesh()
+            self._step, self._params, self._opt_state = \
+                make_sharded_train_step(apply_fn, bundle.params, mesh,
+                                        optimizer=opt, loss_fn=loss_fn)
+            self._x_sharding = batch_sharding(mesh)
+            self._y_sharding = batch_sharding(mesh)
+        else:
+            self._params = bundle.params
+            self._opt_state = opt.init(self._params)
 
-            lv, grads = jax.value_and_grad(objective)(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, lv
+            def step(params, opt_state, x, y):
+                def objective(p):
+                    return loss_fn(apply_fn(p, x), y)
 
-        self._step = jax.jit(step)
+                lv, grads = jax.value_and_grad(objective)(params)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, lv
+
+            self._step = jax.jit(step)
         self._n = 0
         self.losses.clear()
+
+    def _resolve_mesh(self):
+        import math
+
+        import jax
+        from jax.sharding import Mesh
+
+        from ..parallel import make_mesh
+
+        if isinstance(self.mesh, Mesh):
+            return self.mesh
+        if isinstance(self.mesh, dict):
+            axes = {k: int(v) for k, v in self.mesh.items()}
+        else:
+            axes = {}
+            for part in str(self.mesh).split(","):
+                k, _, v = part.partition(":")
+                axes[k.strip()] = int(v)
+        n = math.prod(axes.values())
+        return make_mesh(axes, devices=jax.devices()[:n])
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         if buf.num_tensors < 2:
             raise ValueError("tensor_trainer expects (x, y) tensor frames "
                              "(use tensor_mux)")
-        x = buf.memories[0].device()
-        y = buf.memories[1].device()
+        if self._x_sharding is not None:
+            import jax
+
+            # reshard whatever side the memory lives on: device arrays
+            # move over ICI, no host bounce
+            def _placed(mem, sharding):
+                src = mem.device() if mem.is_device else mem.host()
+                return jax.device_put(src, sharding)
+
+            x = _placed(buf.memories[0], self._x_sharding)
+            y = _placed(buf.memories[1], self._y_sharding)
+        else:
+            x = buf.memories[0].device()
+            y = buf.memories[1].device()
         self._params, self._opt_state, lv = self._step(
             self._params, self._opt_state, x, y)
         self._n += 1

@@ -104,3 +104,46 @@ class TestCheckpoints:
         loaded = checkpoints.load_variables(path,
                                             {"w": np.zeros((4, 4), np.float32)})
         np.testing.assert_array_equal(loaded["w"], params["w"])
+
+
+class TestShardedTrainer:
+    def test_mesh_prop_trains_sharded(self):
+        """mesh="data:4,model:2": the in-pipeline step runs over the
+        8-device mesh (params sharded, loss decreasing)."""
+        import jax
+
+        rng = np.random.default_rng(0)
+        true_w = rng.normal(size=(8, 4)).astype(np.float32)
+        data = []
+        for _ in range(16):
+            x = rng.normal(size=(4, 8)).astype(np.float32)  # batch 4
+            y = np.argmax(x @ true_w, axis=-1).astype(np.int32)
+            data.append((x, y))
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:4,4", "float32,int32"),
+                        data=data)
+        tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                       learning_rate=0.05, mesh="data:4,model:2")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, tr, sink)
+        p.run(timeout=120)
+        losses = list(tr.losses)
+        assert len(losses) == 16
+        assert np.mean(losses[-4:]) < np.mean(losses[:4])
+        # params actually live sharded on the mesh
+        leaf = jax.tree_util.tree_leaves(tr.params)[0]
+        assert len(leaf.sharding.device_set) == 8
+
+    def test_mesh_prop_accepts_dict(self):
+        rng = np.random.default_rng(1)
+        data = [(rng.normal(size=(2, 8)).astype(np.float32),
+                 np.zeros(2, np.int32)) for _ in range(3)]
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                        data=data)
+        tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                       mesh={"data": 2})
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, tr, sink)
+        p.run(timeout=120)
+        assert len(tr.losses) == 3
