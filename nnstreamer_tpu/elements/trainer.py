@@ -93,8 +93,8 @@ class TensorTrainer(Element):
             raise ValueError(f"tensor_trainer: unknown loss {self.loss!r}")
 
         self._bundle = bundle
-        self._x_sharding = self._y_sharding = None  # restart w/ mesh=None
-        if self.mesh is not None:
+        self._x_sharding = self._y_sharding = None  # restart w/ mesh unset
+        if self.mesh:  # None/""/{} all mean unsharded
             from ..parallel import batch_sharding, make_sharded_train_step
 
             mesh = self._resolve_mesh()
@@ -136,6 +136,10 @@ class TensorTrainer(Element):
             axes = {}
             for part in str(self.mesh).split(","):
                 k, _, v = part.partition(":")
+                if not k.strip() or not v.strip().isdigit():
+                    raise ValueError(
+                        f"tensor_trainer {self.name}: mesh= wants "
+                        f"\"axis:size[,axis:size...]\", got {self.mesh!r}")
                 axes[k.strip()] = int(v)
         n = math.prod(axes.values())
         return make_mesh(axes, devices=jax.devices()[:n])

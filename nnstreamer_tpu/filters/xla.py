@@ -54,7 +54,7 @@ log = logger("xla")
 #: stripped before model resolution so identical model specs memoize to one
 #: bundle (and thus one compile) regardless of filter-level settings
 _FILTER_ONLY_OPTS = frozenset(
-    {"sync", "precision", "donate", "bucket", "resize", "arch"})
+    {"sync", "precision", "donate", "bucket", "resize", "arch", "quant"})
 
 
 def _model_options(options: Dict[str, str]) -> Dict[str, str]:
@@ -177,7 +177,8 @@ class XLAFilter(FilterFramework):
     def open(self, props: FilterProps) -> None:
         super().open(props)
         opts = props.custom_dict()
-        self._bundle = resolve_model(props.model, opts)
+        self._bundle = self._maybe_quantize(
+            resolve_model(props.model, opts), opts)
         self._refresh_device()
         self._sync = opts.get("sync", "false").lower() in ("1", "true", "yes")
         self._precision = opts.get("precision", "")
@@ -199,6 +200,25 @@ class XLAFilter(FilterFramework):
             self._out_info = self._infer_out_info(self._in_info)
         log.info("xla-tpu opened model=%s device=%s sync=%s",
                  self._bundle.name, self._device, self._sync)
+
+    @staticmethod
+    def _maybe_quantize(bundle: ModelBundle, opts: Dict[str, str]) -> ModelBundle:
+        """Apply custom="quant=w8" (no-op otherwise). The quantized bundle
+        memoizes on the base bundle so filters sharing one resolved spec
+        also share one quantization pass and one jit cache/compile."""
+        quant = opts.get("quant", "")
+        if not quant:
+            return bundle
+        if quant not in ("w8", "int8"):
+            raise ValueError(f"xla-tpu: unknown quant mode {quant!r} "
+                             "(supported: w8)")
+        cached = bundle.metadata.get("_w8_bundle")
+        if cached is None:
+            from ..models.quantize import quantize_bundle
+
+            cached = quantize_bundle(bundle)
+            bundle.metadata["_w8_bundle"] = cached
+        return cached
 
     def _refresh_device(self) -> None:
         """Input placement target: mesh-sharded bundles
@@ -406,7 +426,7 @@ class XLAFilter(FilterFramework):
     def reload_model(self, model: Any) -> None:
         """Hot swap: same I/O contract required (reference RELOAD semantics)."""
         opts = self.props.custom_dict() if self.props else {}
-        new_bundle = resolve_model(model, opts)
+        new_bundle = self._maybe_quantize(resolve_model(model, opts), opts)
         old_in, old_out = self._in_info, self._out_info
         self._bundle = new_bundle
         self._refresh_device()

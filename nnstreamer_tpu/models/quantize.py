@@ -1,0 +1,101 @@
+"""Weight-only int8 quantization for serving bundles.
+
+The reference's flagship test pipelines serve *quantized* tflite models
+(tests/test_models/models/mobilenet_v1_1.0_224_quant.tflite;
+tensor_filter_tensorflow_lite.cc runs them via TFLite's int8 kernels).
+The TPU-idiomatic equivalent is weight-only quantization: weights live in
+HBM as int8 with per-output-channel scales (4× smaller, 4× less weight
+bandwidth — the binding resource for memory-bound models) and are
+dequantized to the compute dtype *inside* the XLA program, where the
+dequant fuses into the consuming conv/matmul. Activations stay bf16/f32
+on the MXU, which matches how the reference's decoders consume
+dequantized outputs anyway (SURVEY §7 hard part d).
+
+Usage — one flag at the filter:
+
+    tensor_filter framework=xla-tpu model=zoo://mobilenet_v2 custom="quant=w8"
+
+or programmatically ``quantize_bundle(bundle)``. Scales are
+per-output-channel (last axis) absmax; rank<2 leaves (biases, norms) and
+integer leaves stay float/unchanged — they are byte-trivial.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .zoo import ModelBundle
+
+#: tag key marking a quantized leaf container
+_QTAG = "__w8__"
+
+
+def _quantize_leaf(w: Any) -> Any:
+    arr = np.asarray(w)
+    if arr.ndim < 2 or not np.issubdtype(arr.dtype, np.floating):
+        return arr
+    absmax = np.max(np.abs(arr), axis=tuple(range(arr.ndim - 1)))
+    scale = (absmax / 127.0).astype(np.float32)
+    safe = np.where(scale == 0.0, 1.0, scale)
+    q = np.clip(np.round(arr / safe), -127, 127).astype(np.int8)
+    return {_QTAG: q, "scale": scale}
+
+
+def _dequantize_leaf(leaf: Any, dtype) -> Any:
+    if isinstance(leaf, dict) and _QTAG in leaf:
+        return (leaf[_QTAG].astype(dtype) *
+                leaf["scale"].astype(dtype))
+    return leaf
+
+
+def _is_quant(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and _QTAG in leaf
+
+
+def quantize_params(params: Any) -> Any:
+    """float leaves (rank ≥ 2) → {int8 weights, per-channel scales}."""
+    return jax.tree_util.tree_map(_quantize_leaf, params)
+
+
+def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: _dequantize_leaf(leaf, dtype), params,
+        is_leaf=_is_quant)
+
+
+def params_nbytes(params: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += np.asarray(leaf).nbytes
+    return total
+
+
+def quantize_bundle(bundle: ModelBundle,
+                    compute_dtype=jnp.bfloat16) -> ModelBundle:
+    """Serving bundle with int8-quantized weights; the dequant runs inside
+    the jitted program (fused into the consuming ops by XLA)."""
+    if bundle.params is None:
+        raise ValueError("quantize_bundle: bundle has no params "
+                         "(in-process callable models cannot be quantized)")
+    qparams = quantize_params(bundle.params)
+    base_apply = bundle.apply
+
+    def apply(p, *xs):
+        return base_apply(dequantize_params(p, compute_dtype), *xs)
+
+    return replace(
+        bundle,
+        name=f"{bundle.name}:w8",
+        apply=apply,
+        params=qparams,
+        metadata={**bundle.metadata, "quantized": "w8",
+                  "params_nbytes": params_nbytes(qparams),
+                  "params_nbytes_f32": params_nbytes(bundle.params),
+                  # a fresh jit cache: the float bundle's compiled
+                  # programs must not be reused for the tagged pytree
+                  "_jit_cache": {}})

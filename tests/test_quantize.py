@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization (models/quantize.py + custom="quant=w8").
+
+Reference analog: quantized tflite serving
+(tests/test_models/models/mobilenet_v1_1.0_224_quant.tflite via
+tensor_filter_tensorflow_lite.cc). Here weights live as int8 + per-channel
+scales and dequantize inside the XLA program.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.models.quantize import (
+    dequantize_params,
+    params_nbytes,
+    quantize_bundle,
+    quantize_params,
+)
+from nnstreamer_tpu.models.zoo import ModelBundle, get_model
+
+SPEC = "zoo://mobilenet_v2?width=0.25&size=32&num_classes=16&dtype=float32"
+
+
+def test_roundtrip_accuracy_and_size():
+    import jax.numpy as jnp
+
+    b = get_model(SPEC)
+    q = quantize_params(b.params)
+    deq = dequantize_params(q, jnp.float32)
+    # ~4x smaller on the matrix leaves; overall must shrink substantially
+    assert params_nbytes(q) < 0.45 * params_nbytes(b.params)
+    # per-channel absmax int8: worst-case error = scale/2 per element
+    def check(o, d):
+        o, d = np.asarray(o, np.float32), np.asarray(d, np.float32)
+        if o.ndim >= 2:
+            absmax = np.abs(o).max()
+            assert np.abs(o - d).max() <= absmax / 127.0 + 1e-7
+        else:
+            np.testing.assert_array_equal(o, d)
+    import jax
+
+    jax.tree_util.tree_map(check, b.params, deq)
+
+
+def test_quantized_model_output_close():
+    import jax
+
+    b = get_model(SPEC)
+    qb = quantize_bundle(b, compute_dtype=np.float32)
+    x = np.random.default_rng(0).integers(0, 255, (1, 32, 32, 3)) \
+        .astype(np.uint8)
+    ref = np.asarray(jax.jit(b.fn())(x))
+    got = np.asarray(jax.jit(qb.fn())(x))
+    assert got.shape == ref.shape
+    # untrained logits are small; relative agreement on the order of the
+    # quantization step is what weight-only int8 guarantees
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(got - ref).max() / denom < 0.25
+    assert qb.metadata["quantized"] == "w8"
+    assert qb.metadata["params_nbytes"] < \
+        0.45 * qb.metadata["params_nbytes_f32"]
+
+
+def test_filter_quant_option_pipeline():
+    p = Pipeline()
+    rng = np.random.default_rng(1)
+    frames = [rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+              for _ in range(3)]
+    from fractions import Fraction
+
+    src = p.add_new("appsrc", caps=Caps("video/x-raw", {
+        "format": "RGB", "width": 32, "height": 32,
+        "framerate": Fraction(0, 1)}), data=frames)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=SPEC,
+                     custom="quant=w8")
+    sink = p.add_new("tensor_sink", store=True)
+    seen = {}
+    sink.new_data = lambda b: seen.setdefault(
+        "quantized", filt.fw._bundle.metadata.get("quantized"))
+    Pipeline.link(src, conv, filt, sink)
+    p.run(timeout=120)
+    assert sink.num_buffers == 3
+    assert seen["quantized"] == "w8"
+    out = sink.buffers[0].memories[0].host()
+    assert out.shape == (1, 16) and np.isfinite(out).all()
+
+
+def test_unknown_quant_mode_rejected():
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    f = XLAFilter()
+    with pytest.raises(ValueError, match="quant"):
+        f.open(FilterProps(model=SPEC, custom="quant=int4"))
+
+
+def test_callable_bundle_rejected():
+    with pytest.raises(ValueError, match="params"):
+        quantize_bundle(ModelBundle("f", lambda x: x))
+
+
+def test_reload_preserves_quantization():
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    f = XLAFilter()
+    f.open(FilterProps(model=SPEC, custom="quant=w8",
+                       input_info=TensorsInfo.from_strings(
+                           "3:32:32:1", "uint8")))
+    assert f._bundle.metadata["quantized"] == "w8"
+    f.reload_model(SPEC)  # hot swap must NOT revert to float weights
+    assert f._bundle.metadata.get("quantized") == "w8"
+
+
+def test_shared_spec_quantizes_once():
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    f1, f2 = XLAFilter(), XLAFilter()
+    f1.open(FilterProps(model=SPEC, custom="quant=w8"))
+    f2.open(FilterProps(model=SPEC, custom="quant=w8"))
+    assert f1._bundle is f2._bundle, \
+        "filters over one memoized spec must share one quantized bundle"

@@ -147,3 +147,28 @@ class TestShardedTrainer:
         Pipeline.link(src, tr, sink)
         p.run(timeout=120)
         assert len(tr.losses) == 3
+
+    @pytest.mark.parametrize("bad", ["data", "data:", ":4", "data:x"])
+    def test_malformed_mesh_string_clear_error(self, bad):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                        data=[(np.zeros((2, 8), np.float32),
+                               np.zeros(2, np.int32))])
+        tr = p.add_new("tensor_trainer", model=linear_bundle(), mesh=bad)
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, tr, sink)
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        with pytest.raises((PipelineError, ValueError), match="mesh"):
+            p.run(timeout=30)
+
+    def test_empty_mesh_string_is_unsharded(self):
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                        data=[(np.zeros((2, 8), np.float32),
+                               np.zeros(2, np.int32))] * 2)
+        tr = p.add_new("tensor_trainer", model=linear_bundle(), mesh="")
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, tr, sink)
+        p.run(timeout=60)
+        assert len(tr.losses) == 2
