@@ -43,9 +43,17 @@ def main(argv=None) -> int:
 
     from .graph.parse import parse_pipeline
 
-    p = parse_pipeline(args.pipeline)
+    try:
+        p = parse_pipeline(args.pipeline)
+    except Exception as e:  # noqa: BLE001 — CLI reports, never tracebacks
+        print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
     t0 = time.monotonic()
-    p.start()
+    try:
+        p.start()
+    except Exception as e:  # noqa: BLE001
+        print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
     try:
         ok = p.wait_eos(args.timeout)
         err = p.bus.error
@@ -60,7 +68,9 @@ def main(argv=None) -> int:
             print(f"ERROR: {err.source}: {err.data.get('text')}", file=sys.stderr)
             return 1
         if not ok:
+            # distinct code: "ran but never reached EOS" is not success
             print(f"(stopped after {args.timeout}s timeout)", file=sys.stderr)
+            return 2
     finally:
         p.stop()
     if args.verbose:

@@ -158,7 +158,18 @@ def _reassemble_caps(kind: str, props: Dict[str, Any]) -> str:
 def _split_branches(description: str):
     """Tokenize into branches of segments. Each segment is either
     (element_kind, props) or a back-reference string "name."."""
-    tokens = shlex.split(description.replace("!", " ! "))
+    # shlex FIRST (punctuation_chars splits bare '!' as its own token) so
+    # quoting protects values: model="dir!v2/m" must keep its '!'
+    lex = shlex.shlex(description, posix=True, punctuation_chars="!")
+    lex.whitespace_split = True
+    tokens: List[str] = []
+    for tok in lex:
+        if tok and set(tok) == {"!"}:
+            # '!!' arrives as one token; expand so the empty-segment
+            # check below rejects it
+            tokens.extend("!" * len(tok))
+        else:
+            tokens.append(tok)
     branches: List[List[Any]] = []
     current: List[Any] = []
     seg_tokens: List[str] = []
@@ -182,8 +193,9 @@ def _split_branches(description: str):
 
     for i, tok in enumerate(tokens):
         if tok == "!":
-            if not seg_tokens and not current:
-                raise ValueError("pipeline link '!' with no upstream element")
+            if not seg_tokens:
+                # covers a leading '!' and '! !' (empty segment) alike
+                raise ValueError("empty segment before '!' in pipeline")
             if i == len(tokens) - 1:
                 raise ValueError("pipeline ends with a dangling '!'")
             flush_segment()

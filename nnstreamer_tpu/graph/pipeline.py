@@ -321,14 +321,22 @@ class Pipeline:
 
             self._fused_count = fuse_chains(self)
         # start non-sources first so threads/queues are ready, then sources
-        for el in self.elements.values():
-            if not el.is_source:
-                el.start()
-                el.started = True
-        for el in self.elements.values():
-            if el.is_source:
-                el.start()
-                el.started = True
+        try:
+            for el in self.elements.values():
+                if not el.is_source:
+                    el.start()
+                    el.started = True
+            for el in self.elements.values():
+                if el.is_source:
+                    el.start()
+                    el.started = True
+        except Exception:
+            # roll back: elements already started must not leak threads
+            for el in self.elements.values():
+                if el.started:
+                    el.stop()
+                    el.started = False
+            raise
         self.running = True
 
     def _validate_links(self, el: Element) -> None:

@@ -1,0 +1,152 @@
+"""SSAT-style end-to-end launch-string sweep.
+
+Reference model: the 41 runTest.sh SSAT groups drive full pipelines via
+gst-launch strings (tests/nnstreamer_*/runTest.sh, `gstTest "<pipeline>"
+caseid [expect-fail]`). This suite does the same through the REAL CLI
+entry (`nnstreamer_tpu.cli.main`) so every case exercises the textual
+parser + element construction + full run, not the Python API.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.cli import main as cli_main
+
+
+def launch(pipeline: str, timeout: float = 120.0) -> int:
+    return cli_main([pipeline, "--timeout", str(timeout)])
+
+
+@pytest.fixture(scope="module")
+def labels16(tmp_path_factory):
+    p = tmp_path_factory.mktemp("launch") / "labels.txt"
+    p.write_text("\n".join(f"l{i}" for i in range(16)))
+    return str(p)
+
+
+MODEL = ("zoo://mobilenet_v2?width=0.25&size=32&num_classes=16"
+         "&dtype=float32")
+
+PASS_CASES = [
+    # structural
+    "videotestsrc num-buffers=4 width=16 height=16 ! tensor_converter ! "
+    "tensor_sink",
+    "videotestsrc num-buffers=4 width=16 height=16 ! tensor_converter ! "
+    "queue ! tensor_sink",
+    # transform grammar (reference tensor_transform modes)
+    "videotestsrc num-buffers=4 width=16 height=16 ! tensor_converter ! "
+    "tensor_transform mode=arithmetic "
+    "option=typecast:float32,add:-127.5,div:127.5 ! tensor_sink",
+    "videotestsrc num-buffers=4 width=16 height=16 ! tensor_converter ! "
+    "tensor_transform mode=transpose option=1:0:2:3 ! tensor_sink",
+    "videotestsrc num-buffers=4 width=16 height=16 ! tensor_converter ! "
+    "tensor_transform mode=clamp option=10:200 ! tensor_sink",
+    # filter + decoder
+    f"videotestsrc num-buffers=3 width=32 height=32 ! tensor_converter ! "
+    f'tensor_filter framework=xla-tpu model="{MODEL}" ! tensor_sink',
+    # quantized serving through the launch string
+    f"videotestsrc num-buffers=3 width=32 height=32 ! tensor_converter ! "
+    f'tensor_filter framework=xla-tpu model="{MODEL}" custom=quant=w8 ! '
+    f"tensor_sink",
+    # adaptive micro-batching elements
+    f"videotestsrc num-buffers=8 width=32 height=32 ! tensor_converter ! "
+    f"tensor_batch max-batch=4 budget-ms=100 ! "
+    f'tensor_filter framework=xla-tpu model="{MODEL}&batch=4" ! '
+    f"tensor_unbatch ! tensor_sink",
+    # aggregator window
+    "videotestsrc num-buffers=8 width=8 height=8 ! tensor_converter ! "
+    "tensor_aggregator frames_in=1 frames_out=4 frames_flush=4 "
+    "frames_dim=3 ! tensor_sink",
+    # tee fan-out with two sinks
+    "videotestsrc num-buffers=4 width=8 height=8 ! tensor_converter ! "
+    "tee name=t t. ! queue ! tensor_sink t. ! queue ! tensor_sink",
+]
+
+FAIL_CASES = [
+    # unknown element / property / malformed grammar (SSAT expect-fail)
+    "videotestsrc num-buffers=2 ! tensor_bogus ! tensor_sink",
+    "videotestsrc num-buffers=2 bogus-prop=1 ! tensor_sink",
+    "videotestsrc num-buffers=2 ! tensor_converter ! "
+    "tensor_transform mode=nope option=1 ! tensor_sink",
+    "videotestsrc num-buffers=2 ! tensor_converter ! "
+    "tensor_filter framework=no-such-fw model=x ! tensor_sink",
+    "videotestsrc num-buffers=2 ! ! tensor_sink",
+]
+
+
+@pytest.mark.parametrize("pipeline", PASS_CASES,
+                         ids=[f"ok{i}" for i in range(len(PASS_CASES))])
+def test_launch_ok(pipeline):
+    assert launch(pipeline) == 0
+
+
+def test_launch_with_labels_decode(labels16):
+    pipeline = (
+        f"videotestsrc num-buffers=3 width=32 height=32 ! tensor_converter "
+        f'! tensor_filter framework=xla-tpu model="{MODEL}" ! '
+        f"tensor_decoder mode=image_labeling option1={labels16} ! "
+        f"tensor_sink")
+    assert launch(pipeline) == 0
+
+
+@pytest.mark.parametrize("pipeline", FAIL_CASES,
+                         ids=[f"bad{i}" for i in range(len(FAIL_CASES))])
+def test_launch_expect_fail(pipeline):
+    assert launch(pipeline, timeout=30.0) != 0
+
+
+def test_list_elements_includes_new():
+    import io
+    from contextlib import redirect_stdout
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        assert cli_main(["--list-elements"]) == 0
+    listing = out.getvalue()
+    for el in ("tensor_batch", "tensor_unbatch", "tensor_trainer",
+               "tensor_query_client", "tensor_filter"):
+        assert el in listing
+
+
+def test_inspect_new_elements():
+    import io
+    from contextlib import redirect_stdout
+
+    for el in ("tensor_batch", "tensor_unbatch"):
+        out = io.StringIO()
+        with redirect_stdout(out):
+            assert cli_main(["--inspect", el]) == 0
+        assert "max_batch" in out.getvalue() or "sink" in out.getvalue()
+
+
+def test_quoted_bang_preserved_in_prop():
+    from nnstreamer_tpu.graph.parse import _split_branches
+
+    branches = _split_branches('a ! b opt="x!y" ! c')
+    assert branches[0][1] == ("b", {"opt": "x!y"})
+
+
+def test_timeout_returns_distinct_code():
+    # an endless source never reaches EOS: rc 2, not success
+    rc = launch("videotestsrc width=8 height=8 ! tensor_converter ! "
+                "tensor_sink", timeout=1.0)
+    assert rc == 2
+
+
+def test_failed_start_leaks_no_threads():
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    rc = launch("videotestsrc num-buffers=4 width=8 height=8 ! "
+                "tensor_converter ! queue ! "
+                "tensor_transform mode=nope option=1 ! tensor_sink",
+                timeout=10.0)
+    assert rc == 1
+    import time as _t
+
+    _t.sleep(0.3)
+    leaked = {t.name for t in threading.enumerate()} - before
+    assert not {n for n in leaked if n.startswith(("q:", "src:", "batch:"))}, \
+        f"leaked pipeline threads: {leaked}"
