@@ -162,6 +162,7 @@ def _split_branches(description: str):
     # quoting protects values: model="dir!v2/m" must keep its '!'
     lex = shlex.shlex(description, posix=True, punctuation_chars="!")
     lex.whitespace_split = True
+    lex.commenters = ""  # '#' is data (paths, URI fragments), not comments
     tokens: List[str] = []
     for tok in lex:
         if tok and set(tok) == {"!"}:

@@ -331,10 +331,16 @@ class Pipeline:
                     el.start()
                     el.started = True
         except Exception:
-            # roll back: elements already started must not leak threads
-            for el in self.elements.values():
+            # roll back: elements already started must not leak threads.
+            # Sources first (mirroring stop()) and best-effort per element
+            # so one failing stop cannot strand the rest.
+            for el in sorted(self.elements.values(),
+                             key=lambda e: not e.is_source):
                 if el.started:
-                    el.stop()
+                    try:
+                        el.stop()
+                    except Exception:  # noqa: BLE001
+                        log.exception("rollback stop failed for %s", el.name)
                     el.started = False
             raise
         self.running = True

@@ -151,6 +151,14 @@ def ep_param_shardings(params: Any, mesh, n_experts: int,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _ep_x_sharding(mesh, dp_axis):
+    """Input placement for ep inference: batch over dp_axis when it has
+    width, else replicated. The ONE spot both the jit compilation and the
+    filter-side placement derive from."""
+    dp = mesh.shape.get(dp_axis, 1) if dp_axis else 1
+    return dp, NamedSharding(mesh, P(dp_axis) if dp > 1 else P())
+
+
 def make_ep_infer(bundle: ModelBundle, mesh, ep_axis: str = "expert",
                   dp_axis: str = "data"):
     """(infer_fn, placed_params) with expert stacks sharded over
@@ -158,8 +166,8 @@ def make_ep_infer(bundle: ModelBundle, mesh, ep_axis: str = "expert",
     n_experts = bundle.metadata["experts"]
     shardings = ep_param_shardings(bundle.params, mesh, n_experts, ep_axis)
     placed = jax.tree_util.tree_map(jax.device_put, bundle.params, shardings)
-    dp = mesh.shape.get(dp_axis, 1) if dp_axis else 1
-    x_spec = P(dp_axis) if dp > 1 else P()
+    dp, x_sharding = _ep_x_sharding(mesh, dp_axis)
+    x_spec = x_sharding.spec
     apply = bundle.apply
     jitted = jax.jit(
         lambda p, x: apply(p, x),
@@ -207,6 +215,27 @@ def make_sp_ep_infer(bundle: ModelBundle, mesh, sp_axis: str = "sp",
         return jitted(p, x)
 
     return infer, placed
+
+
+def ep_bundle(bundle: ModelBundle, mesh, ep_axis: str = "expert",
+              dp_axis: str = "data") -> ModelBundle:
+    """Wrap for pipeline serving: ``tensor_filter model=ep_bundle(b, mesh)``
+    fans each request over the mesh with expert weights sharded — the MoE
+    analog of parallel.sharded_bundle (pod-slice offload). Carries
+    ``jit: False`` (already a pjit program) and the input sharding the
+    filter places incoming host tensors with."""
+    infer, placed = make_ep_infer(bundle, mesh, ep_axis, dp_axis)
+    _, x_sharding = _ep_x_sharding(mesh, dp_axis)
+    # drop private "_"-keys: an inherited _w8_bundle/_jit_cache would let
+    # a later quant/compile cache-hit bypass the mesh program entirely
+    public_meta = {k: v for k, v in bundle.metadata.items()
+                   if not k.startswith("_")}
+    return ModelBundle(
+        f"{bundle.name}@ep{mesh.shape.get(ep_axis, 1)}",
+        lambda x: infer(placed, x),
+        in_info=bundle.in_info, out_info=bundle.out_info,
+        metadata={**public_meta, "jit": False,
+                  "input_sharding": x_sharding})
 
 
 register_model("moe_transformer", make_moe_transformer)

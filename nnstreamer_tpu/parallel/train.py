@@ -96,9 +96,13 @@ def sharded_bundle(base: Any, mesh: Mesh) -> Any:
     from ..models.zoo import ModelBundle
 
     infer, params = make_sharded_infer_step(base.apply, base.params, mesh)
+    # private "_"-keys (quant/jit caches) must not ride along: a cache hit
+    # on an inherited key would silently serve the UNSHARDED program
+    public_meta = {k: v for k, v in base.metadata.items()
+                   if not k.startswith("_")}
     return ModelBundle(
         f"{base.name}@{'x'.join(str(v) for v in mesh.shape.values())}",
         lambda x: infer(params, x),
         in_info=base.in_info, out_info=base.out_info,
-        metadata={**base.metadata, "input_sharding": batch_sharding(mesh),
+        metadata={**public_meta, "input_sharding": batch_sharding(mesh),
                   "jit": False})

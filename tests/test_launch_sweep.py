@@ -150,3 +150,11 @@ def test_failed_start_leaks_no_threads():
     leaked = {t.name for t in threading.enumerate()} - before
     assert not {n for n in leaked if n.startswith(("q:", "src:", "batch:"))}, \
         f"leaked pipeline threads: {leaked}"
+
+
+def test_hash_in_prop_value_not_a_comment():
+    from nnstreamer_tpu.graph.parse import _split_branches
+
+    branches = _split_branches("a ! b opt=x#y ! c")
+    assert branches[0][1] == ("b", {"opt": "x#y"})
+    assert branches[0][2] == ("c", {})

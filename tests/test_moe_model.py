@@ -177,3 +177,24 @@ def test_sp_ep_honors_nondefault_capacity_factor():
     infer, placed = make_sp_ep_infer(b, mesh)
     got = np.asarray(infer(placed, jnp.asarray(x)))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ep_bundle_serves_through_filter():
+    """tensor_filter serves the expert-sharded MoE pjit program (pod-slice
+    offload path), equal to the unsharded oracle."""
+    from nnstreamer_tpu.core.buffer import TensorMemory
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.models.moe_transformer import ep_bundle
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import make_mesh
+
+    b = get_model(SPEC + "&batch=2")
+    mesh = make_mesh({"data": 2, "expert": 4})
+    served = ep_bundle(b, mesh)
+    filt = XLAFilter()
+    filt.open(FilterProps(model=served))
+    x = np.random.default_rng(3).normal(size=(2, 16, 32)).astype(np.float32)
+    got = filt.invoke([TensorMemory(x)])[0].host()
+    ref = np.asarray(jax.jit(b.fn())(x))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
