@@ -1,0 +1,184 @@
+"""Causal transformer LM with a streaming KV-cache decode step.
+
+Long-context streaming as a *pipeline loop* (the tensor_repo recurrence
+the reference uses for its LSTM example, tests/nnstreamer_repo_lstm):
+the KV cache is carried as ordinary device-resident stream tensors, so
+autoregressive decoding is
+
+    tokens ─┐
+            ├─ tensor_mux ! tensor_filter(zoo://causal_lm?...) ! demux
+    state ──┘        ▲                                        │
+  (reposrc)          └── logits → sink;  (k,v,pos) → reposink ┘
+
+One token per loop iteration, O(1) work per step against an O(max_len)
+cache — no recompute of the prefix. Shapes are static (cache is
+pre-allocated at ``max_len``; ``pos`` masks the unwritten tail) so XLA
+compiles the step exactly once.
+
+Exactness contract: step-decoding a sequence token-by-token produces the
+same logits as the full causal forward pass (``lm_forward``) at every
+position (tests/test_causal_lm.py).
+
+Cache transport layout: rank-3 ``(layers·batch·heads, max_len, head_dim)``
+so it rides the tensor type system's rank limit; the step reshapes to the
+logical 5-D layout internally.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import TensorsInfo
+from .zoo import ModelBundle, register_model
+
+
+def init_causal_lm(rng: jax.Array, vocab: int, d_model: int, n_heads: int,
+                   n_layers: int, max_len: int,
+                   d_ff: int = 0) -> Dict[str, jax.Array]:
+    d_ff = d_ff or 4 * d_model
+    ks = jax.random.split(rng, 6)
+    s = 1.0 / math.sqrt(d_model)
+    sf = 1.0 / math.sqrt(d_ff)
+    L = n_layers
+    return {
+        "embed": jax.random.normal(ks[0], (vocab, d_model)) * 0.02,
+        "pos_embed": jax.random.normal(ks[1], (max_len, d_model)) * 0.02,
+        "wqkv": jax.random.normal(ks[2], (L, d_model, 3 * d_model)) * s,
+        "wo": jax.random.normal(ks[3], (L, d_model, d_model)) * s,
+        "w1": jax.random.normal(ks[4], (L, d_model, d_ff)) * s,
+        "w2": jax.random.normal(ks[5], (L, d_ff, d_model)) * sf,
+        "ln1": jnp.ones((L, d_model)),
+        "ln2": jnp.ones((L, d_model)),
+        "lnf": jnp.ones((d_model,)),
+    }
+
+
+def _ln(x, scale):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _split_heads(t, n_heads):
+    b, l, d = t.shape
+    return t.reshape(b, l, n_heads, d // n_heads).transpose(0, 2, 1, 3)
+
+
+def lm_forward(params: Dict[str, jax.Array], tokens: jax.Array,
+               n_heads: int) -> jax.Array:
+    """Full causal forward (the oracle): (B, T) int32 → (B, T, vocab)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][:t][None]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+
+    def block(h, layer):
+        wqkv, wo, w1, w2, ln1, ln2 = layer
+        a = _ln(h, ln1)
+        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)
+        q, k, v = (_split_heads(z, n_heads) for z in (q, k, v))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+        o = o.transpose(0, 2, 1, 3).reshape(h.shape)
+        h = h + o @ wo
+        m = _ln(h, ln2)
+        return h + jax.nn.gelu(m @ w1) @ w2, None
+
+    x, _ = jax.lax.scan(
+        block, x, (params["wqkv"], params["wo"], params["w1"],
+                   params["w2"], params["ln1"], params["ln2"]))
+    return _ln(x, params["lnf"]) @ params["embed"].T
+
+
+def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
+                   kcache: jax.Array, vcache: jax.Array, pos: jax.Array,
+                   n_heads: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One streaming decode step.
+
+    token: (B, 1) int32; kcache/vcache: (L·B·H, max_len, hd) flat transport
+    layout; pos: (1,) int32 — next write position. Returns
+    (logits (B, vocab), kcache', vcache', pos+1).
+    """
+    n_layers = params["wqkv"].shape[0]
+    b = token.shape[0]
+    d_model = params["embed"].shape[1]
+    hd = d_model // n_heads
+    max_len = kcache.shape[-2]
+    p = jnp.asarray(pos).reshape(())
+
+    kc = kcache.reshape(n_layers, b, n_heads, max_len, hd)
+    vc = vcache.reshape(n_layers, b, n_heads, max_len, hd)
+    x = params["embed"][token[:, 0]][:, None, :] + \
+        params["pos_embed"][p][None, None, :]
+    live = (jnp.arange(max_len) <= p)[None, None, None, :]
+
+    def block(h, layer):
+        wqkv, wo, w1, w2, ln1, ln2, kc_l, vc_l = layer
+        a = _ln(h, ln1)
+        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)          # (B, 1, D)
+        q = _split_heads(q, n_heads)                       # (B, H, 1, hd)
+        k = _split_heads(k, n_heads)
+        v = _split_heads(v, n_heads)
+        kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, 0, p, 0))
+        vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, 0, p, 0))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc_l) / math.sqrt(hd)
+        s = jnp.where(live, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vc_l)
+        o = o.transpose(0, 2, 1, 3).reshape(h.shape)
+        h = h + o @ wo
+        m = _ln(h, ln2)
+        return h + jax.nn.gelu(m @ w1) @ w2, (kc_l, vc_l)
+
+    x, (kc, vc) = jax.lax.scan(
+        block, x, (params["wqkv"], params["wo"], params["w1"],
+                   params["w2"], params["ln1"], params["ln2"], kc, vc))
+    logits = (_ln(x, params["lnf"]) @ params["embed"].T)[:, 0]
+    flat = (n_layers * b * n_heads, max_len, hd)
+    return (logits, kc.reshape(flat), vc.reshape(flat),
+            (p + 1).reshape(1).astype(jnp.int32))
+
+
+def empty_cache(n_layers: int, batch: int, n_heads: int, max_len: int,
+                head_dim: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(kcache, vcache, pos) zero state in the flat transport layout."""
+    flat = (n_layers * batch * n_heads, max_len, head_dim)
+    return (np.zeros(flat, np.float32), np.zeros(flat, np.float32),
+            np.zeros((1,), np.int32))
+
+
+def make_causal_lm(vocab: str = "256", dim: str = "64", heads: str = "4",
+                   layers: str = "2", max_len: str = "128",
+                   batch: str = "1", seed: str = "0",
+                   **_: Any) -> ModelBundle:
+    V, D, H, L = int(vocab), int(dim), int(heads), int(layers)
+    M, B = int(max_len), int(batch)
+    if D % H:
+        raise ValueError(f"causal_lm: dim={D} not divisible by heads={H}")
+    hd = D // H
+    params = init_causal_lm(jax.random.PRNGKey(int(seed)), V, D, H, L, M)
+
+    def apply(p, token, kcache, vcache, pos):
+        return lm_decode_step(p, token.astype(jnp.int32), kcache, vcache,
+                              pos, H)
+
+    flat = L * B * H
+    in_info = TensorsInfo.from_strings(
+        f"1:{B},{hd}:{M}:{flat},{hd}:{M}:{flat},1",
+        "int32,float32,float32,int32")
+    out_info = TensorsInfo.from_strings(
+        f"{V}:{B},{hd}:{M}:{flat},{hd}:{M}:{flat},1",
+        "float32,float32,float32,int32")
+    return ModelBundle(
+        "causal_lm", apply, params=params,
+        in_info=in_info, out_info=out_info,
+        metadata={"vocab": V, "dim": D, "heads": H, "layers": L,
+                  "max_len": M, "head_dim": hd})
+
+
+register_model("causal_lm", make_causal_lm)

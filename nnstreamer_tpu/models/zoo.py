@@ -209,3 +209,4 @@ def _ensure_builtin_models() -> None:
     from . import lenet  # noqa: F401
     from . import stream_transformer  # noqa: F401
     from . import moe_transformer  # noqa: F401
+    from . import causal_lm  # noqa: F401

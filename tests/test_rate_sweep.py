@@ -1,0 +1,89 @@
+"""tensor_rate conformance sweep: upsampling (duplicate), downsampling
+(drop), counters, and edge cases.
+
+Reference model: gst/nnstreamer/elements/gsttensorrate.c props
+framerate/drop/duplicate and the in/out/duplicate/drop counters
+(gsttensorrate.c:957-993) exercised by tests/nnstreamer_rate/runTest.sh.
+"""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+MS = 1_000_000
+
+
+def caps_of(rate):
+    return Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings("2", "float32"), rate))
+
+
+def run_rate(in_rate_hz, out_rate, n, **props):
+    p = Pipeline()
+    period = int(1e9 / in_rate_hz)
+    data = [Buffer.of(np.full((2,), i, np.float32), pts=i * period,
+                      duration=period) for i in range(n)]
+    src = p.add_new("appsrc", caps=caps_of(Fraction(in_rate_hz, 1)),
+                    data=data)
+    rate = p.add_new("tensor_rate", framerate=out_rate, throttle=False,
+                     **props)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, rate, sink)
+    p.run(timeout=60)
+    return rate, sink
+
+
+class TestRateConform:
+    def test_downsample_3x(self):
+        rate, sink = run_rate(30, "10/1", 30)
+        # 1 second of 30 Hz → ~10 output frames
+        assert 9 <= sink.num_buffers <= 11
+        assert rate.n_in == 30
+        assert rate.n_drop >= 18
+        pts = [b.pts for b in sink.buffers]
+        assert pts == sorted(pts)
+
+    def test_upsample_duplicates(self):
+        rate, sink = run_rate(10, "30/1", 10)
+        # 1 second of 10 Hz → ~30 outputs, two thirds duplicated
+        assert 27 <= sink.num_buffers <= 33
+        assert rate.n_dup >= 18
+        # duplicated frames repeat the previous payload
+        vals = [int(b.memories[0].host()[0]) for b in sink.buffers]
+        assert vals == sorted(vals)  # non-decreasing source indices
+        assert len(set(vals)) == 10
+
+    def test_same_rate_passthrough(self):
+        rate, sink = run_rate(30, "30/1", 15)
+        assert sink.num_buffers == 15
+        assert rate.n_drop == 0 and rate.n_dup == 0
+
+    def test_drop_disabled_passes_everything(self):
+        rate, sink = run_rate(30, "10/1", 12, drop=False)
+        assert sink.num_buffers == 12  # conform disabled: passthrough
+
+    def test_counters_match_io(self):
+        rate, sink = run_rate(20, "5/1", 20)
+        assert rate.n_in == 20
+        assert rate.n_out == sink.num_buffers
+        assert rate.n_in - rate.n_drop <= rate.n_out + 1
+
+    @pytest.mark.parametrize("bad", ["0/1", "-5/1", "x/y", "1/0"])
+    def test_invalid_framerate_rejected(self, bad):
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of(Fraction(30, 1)),
+                        data=[Buffer.of(np.zeros(2, np.float32), pts=0,
+                                        duration=33 * MS)])
+        rate = p.add_new("tensor_rate", framerate=bad, throttle=False)
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, rate, sink)
+        with pytest.raises((PipelineError, ValueError, ZeroDivisionError)):
+            p.run(timeout=30)
