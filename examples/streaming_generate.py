@@ -1,0 +1,92 @@
+"""Autoregressive generation as a streaming pipeline loop.
+
+The KV cache rides the tensor_repo loop as device-resident stream
+tensors; each loop iteration decodes ONE token in O(1) work against the
+preallocated cache (no prefix recompute). Greedy feedback happens in the
+app: the sink's logits pick the next token pushed into appsrc.
+
+    python examples/streaming_generate.py [--tokens 24] [--cpu]
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=24)
+    ap.add_argument("--prompt", type=int, nargs="*", default=[1, 7, 3])
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from nnstreamer_tpu.core import Caps
+    from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.elements.repo import reset_repo
+    from nnstreamer_tpu.graph import Pipeline
+    from nnstreamer_tpu.models.zoo import get_model
+
+    spec = "zoo://causal_lm?vocab=64&dim=64&heads=4&layers=2&max_len=64"
+    bundle = get_model(spec)
+    meta = bundle.metadata
+    flat = meta["layers"] * meta["batch"] * meta["heads"]
+    hd, M = meta["head_dim"], meta["max_len"]
+    if not args.prompt:
+        ap.error("--prompt needs at least one token id")
+    if len(args.prompt) + args.tokens > M:
+        ap.error(f"prompt+tokens exceeds the model's max_len={M} cache")
+
+    reset_repo()
+    p = Pipeline("generate")
+    src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings("1:1", "int32"), 0)))
+    state = p.add_new("tensor_reposrc", slot_index=7,
+                      dims=f"{hd}:{M}:{flat},{hd}:{M}:{flat},1",
+                      types="float32,float32,int32")
+    mux = p.add_new("tensor_mux", sync_mode="nosync")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=bundle)
+    demux = p.add_new("tensor_demux", tensorpick="0,1:2:3")
+    q_out, q_state = p.add_new("queue"), p.add_new("queue")
+    rsink = p.add_new("tensor_reposink", slot_index=7)
+    sink = p.add_new("tensor_sink")
+
+    generated = []
+    prompt = list(args.prompt)
+
+    def on_logits(buf) -> None:
+        logits = buf.memories[0].host()[0]
+        nxt = int(np.argmax(logits))
+        if prompt:  # still teacher-forcing the prompt
+            tok = prompt.pop(0)
+        else:
+            tok = nxt
+            generated.append(tok)
+        if len(generated) >= args.tokens:
+            src.end_of_stream()
+        else:
+            src.push_buffer(np.array([[tok]], np.int32))
+
+    sink.new_data = on_logits
+    Pipeline.link(src, mux)
+    Pipeline.link(state, mux)
+    Pipeline.link(mux, filt, demux)
+    Pipeline.link(demux, q_out, sink)
+    Pipeline.link(demux, q_state, rsink)
+    p.start()
+    # pop BEFORE pushing: on_logits (sink thread) also pops this list, so
+    # mutating after the push would race the first decode's callback
+    first = prompt.pop(0)
+    src.push_buffer(np.array([[first]], np.int32))
+    p.wait_eos(300)
+    p.stop()
+    print(f"prompt={args.prompt} generated={generated}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

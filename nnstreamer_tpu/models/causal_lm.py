@@ -178,7 +178,7 @@ def make_causal_lm(vocab: str = "256", dim: str = "64", heads: str = "4",
         "causal_lm", apply, params=params,
         in_info=in_info, out_info=out_info,
         metadata={"vocab": V, "dim": D, "heads": H, "layers": L,
-                  "max_len": M, "head_dim": hd})
+                  "max_len": M, "head_dim": hd, "batch": B})
 
 
 register_model("causal_lm", make_causal_lm)

@@ -86,7 +86,7 @@ def test_repo_loop_streaming_decode(bundle):
                                    jnp.asarray(tokens[None]),
                                    meta["heads"]))[0]
 
-    flat = meta["layers"] * meta["heads"]
+    flat = meta["layers"] * meta["batch"] * meta["heads"]
     hd, M = meta["head_dim"], meta["max_len"]
     p = Pipeline()
     src = p.add_new(
