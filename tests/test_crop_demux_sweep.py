@@ -1,0 +1,126 @@
+"""tensor_crop / tensor_demux / tensor_split edge-case sweeps.
+
+Reference model: gst/nnstreamer/elements/gsttensor_crop.c (clipping,
+multi-region, zero-region frames), tensor_demux tensorpick variants, and
+tensor_split tensorseg slicing (tests/nnstreamer_demux, nnstreamer_split
+SSAT groups).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.buffer import Buffer
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+MS = 1_000_000
+
+
+def caps_of(dims, types):
+    return Caps.tensors(TensorsConfig(TensorsInfo.from_strings(dims, types)))
+
+
+def run_crop(img, boxes_per_frame):
+    p = Pipeline()
+    h, w, c = img.shape
+    n = len(boxes_per_frame)
+    raw = p.add_new("appsrc", caps=caps_of(f"{c}:{w}:{h}:1", "uint8"),
+                    data=[Buffer.of(img[None], pts=i * 33 * MS,
+                                    duration=33 * MS) for i in range(n)])
+    info = p.add_new(
+        "appsrc", caps=caps_of("4:4", "int32"),
+        data=[Buffer.of(np.asarray(b, np.int32), pts=i * 33 * MS,
+                        duration=33 * MS)
+              for i, b in enumerate(boxes_per_frame)])
+    crop = p.add_new("tensor_crop")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(raw, crop)     # raw pad
+    Pipeline.link(info, crop)    # info pad
+    Pipeline.link(crop, sink)
+    p.run(timeout=60)
+    return sink
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 255, (16, 20, 3)).astype(np.uint8)
+
+
+class TestCrop:
+    def test_multi_region_values(self, img):
+        boxes = [[[2, 3, 5, 4], [0, 0, 20, 16]]]
+        sink = run_crop(img, boxes)
+        assert sink.num_buffers == 1
+        mems = sink.buffers[0].memories
+        assert len(mems) == 2
+        np.testing.assert_array_equal(mems[0].host(), img[3:7, 2:7])
+        np.testing.assert_array_equal(mems[1].host(), img)
+
+    def test_out_of_bounds_boxes_clipped(self, img):
+        sink = run_crop(img, [[[18, 14, 10, 10]]])
+        got = sink.buffers[0].memories[0].host()
+        np.testing.assert_array_equal(got, img[14:16, 18:20])
+
+    def test_per_frame_region_counts_vary(self, img):
+        sink = run_crop(img, [[[0, 0, 4, 4]],
+                              [[0, 0, 4, 4], [4, 4, 4, 4], [8, 8, 4, 4]]])
+        assert sink.num_buffers == 2
+        assert len(sink.buffers[0].memories) == 1
+        assert len(sink.buffers[1].memories) == 3
+
+
+class TestDemuxPicks:
+    def _run(self, tensorpick, n_pads):
+        p = Pipeline()
+        frames = [Buffer.from_arrays(
+            [np.full((2,), 10 * t + i, np.float32) for i in range(4)],
+            pts=t * 33 * MS) for t in range(3)]
+        src = p.add_new("appsrc",
+                        caps=caps_of("2,2,2,2", ",".join(["float32"] * 4)),
+                        data=frames)
+        demux = p.add_new("tensor_demux", tensorpick=tensorpick)
+        sinks = [p.add_new("tensor_sink", store=True) for _ in range(n_pads)]
+        Pipeline.link(src, demux)
+        for s in sinks:
+            Pipeline.link(demux, s)
+        p.run(timeout=60)
+        return sinks
+
+    def test_single_picks(self):
+        sinks = self._run("0,2", 2)
+        for t in range(3):
+            assert sinks[0].buffers[t].memories[0].host()[0] == 10 * t
+            assert sinks[1].buffers[t].memories[0].host()[0] == 10 * t + 2
+
+    def test_grouped_pick_emits_multi_tensor(self):
+        sinks = self._run("0:1,3", 2)
+        b = sinks[0].buffers[0]
+        assert b.num_tensors == 2
+        assert b.memories[1].host()[0] == 1
+        assert sinks[1].buffers[0].memories[0].host()[0] == 3
+
+    def test_no_pick_fans_out_all(self):
+        sinks = self._run(None, 4)
+        assert all(s.num_buffers == 3 for s in sinks)
+
+
+class TestSplitSegs:
+    def test_tensorseg_slices(self):
+        p = Pipeline()
+        arr = np.arange(12, dtype=np.float32).reshape(1, 12)
+        src = p.add_new("appsrc", caps=caps_of("12:1", "float32"),
+                        data=[arr] * 2)
+        split = p.add_new("tensor_split", tensorseg="3,4,5")
+        sinks = [p.add_new("tensor_sink", store=True) for _ in range(3)]
+        Pipeline.link(src, split)
+        for s in sinks:
+            Pipeline.link(split, s)
+        p.run(timeout=60)
+        np.testing.assert_array_equal(sinks[0].buffers[0].memories[0].host(),
+                                      arr[:, :3])
+        np.testing.assert_array_equal(sinks[1].buffers[0].memories[0].host(),
+                                      arr[:, 3:7])
+        np.testing.assert_array_equal(sinks[2].buffers[0].memories[0].host(),
+                                      arr[:, 7:])
