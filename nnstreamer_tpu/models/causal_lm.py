@@ -69,30 +69,92 @@ def _split_heads(t, n_heads):
     return t.reshape(b, l, n_heads, d // n_heads).transpose(0, 2, 1, 3)
 
 
+#: TPU matmuls default to bf16 accumulation, which makes prefill vs
+#: step-decode logits drift ~1e-3 (different contraction orders). This
+#: family's contract is exactness between its execution forms, so its
+#: matmuls pin float32 precision (measured 6e-8 agreement on v5e).
+#: Large production models would keep bf16 and accept the drift.
+_PRECISION = "float32"
+
+
 def lm_forward(params: Dict[str, jax.Array], tokens: jax.Array,
                n_heads: int) -> jax.Array:
     """Full causal forward (the oracle): (B, T) int32 → (B, T, vocab)."""
+    with jax.default_matmul_precision(_PRECISION):
+        return _lm_forward(params, tokens, n_heads)
+
+
+def _block_body(h, layer, mask, n_heads):
+    """One transformer block over a full (masked) sequence; returns the
+    new hidden state plus this layer's per-head K/V (for cache prefill).
+    The ONE definition all full-sequence execution forms share."""
+    wqkv, wo, w1, w2, ln1, ln2 = layer
+    a = _ln(h, ln1)
+    q, k, v = jnp.split(a @ wqkv, 3, axis=-1)
+    qh, kh, vh = (_split_heads(z, n_heads) for z in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(qh.shape[-1])
+    s = jnp.where(mask, s, -1e30)
+    o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vh)
+    o = o.transpose(0, 2, 1, 3).reshape(h.shape)
+    h = h + o @ wo
+    m = _ln(h, ln2)
+    return h + jax.nn.gelu(m @ w1) @ w2, kh, vh
+
+
+def _layer_stack(params):
+    return (params["wqkv"], params["wo"], params["w1"], params["w2"],
+            params["ln1"], params["ln2"])
+
+
+def _lm_forward(params, tokens, n_heads):
     b, t = tokens.shape
     x = params["embed"][tokens] + params["pos_embed"][:t][None]
     mask = jnp.tril(jnp.ones((t, t), bool))
 
     def block(h, layer):
-        wqkv, wo, w1, w2, ln1, ln2 = layer
-        a = _ln(h, ln1)
-        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)
-        q, k, v = (_split_heads(z, n_heads) for z in (q, k, v))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(q.shape[-1])
-        s = jnp.where(mask, s, -1e30)
-        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
-        o = o.transpose(0, 2, 1, 3).reshape(h.shape)
-        h = h + o @ wo
-        m = _ln(h, ln2)
-        return h + jax.nn.gelu(m @ w1) @ w2, None
+        h, _, _ = _block_body(h, layer, mask, n_heads)
+        return h, None
 
-    x, _ = jax.lax.scan(
-        block, x, (params["wqkv"], params["wo"], params["w1"],
-                   params["w2"], params["ln1"], params["ln2"]))
+    x, _ = jax.lax.scan(block, x, _layer_stack(params))
     return _ln(x, params["lnf"]) @ params["embed"].T
+
+
+def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
+               n_heads: int, max_len: int
+               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Process a whole prompt in ONE forward and emit the populated cache.
+
+    tokens: (B, T) int32 with T <= max_len. Returns (logits_last (B, vocab),
+    kcache, vcache, pos=T) in the flat transport layout — decode then
+    continues token-by-token via ``lm_decode_step``. This is the standard
+    prefill/decode split: prompt cost is one big (MXU-friendly) forward,
+    not T sequential steps.
+    """
+    with jax.default_matmul_precision(_PRECISION):
+        return _lm_prefill(params, tokens, n_heads, max_len)
+
+
+def _lm_prefill(params, tokens, n_heads, max_len):
+    b, t = tokens.shape
+    if t > max_len:
+        raise ValueError(
+            f"lm_prefill: prompt length {t} exceeds max_len={max_len}")
+    n_layers = params["wqkv"].shape[0]
+    d_model = params["embed"].shape[1]
+    hd = d_model // n_heads
+    x = params["embed"][tokens] + params["pos_embed"][:t][None]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    pad = [(0, 0), (0, 0), (0, max_len - t), (0, 0)]
+
+    def block(h, layer):
+        h, kh, vh = _block_body(h, layer, mask, n_heads)
+        return h, (jnp.pad(kh, pad), jnp.pad(vh, pad))
+
+    x, (kc, vc) = jax.lax.scan(block, x, _layer_stack(params))
+    logits = (_ln(x[:, -1:], params["lnf"]) @ params["embed"].T)[:, 0]
+    flat = (n_layers * b * n_heads, max_len, hd)
+    return (logits, kc.reshape(flat), vc.reshape(flat),
+            jnp.full((1,), t, jnp.int32))
 
 
 def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
@@ -105,6 +167,11 @@ def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
     layout; pos: (1,) int32 — next write position. Returns
     (logits (B, vocab), kcache', vcache', pos+1).
     """
+    with jax.default_matmul_precision(_PRECISION):
+        return _lm_decode_step(params, token, kcache, vcache, pos, n_heads)
+
+
+def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
     n_layers = params["wqkv"].shape[0]
     b = token.shape[0]
     d_model = params["embed"].shape[1]

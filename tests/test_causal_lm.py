@@ -135,3 +135,57 @@ def test_cache_overflow_position_is_callers_problem(bundle):
         logits, k, v, pos = step(tok, k, v, pos)
     assert int(np.asarray(pos)[0]) == meta["max_len"]
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_prefill_then_decode_matches_full_forward(bundle):
+    """Prompt via one lm_prefill forward, continuation via steps: logits
+    equal the full causal forward over the whole sequence."""
+    from nnstreamer_tpu.models.causal_lm import lm_prefill
+
+    meta = bundle.metadata
+    rng = np.random.default_rng(4)
+    P_, C = 6, 5
+    tokens = rng.integers(0, meta["vocab"], (1, P_ + C)).astype(np.int32)
+    oracle = np.asarray(lm_forward(bundle.params, jnp.asarray(tokens),
+                                   meta["heads"]))
+    logits, k, v, pos = jax.jit(
+        lambda p, t: lm_prefill(p, t, meta["heads"], meta["max_len"]))(
+        bundle.params, tokens[:, :P_])
+    np.testing.assert_allclose(np.asarray(logits), oracle[:, P_ - 1],
+                               rtol=2e-4, atol=2e-5,
+                               err_msg="prefill last-logits diverged")
+    assert int(np.asarray(pos)[0]) == P_
+    step = jax.jit(bundle.fn())
+    for t in range(P_, P_ + C):
+        logits, k, v, pos = step(tokens[:, t:t + 1], k, v, pos)
+        np.testing.assert_allclose(np.asarray(logits), oracle[:, t],
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"decode step {t} diverged")
+
+
+def test_batched_decode_matches_oracle():
+    """batch=2 decoding: each batch row equals its own oracle."""
+    b = get_model(SPEC + "&batch=2")
+    meta = b.metadata
+    rng = np.random.default_rng(6)
+    T = 5
+    tokens = rng.integers(0, meta["vocab"], (2, T)).astype(np.int32)
+    oracle = np.asarray(lm_forward(b.params, jnp.asarray(tokens),
+                                   meta["heads"]))
+    k, v, pos = empty_cache(meta["layers"], 2, meta["heads"],
+                            meta["max_len"], meta["head_dim"])
+    step = jax.jit(b.fn())
+    for t in range(T):
+        logits, k, v, pos = step(tokens[:, t:t + 1], k, v, pos)
+        np.testing.assert_allclose(np.asarray(logits), oracle[:, t],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_prefill_rejects_oversized_prompt(bundle):
+    from nnstreamer_tpu.models.causal_lm import lm_prefill
+
+    meta = bundle.metadata
+    too_long = np.zeros((1, meta["max_len"] + 1), np.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        lm_prefill(bundle.params, jnp.asarray(too_long), meta["heads"],
+                   meta["max_len"])
