@@ -84,17 +84,23 @@ def lm_forward(params: Dict[str, jax.Array], tokens: jax.Array,
         return _lm_forward(params, tokens, n_heads)
 
 
-def _block_body(h, layer, mask, n_heads):
+def _block_body(h, layer, mask, n_heads, attention_fn=None):
     """One transformer block over a full (masked) sequence; returns the
     new hidden state plus this layer's per-head K/V (for cache prefill).
-    The ONE definition all full-sequence execution forms share."""
+    The ONE definition all full-sequence execution forms share.
+    ``attention_fn`` (q,k,v)->o replaces the dense causal attention
+    (e.g. sequence-parallel ring attention — it must apply causality
+    itself)."""
     wqkv, wo, w1, w2, ln1, ln2 = layer
     a = _ln(h, ln1)
     q, k, v = jnp.split(a @ wqkv, 3, axis=-1)
     qh, kh, vh = (_split_heads(z, n_heads) for z in (q, k, v))
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(qh.shape[-1])
-    s = jnp.where(mask, s, -1e30)
-    o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vh)
+    if attention_fn is not None:
+        o = attention_fn(qh, kh, vh)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(qh.shape[-1])
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vh)
     o = o.transpose(0, 2, 1, 3).reshape(h.shape)
     h = h + o @ wo
     m = _ln(h, ln2)
@@ -120,7 +126,8 @@ def _lm_forward(params, tokens, n_heads):
 
 
 def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
-               n_heads: int, max_len: int
+               n_heads: int, max_len: int, mesh=None,
+               sp_axis: str = "sp"
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Process a whole prompt in ONE forward and emit the populated cache.
 
@@ -129,12 +136,18 @@ def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
     continues token-by-token via ``lm_decode_step``. This is the standard
     prefill/decode split: prompt cost is one big (MXU-friendly) forward,
     not T sequential steps.
+
+    With ``mesh``, prompt attention runs **sequence-parallel** over
+    ``mesh[sp_axis]`` via causal ring attention (parallel/ring.py):
+    prompt length scales with the axis size (T must divide by it) while
+    the emitted cache and subsequent decode are unchanged — long-context
+    prefill across chips, streaming decode after.
     """
     with jax.default_matmul_precision(_PRECISION):
-        return _lm_prefill(params, tokens, n_heads, max_len)
+        return _lm_prefill(params, tokens, n_heads, max_len, mesh, sp_axis)
 
 
-def _lm_prefill(params, tokens, n_heads, max_len):
+def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp"):
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(
@@ -143,11 +156,27 @@ def _lm_prefill(params, tokens, n_heads, max_len):
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads
     x = params["embed"][tokens] + params["pos_embed"][:t][None]
-    mask = jnp.tril(jnp.ones((t, t), bool))
     pad = [(0, 0), (0, 0), (0, max_len - t), (0, 0)]
+    attn = mask = None
+    if mesh is not None:
+        from ..parallel.ring import sp_attention_fn
+
+        if sp_axis not in mesh.shape:
+            raise ValueError(
+                f"lm_prefill: mesh has no {sp_axis!r} axis "
+                f"(axes: {dict(mesh.shape)})")
+        if t % mesh.shape[sp_axis]:
+            raise ValueError(
+                f"lm_prefill: prompt length {t} not divisible by the "
+                f"{sp_axis!r} axis size {mesh.shape[sp_axis]}")
+        attn = sp_attention_fn("ring", mesh, sp_axis, causal=True)
+    else:
+        # only the dense path needs the O(t²) mask; the sp path exists
+        # precisely to avoid materializing it on one device
+        mask = jnp.tril(jnp.ones((t, t), bool))
 
     def block(h, layer):
-        h, kh, vh = _block_body(h, layer, mask, n_heads)
+        h, kh, vh = _block_body(h, layer, mask, n_heads, attn)
         return h, (jnp.pad(kh, pad), jnp.pad(vh, pad))
 
     x, (kc, vc) = jax.lax.scan(block, x, _layer_stack(params))

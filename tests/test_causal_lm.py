@@ -189,3 +189,53 @@ def test_prefill_rejects_oversized_prompt(bundle):
     with pytest.raises(ValueError, match="max_len"):
         lm_prefill(bundle.params, jnp.asarray(too_long), meta["heads"],
                    meta["max_len"])
+
+
+def test_sp_prefill_then_decode_exact(bundle):
+    """Long-context path: ring-attention prefill over the sp mesh, then
+    single-stream decode — logits equal the dense oracle throughout."""
+    from nnstreamer_tpu.models.causal_lm import lm_prefill
+    from nnstreamer_tpu.parallel import make_mesh
+
+    meta = bundle.metadata
+    mesh = make_mesh({"sp": 8})
+    rng = np.random.default_rng(9)
+    P_, C = 8, 4  # prompt divides the sp axis
+    tokens = rng.integers(0, meta["vocab"], (1, P_ + C)).astype(np.int32)
+    oracle = np.asarray(lm_forward(bundle.params, jnp.asarray(tokens),
+                                   meta["heads"]))
+    logits, k, v, pos = lm_prefill(bundle.params,
+                                   jnp.asarray(tokens[:, :P_]),
+                                   meta["heads"], meta["max_len"],
+                                   mesh=mesh)
+    np.testing.assert_allclose(np.asarray(logits), oracle[:, P_ - 1],
+                               rtol=2e-4, atol=2e-5)
+    step = jax.jit(bundle.fn())
+    k, v = np.asarray(k), np.asarray(v)  # cache leaves the mesh
+    for t in range(P_, P_ + C):
+        logits, k, v, pos = step(tokens[:, t:t + 1], k, v, pos)
+        np.testing.assert_allclose(np.asarray(logits), oracle[:, t],
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"sp-prefill decode step {t}")
+
+
+def test_sp_prefill_rejects_indivisible_prompt(bundle):
+    from nnstreamer_tpu.models.causal_lm import lm_prefill
+    from nnstreamer_tpu.parallel import make_mesh
+
+    meta = bundle.metadata
+    mesh = make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="divisible"):
+        lm_prefill(bundle.params, jnp.zeros((1, 6), jnp.int32),
+                   meta["heads"], meta["max_len"], mesh=mesh)
+
+
+def test_sp_prefill_rejects_missing_axis(bundle):
+    from nnstreamer_tpu.models.causal_lm import lm_prefill
+    from nnstreamer_tpu.parallel import make_mesh
+
+    meta = bundle.metadata
+    with pytest.raises(ValueError, match="axis"):
+        lm_prefill(bundle.params, jnp.zeros((1, 8), jnp.int32),
+                   meta["heads"], meta["max_len"],
+                   mesh=make_mesh({"data": 8}))
