@@ -1,0 +1,111 @@
+"""Full model lifecycle in one flow: in-pipeline training → checkpoint →
+serialized export → pipeline-string deployment → remote offload.
+
+The integration capstone mirroring a real user journey across
+tensor_trainer, utils.checkpoints, models.deploy, the textual parser,
+and the query layer — each subsystem has its own suite; this pins that
+they compose.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from nnstreamer_tpu.core import Caps
+from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.models.zoo import ModelBundle
+
+
+def caps_of(dims, types, rate=30):
+    return Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings(dims, types), rate))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_train_checkpoint_export_deploy_offload(tmp_path):
+    rng = np.random.default_rng(0)
+    true_w = rng.normal(size=(8, 4)).astype(np.float32)
+    w0 = jax.random.normal(jax.random.PRNGKey(0), (8, 4)) * 0.1
+    bundle = ModelBundle(
+        "linear", lambda p, x: x @ p, params=w0,
+        in_info=TensorsInfo.from_strings("8:4", "float32"),
+        out_info=TensorsInfo.from_strings("4:4", "float32"))
+
+    # 1. train in-pipeline --------------------------------------------------- #
+    data = []
+    for _ in range(30):
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        data.append((x, np.argmax(x @ true_w, -1).astype(np.int32)))
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("8:4,4", "float32,int32"),
+                    data=data)
+    tr = p.add_new("tensor_trainer", model=bundle, learning_rate=0.1,
+                   checkpoint_path=str(tmp_path / "trained.msgpack"))
+    sink = p.add_new("tensor_sink")
+    Pipeline.link(src, tr, sink)
+    p.run(timeout=120)
+    losses = list(tr.losses)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+    assert (tmp_path / "trained.msgpack").exists()
+    trained = tr.trained_bundle()
+
+    # 2. export the TRAINED model to a serialized artifact ------------------- #
+    from nnstreamer_tpu.models.deploy import export_model
+
+    artifact = tmp_path / "linear.jaxexport"
+    export_model(str(artifact), trained)
+    assert artifact.stat().st_size > 0
+
+    # 3. deploy via a pipeline STRING (no Python model source) --------------- #
+    from nnstreamer_tpu.graph.parse import parse_pipeline
+
+    probe = rng.normal(size=(4, 8)).astype(np.float32)
+    want = np.asarray(probe @ np.asarray(trained.params))
+    p2 = parse_pipeline(
+        f'appsrc name=in ! tensor_filter framework=auto '
+        f'model="{artifact}" ! tensor_sink name=out store=true')
+    p2.get_by_name("in").set_properties(
+        caps=caps_of("8:4", "float32"), data=[probe])
+    p2.run(timeout=120)
+    out = p2.get_by_name("out").buffers[0].memories[0].host()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    # the artifact serves the TRAINED weights, not the init
+    assert not np.allclose(out, probe @ np.asarray(w0))
+
+    # 4. offload the artifact behind a query server -------------------------- #
+    port = free_port()
+    sp = Pipeline("server")
+    ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                      port=port, id=3, dims="8:4", types="float32")
+    filt = sp.add_new("tensor_filter", framework="auto",
+                      model=str(artifact))
+    ssink = sp.add_new("tensor_query_serversink", id=3, async_depth=8)
+    Pipeline.link(ssrc, filt, ssink)
+    sp.start()
+    try:
+        time.sleep(0.2)
+        cp = Pipeline("client")
+        csrc = cp.add_new("appsrc", caps=caps_of("8:4", "float32"),
+                          data=[probe] * 3)
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
+                        async_depth=8)
+        csink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(csrc, qc, csink)
+        cp.run(timeout=120)
+        assert csink.num_buffers == 3
+        np.testing.assert_allclose(csink.buffers[-1].memories[0].host(),
+                                   want, rtol=1e-4, atol=1e-5)
+    finally:
+        sp.stop()
