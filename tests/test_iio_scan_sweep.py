@@ -1,0 +1,106 @@
+"""IIO scan-element spec/extraction sweep.
+
+Reference: per-channel typed scan conversion in tensor_src_iio.c:104-136
+and the unittest_src_iio fixture matrix (endianness × sign × bits ×
+shift). Pins the bit-exact extraction math and the kernel buffer layout
+(natural alignment) rule.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.elements.iio import (
+    ScanChannel,
+    parse_scan_type,
+    scan_layout,
+)
+
+
+@pytest.mark.parametrize("spec,want", [
+    ("le:s12/16>>4", (False, True, 12, 16, 4)),
+    ("be:u10/16>>6", (True, False, 10, 16, 6)),
+    ("le:u8/8", (False, False, 8, 8, 0)),
+    ("be:s32/32>>0", (True, True, 32, 32, 0)),
+    ("le:s64/64", (False, True, 64, 64, 0)),
+])
+def test_parse_scan_type(spec, want):
+    assert parse_scan_type(spec) == want
+
+
+@pytest.mark.parametrize("bad", ["", "xx:s12/16", "le:q12/16", "le:s12",
+                                 "s12/16>>4", "le:s12/16>>"])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_scan_type(bad)
+
+
+def _ch(**kw):
+    base = dict(name="c", index=0, big_endian=False, signed=True,
+                bits=12, storage_bits=16, shift=4)
+    base.update(kw)
+    return ScanChannel(**base)
+
+
+class TestExtract:
+    def test_le_signed_shifted(self):
+        # 12-bit value -5 stored in the high bits of a 16-bit LE word
+        raw = struct.pack("<H", ((-5) & 0xFFF) << 4)
+        assert _ch().extract(raw) == -5.0
+
+    def test_be_unsigned(self):
+        ch = _ch(big_endian=True, signed=False, bits=10, shift=6)
+        raw = struct.pack(">H", 700 << 6)
+        assert ch.extract(raw) == 700.0
+
+    def test_sign_extension_boundaries(self):
+        ch = _ch(shift=0, bits=16, storage_bits=16)
+        assert ch.extract(struct.pack("<h", -32768)) == -32768.0
+        assert ch.extract(struct.pack("<h", 32767)) == 32767.0
+
+    def test_scale_and_offset_applied(self):
+        ch = _ch(shift=0, bits=16, storage_bits=16, scale=0.5, offset=10.0)
+        assert ch.extract(struct.pack("<h", 4)) == (4 + 10.0) * 0.5
+
+    def test_garbage_outside_field_masked(self):
+        # bits above the 12-bit field (after shift) must be ignored
+        ch = _ch(shift=0, bits=12, storage_bits=16, signed=False)
+        raw = struct.pack("<H", 0xF000 | 0x0ABC)
+        assert ch.extract(raw) == 0x0ABC
+
+
+class TestLayout:
+    def test_natural_alignment_with_padding(self):
+        chans = [
+            ScanChannel("a", 0, False, False, 8, 8, 0),     # 1 byte @0
+            ScanChannel("b", 1, False, True, 16, 16, 0),    # align 2 → @2
+            ScanChannel("c", 2, False, True, 32, 32, 0),    # align 4 → @4
+        ]
+        total = scan_layout(chans)
+        assert [c.byte_offset for c in chans] == [0, 2, 4]
+        assert total == 8  # padded to the largest storage size
+
+    def test_index_order_not_list_order(self):
+        chans = [
+            ScanChannel("second", 1, False, False, 16, 16, 0),
+            ScanChannel("first", 0, False, False, 16, 16, 0),
+        ]
+        scan_layout(chans)
+        first = next(c for c in chans if c.name == "first")
+        second = next(c for c in chans if c.name == "second")
+        assert first.byte_offset == 0 and second.byte_offset == 2
+
+    def test_roundtrip_through_packed_scan(self):
+        chans = [
+            ScanChannel("a", 0, False, True, 12, 16, 4),
+            ScanChannel("b", 1, True, False, 10, 16, 6),
+            ScanChannel("c", 2, False, True, 32, 32, 0),
+        ]
+        total = scan_layout(chans)
+        buf = bytearray(total)
+        buf[0:2] = struct.pack("<H", ((-100) & 0xFFF) << 4)
+        buf[2:4] = struct.pack(">H", 513 << 6)
+        buf[4:8] = struct.pack("<i", -123456)
+        vals = [c.extract(bytes(buf)) for c in chans]
+        assert vals == [-100.0, 513.0, -123456.0]
