@@ -47,6 +47,11 @@ class TensorTrainer(Element):
         self.checkpoint_path: Optional[str] = None
         self.report_every = 0  # frames; 0 = no bus reports
         self.mesh: Any = None  # Mesh | axes dict | "data:4,model:2"
+        #: True: checkpoint_path stores {params, opt_state, frames} and a
+        #: restart RESUMES training (optimizer momentum intact) instead of
+        #: re-initializing. False (default): params only — the file stays
+        #: directly servable via custom="arch=..." deployment.
+        self.resume = False
         super().__init__(name, **props)
         self._x_sharding = None
         self._y_sharding = None
@@ -119,6 +124,36 @@ class TensorTrainer(Element):
             self._step = jax.jit(step)
         self._n = 0
         self.losses.clear()
+        if self.resume and self.checkpoint_path:
+            import os
+
+            if os.path.exists(self.checkpoint_path):
+                from ..utils import checkpoints
+
+                try:
+                    blob = checkpoints.load_variables(
+                        self.checkpoint_path,
+                        {"params": self._params,
+                         "opt_state": self._opt_state, "frames": 0})
+                except Exception as e:  # noqa: BLE001 — format mismatch
+                    raise ValueError(
+                        f"tensor_trainer {self.name}: {self.checkpoint_path}"
+                        " is not a resume checkpoint (params+opt_state) — "
+                        "it looks like a params-only file written with "
+                        "resume=false; delete it or point resume at a "
+                        f"fresh path ({type(e).__name__}: {e})") from e
+                # restore onto the placements the step was built with
+                # (mesh mode: opt_state is model-parallel; a plain commit
+                # would replicate it and defeat the sharding)
+                self._params = jax.tree_util.tree_map(
+                    lambda old, new: jax.device_put(
+                        new, getattr(old, "sharding", None)),
+                    self._params, blob["params"])
+                self._opt_state = jax.tree_util.tree_map(
+                    lambda old, new: jax.device_put(
+                        new, getattr(old, "sharding", None)),
+                    self._opt_state, blob["opt_state"])
+                self._n = int(blob.get("frames", 0))
 
     def _resolve_mesh(self):
         import math
@@ -190,7 +225,11 @@ class TensorTrainer(Element):
         if self.checkpoint_path and self._params is not None:
             from ..utils import checkpoints
 
-            checkpoints.save_variables(self.checkpoint_path, self._params)
+            payload = ({"params": self._params,
+                        "opt_state": self._opt_state,
+                        "frames": self._n}
+                       if self.resume else self._params)
+            checkpoints.save_variables(self.checkpoint_path, payload)
             self.post_message(MessageType.ELEMENT,
                               {"trainer": self.name,
                                "checkpoint": self.checkpoint_path})

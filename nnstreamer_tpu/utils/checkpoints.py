@@ -23,7 +23,9 @@ def save_variables(path: str, variables: Any) -> None:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(path), variables)
+        # checkpoints are save-points: overwriting an existing path is the
+        # normal save->load->save cycle (orbax refuses by default)
+        ckptr.save(os.path.abspath(path), variables, force=True)
         ckptr.wait_until_finished()
 
 

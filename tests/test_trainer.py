@@ -172,3 +172,134 @@ class TestShardedTrainer:
         Pipeline.link(src, tr, sink)
         p.run(timeout=60)
         assert len(tr.losses) == 2
+
+
+class TestResume:
+    def test_resume_restores_params_opt_state_and_counter(self, tmp_path):
+        """Two runs with resume=true continue training (momentum intact);
+        loss after resume starts near where the first run ended."""
+        ckpt = tmp_path / "resume.msgpack"
+        rng = np.random.default_rng(0)
+        true_w = rng.normal(size=(8, 4)).astype(np.float32)
+
+        def run(n):
+            data = []
+            for _ in range(n):
+                x = rng.normal(size=(4, 8)).astype(np.float32)
+                data.append((x, np.argmax(x @ true_w, -1).astype(np.int32)))
+            p = Pipeline()
+            src = p.add_new("appsrc", caps=caps_of("8:4,4", "float32,int32"),
+                            data=data)
+            tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                           learning_rate=0.05, optimizer="sgd",
+                           checkpoint_path=str(ckpt), resume=True)
+            sink = p.add_new("tensor_sink")
+            Pipeline.link(src, tr, sink)
+            p.run(timeout=120)
+            return tr
+
+        t1 = run(15)
+        end_loss = float(np.mean(list(t1.losses)[-5:]))
+        t2 = run(15)
+        assert t2._n == 30  # frame counter resumed
+        start_loss = float(np.mean(list(t2.losses)[:5]))
+        # resumed run starts from the trained state, not from scratch
+        first_run_start = float(np.mean(list(t1.losses)[:5]))
+        assert start_loss < first_run_start
+        assert start_loss < end_loss * 3 + 0.5
+
+    def test_plain_checkpoint_stays_servable(self, tmp_path):
+        """resume=false (default) keeps the params-only format that
+        custom=\"arch=...\" deployment consumes."""
+        ckpt = tmp_path / "plain.msgpack"
+        rng = np.random.default_rng(1)
+        data = [(rng.normal(size=(2, 8)).astype(np.float32),
+                 np.zeros(2, np.int32)) for _ in range(3)]
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                        data=data)
+        tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                       checkpoint_path=str(ckpt))
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, tr, sink)
+        p.run(timeout=60)
+        from nnstreamer_tpu.utils import checkpoints
+        import jax
+
+        w = checkpoints.load_variables(
+            str(ckpt), jax.numpy.zeros((8, 4)))
+        assert np.asarray(w).shape == (8, 4)
+
+    def test_resume_cycle_with_orbax_dir(self, tmp_path):
+        """save->load->save with an orbax directory checkpoint (no
+        .msgpack suffix) must overwrite cleanly across runs."""
+        ckpt = tmp_path / "orbax_ckpt"
+        rng = np.random.default_rng(2)
+
+        def run():
+            data = [(rng.normal(size=(2, 8)).astype(np.float32),
+                     np.zeros(2, np.int32)) for _ in range(3)]
+            p = Pipeline()
+            src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                            data=data)
+            tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                           checkpoint_path=str(ckpt), resume=True)
+            sink = p.add_new("tensor_sink")
+            Pipeline.link(src, tr, sink)
+            p.run(timeout=120)
+            return tr
+
+        run()
+        t2 = run()  # second EOS overwrites; second start resumed
+        assert t2._n == 6
+
+    def test_resume_against_params_only_file_clear_error(self, tmp_path):
+        ckpt = tmp_path / "old.msgpack"
+        from nnstreamer_tpu.utils import checkpoints
+        import jax.numpy as jnp
+
+        checkpoints.save_variables(str(ckpt), jnp.zeros((8, 4)))
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=caps_of("8:2,2", "float32,int32"),
+                        data=[(np.zeros((2, 8), np.float32),
+                               np.zeros(2, np.int32))])
+        tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                       checkpoint_path=str(ckpt), resume=True)
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, tr, sink)
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        with pytest.raises((PipelineError, ValueError),
+                           match="resume"):
+            p.run(timeout=30)
+
+    def test_mesh_resume_preserves_sharding(self, tmp_path):
+        import jax
+
+        ckpt = tmp_path / "mesh_resume.msgpack"
+        rng = np.random.default_rng(3)
+
+        def run():
+            data = [(rng.normal(size=(4, 8)).astype(np.float32),
+                     np.zeros(4, np.int32)) for _ in range(3)]
+            p = Pipeline()
+            src = p.add_new("appsrc", caps=caps_of("8:4,4", "float32,int32"),
+                            data=data)
+            tr = p.add_new("tensor_trainer", model=linear_bundle(),
+                           optimizer="sgd", mesh="data:4,model:2",
+                           checkpoint_path=str(ckpt), resume=True)
+            sink = p.add_new("tensor_sink")
+            Pipeline.link(src, tr, sink)
+            p.run(timeout=120)
+            return tr
+
+        run()
+        t2 = run()
+        assert t2._n == 6
+        # restored params keep their mesh placement (8 devices)
+        leaf = jax.tree_util.tree_leaves(t2.params)[0]
+        assert len(leaf.sharding.device_set) == 8
+        # momentum state is device-resident too, not host numpy
+        opt_leaves = [x for x in jax.tree_util.tree_leaves(t2._opt_state)
+                      if hasattr(x, "sharding")]
+        assert opt_leaves, "opt_state lost device placement on resume"
