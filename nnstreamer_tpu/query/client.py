@@ -67,6 +67,16 @@ class TensorQueryClient(Element):
         self._cv = threading.Condition()
         self._reader: Optional[threading.Thread] = None
         self._reader_error: Optional[Exception] = None
+        self._pong = False
+        #: entries appended to _pending whose DATA has not gone out yet —
+        #: the reader must not count them as lost in-flight frames
+        self._unsent = 0
+        self._last_activity = 0.0
+        #: reused connections idle longer than this get a PING/PONG probe
+        #: before the next frame (a peer that died while idle is only
+        #: detectable by traffic); short gaps skip the probe so steady
+        #: streams never pay the extra round trip
+        self.idle_probe_s = 0.5
 
     # -- connection ---------------------------------------------------------- #
     def _resolve_endpoints(self) -> list:
@@ -143,6 +153,7 @@ class TensorQueryClient(Element):
         self._reader = None
         with self._cv:
             self._pending.clear()
+            self._unsent = 0
             self._cv.notify_all()
 
     # -- negotiation --------------------------------------------------------- #
@@ -157,6 +168,11 @@ class TensorQueryClient(Element):
         try:
             while True:
                 cmd, rmeta, rpayload = recv_message(sock)
+                if cmd is Cmd.PONG:
+                    with self._cv:
+                        self._pong = True
+                        self._cv.notify_all()
+                    continue
                 if cmd is Cmd.ERROR:
                     raise QueryProtocolError(rmeta.get("error", "server error"))
                 if cmd is not Cmd.RESULT:
@@ -175,47 +191,115 @@ class TensorQueryClient(Element):
                     self._cv.notify_all()
         except (ConnectionError, OSError, QueryProtocolError) as e:
             with self._cv:
-                # in-flight frames are lost; surface unless this is a clean
-                # shutdown with nothing outstanding
-                if self._pending or not isinstance(e, OSError):
+                # SENT frames are lost; entries never transmitted
+                # (_unsent, a chain call mid-send-failure) are NOT — their
+                # chain call pops and retries them itself
+                lost = len(self._pending) - self._unsent
+                if lost > 0 or not isinstance(e, OSError):
                     self._reader_error = e
                     self.post_error(f"query reader failed with "
-                                    f"{len(self._pending)} in flight: {e}",
-                                    exc=e)
-                self._pending.clear()
+                                    f"{lost} in flight: {e}", exc=e)
+                    self._pending.clear()
+                    self._unsent = 0
                 self._cv.notify_all()
+
+    def _reset_conn(self) -> None:
+        """Drop the connection + reader so the next attempt dials fresh.
+        Only safe with nothing in flight. stop() joins the old reader
+        BEFORE the state reset — an unjoined reader could wake later and
+        misread the new connection's pending window."""
+        self.stop()
+        self._reader_error = None
+
+    def _probe_idle_conn(self, sock: socket.socket) -> bool:
+        """PING/PONG a reused idle connection. A peer that died while we
+        were idle is only detectable by traffic — without this, the first
+        frame after an idle gap would be entrusted to a dead socket and
+        lost to an async RST."""
+        with self._cv:
+            self._pong = False
+        try:
+            send_message(sock, Cmd.PING, {})
+        except OSError:
+            return False
+        deadline = time.monotonic() + min(self.timeout_s, 5.0)
+        with self._cv:
+            while not self._pong and self._reader_error is None \
+                    and self._reader is not None \
+                    and self._reader.is_alive() \
+                    and time.monotonic() < deadline:
+                self._cv.wait(0.1)
+            return self._pong
 
     def _chain_pipelined(self, buf: Buffer, depth: int) -> FlowReturn:
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
-        if self._reader is not None and not self._reader.is_alive() \
-                and self._reader_error is None:
-            # reader exited cleanly (server closed between streams):
-            # reconnect fresh on the next frame
-            self._reader = None
-            self.stop()
-        sock = self._ensure_conn()
-        if self._reader is None:
-            # the reader blocks in recv indefinitely (stop() unblocks it
-            # via shutdown); the connect timeout must NOT ride along or a
-            # >timeout_s gap between results (e.g. a server-side XLA
-            # compile) would kill the stream
-            sock.settimeout(None)
-            self._reader = threading.Thread(
-                target=self._reader_loop, args=(sock,), daemon=True,
-                name=f"qclient-reader:{self.name}")
-            self._reader.start()
-        with self._cv:
-            while len(self._pending) >= depth and self._reader_error is None:
-                self._cv.wait(0.1)
-            if self._reader_error is not None:
-                return FlowReturn.ERROR  # error already on the bus
-            self._pending.append((buf.pts, buf.duration, buf.offset))
-        try:
-            send_message(sock, Cmd.DATA, meta, payload)
-        except OSError as e:
-            self.post_error(f"query send failed: {e}", exc=e)
-            return FlowReturn.ERROR
-        return FlowReturn.OK
+        for attempt in range(max(int(self.max_request_retry), 1)):
+            with self._cv:
+                if self._reader_error is not None:
+                    return FlowReturn.ERROR  # in-flight loss, on the bus
+                idle = not self._pending
+                reader_dead = self._reader is not None \
+                    and not self._reader.is_alive()
+            if reader_dead:
+                if not idle:
+                    self.post_error("query reader died with frames queued")
+                    return FlowReturn.ERROR
+                self._reset_conn()  # clean close between streams: redial
+            if self._sock is None:
+                try:
+                    # single dial per outer attempt: the sync path's
+                    # _ensure_conn retry loop would multiply with this one
+                    self._sock = self._connect()
+                except (ConnectionError, OSError):
+                    time.sleep(min(0.2 * (attempt + 1), 1.0))
+                    continue
+            sock = self._sock
+            fresh = self._reader is None
+            if fresh:
+                # the reader blocks in recv indefinitely (stop() unblocks
+                # it via shutdown); the connect timeout must NOT ride
+                # along or a >timeout_s gap between results (e.g. a
+                # server-side XLA compile) would kill the stream
+                sock.settimeout(None)
+                self._reader = threading.Thread(
+                    target=self._reader_loop, args=(sock,), daemon=True,
+                    name=f"qclient-reader:{self.name}")
+                self._reader.start()
+            stale = (idle and not fresh and
+                     time.monotonic() - self._last_activity
+                     > float(self.idle_probe_s))
+            if stale and not self._probe_idle_conn(sock):
+                self._reset_conn()
+                continue  # dead idle connection: retry on a fresh one
+            with self._cv:
+                while len(self._pending) >= depth \
+                        and self._reader_error is None:
+                    self._cv.wait(0.1)
+                if self._reader_error is not None:
+                    return FlowReturn.ERROR
+                self._pending.append((buf.pts, buf.duration, buf.offset))
+                self._unsent += 1
+            try:
+                send_message(sock, Cmd.DATA, meta, payload)
+                with self._cv:
+                    self._unsent = max(0, self._unsent - 1)
+                self._last_activity = time.monotonic()
+                return FlowReturn.OK
+            except OSError:
+                with self._cv:
+                    if self._pending:
+                        self._pending.pop()  # this frame never went out
+                    self._unsent = max(0, self._unsent - 1)
+                    others = bool(self._pending)
+                if others or self._reader_error is not None:
+                    # sent frames are (or already were) reported lost
+                    if self._reader_error is None:
+                        self.post_error(
+                            "query send failed with frames in flight")
+                    return FlowReturn.ERROR
+                self._reset_conn()  # nothing else at risk: retry fresh
+        self.post_error("query: request failed after retries")
+        return FlowReturn.ERROR
 
     def _drain_pending(self, timeout: float = 60.0) -> None:
         deadline = time.monotonic() + timeout

@@ -681,3 +681,42 @@ class TestPipelinedOffload:
         Pipeline.link(src, qc, sink)
         with pytest.raises((PipelineError, TimeoutError)):
             cp.run(timeout=30)
+
+    def test_pipelined_reconnects_after_server_restart(self):
+        """A cleanly closed connection between streams must reconnect on
+        the next frame (reader exits cleanly, next chain redials)."""
+        port = free_port()
+        sp1 = self._server(port)
+        sp1.start()
+        time.sleep(0.2)
+        cp = Pipeline("client")
+        src = cp.add_new("appsrc", caps=caps_of("4:1", "float32"))
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
+                        async_depth=4, max_request_retry=10)
+        sink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(src, qc, sink)
+        cp.start()
+        try:
+            src.push_buffer(np.full((1, 4), 1, np.float32))
+            deadline = time.monotonic() + 30
+            while sink.num_buffers < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sink.num_buffers == 1
+            sp1.stop()          # server goes away between frames
+            time.sleep(0.3)
+            sp2 = self._server(port)
+            sp2.start()
+            time.sleep(0.3)
+            try:
+                src.push_buffer(np.full((1, 4), 2, np.float32))
+                src.end_of_stream()
+                assert cp.wait_eos(30)
+                assert sink.num_buffers == 2
+                np.testing.assert_array_equal(
+                    sink.buffers[1].memories[0].host(),
+                    np.full((1, 4), 20, np.float32))
+            finally:
+                sp2.stop()
+        finally:
+            cp.stop()
+            sp1.stop()
