@@ -1,0 +1,73 @@
+"""MobileNet-v1 in flax — the reference's flagship test model.
+
+The reference's golden pipelines serve mobilenet_v1 (quantized tflite:
+tests/test_models/models/mobilenet_v1_1.0_224_quant.tflite, SSAT label
+goldens in tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:69-76).
+This is the native flax v1 (Howard et al. 2017: a stem conv then 13
+depthwise-separable blocks), NHWC for the MXU, bf16 compute;
+``custom="quant=w8"`` at the filter mirrors the quantized-tflite serving
+shape (int8 weights, dequant fused).
+
+Reuses ConvBNReLU and the tflite uint8 preprocessing convention from
+mobilenet_v2.py; output is 1001-way logits (background + ImageNet).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .mobilenet_v2 import ConvBNReLU, _make_divisible
+from .zoo import ModelBundle, register_model
+
+#: (out channels, stride) per depthwise-separable block — v1 paper table 1
+_BLOCKS: Sequence[Tuple[int, int]] = (
+    (64, 1),
+    (128, 2), (128, 1),
+    (256, 2), (256, 1),
+    (512, 2), (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+    (1024, 2), (1024, 1),
+)
+
+
+class DepthwiseSeparable(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = x.shape[-1]
+        x = ConvBNReLU(ch, kernel=3, stride=self.stride, groups=ch,
+                       dtype=self.dtype)(x, train)       # depthwise
+        return ConvBNReLU(self.features, kernel=1,
+                          dtype=self.dtype)(x, train)    # pointwise
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 1001
+    width: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNReLU(_make_divisible(32 * self.width), stride=2,
+                       dtype=self.dtype)(x, train)
+        for c, s in _BLOCKS:
+            x = DepthwiseSeparable(_make_divisible(c * self.width),
+                                   stride=s, dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def make_mobilenet_v1(**options: Any) -> ModelBundle:
+    from .mobilenet_v2 import make_mobilenet_bundle
+
+    return make_mobilenet_bundle("mobilenet_v1", MobileNetV1, **options)
+
+
+register_model("mobilenet_v1", make_mobilenet_v1)

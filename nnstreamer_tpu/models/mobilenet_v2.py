@@ -109,13 +109,17 @@ def preprocess_uint8(x: jax.Array) -> jax.Array:
     return x.astype(jnp.float32) / 127.5 - 1.0
 
 
-def make_mobilenet_v2(width: str = "1.0", size: str = "224",
-                      num_classes: str = "1001", checkpoint: Optional[str] = None,
-                      dtype: str = "bfloat16", seed: str = "0",
-                      batch: str = "1", **_: Any) -> ModelBundle:
+def make_mobilenet_bundle(name: str, model_cls: Any, width: str = "1.0",
+                          size: str = "224", num_classes: str = "1001",
+                          checkpoint: Optional[str] = None,
+                          dtype: str = "bfloat16", seed: str = "0",
+                          batch: str = "1", **_: Any) -> ModelBundle:
+    """Shared classifier-bundle factory: the serving contract (uint8
+    preprocessing dispatch, checkpoint restore, I/O metadata) is ONE
+    definition for every mobilenet-family class (v1/v2)."""
     w, hw, nc, b = float(width), int(size), int(num_classes), int(batch)
-    model = MobileNetV2(num_classes=nc, width=w,
-                        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    model = model_cls(num_classes=nc, width=w,
+                      dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
     from .zoo import init_variables
 
     variables = init_variables(model, int(seed),
@@ -132,10 +136,14 @@ def make_mobilenet_v2(width: str = "1.0", size: str = "224",
 
     in_info = TensorsInfo.from_strings(f"3:{hw}:{hw}:{b}", "uint8")
     out_info = TensorsInfo.from_strings(f"{nc}:{b}", "float32")
-    return ModelBundle("mobilenet_v2", apply, params=variables,
+    return ModelBundle(name, apply, params=variables,
                        in_info=in_info, out_info=out_info,
                        preprocess=preprocess_uint8,
                        metadata={"width": w, "size": hw, "classes": nc})
+
+
+def make_mobilenet_v2(**options: Any) -> ModelBundle:
+    return make_mobilenet_bundle("mobilenet_v2", MobileNetV2, **options)
 
 
 register_model("mobilenet_v2", make_mobilenet_v2)

@@ -196,11 +196,13 @@ _builtins_loaded = False
 
 
 def _ensure_builtin_models() -> None:
+    # NOTE: flag is set AFTER the imports: a failing builtin module must
+    # surface its ImportError on every call, not leave an empty catalog
     global _builtins_loaded
     if _builtins_loaded:
         return
-    _builtins_loaded = True
     from . import mobilenet_v2  # noqa: F401
+    from . import mobilenet_v1  # noqa: F401
     from . import simple  # noqa: F401
     from . import ssd_mobilenet  # noqa: F401
     from . import deeplab  # noqa: F401
@@ -210,3 +212,4 @@ def _ensure_builtin_models() -> None:
     from . import stream_transformer  # noqa: F401
     from . import moe_transformer  # noqa: F401
     from . import causal_lm  # noqa: F401
+    _builtins_loaded = True

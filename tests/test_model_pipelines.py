@@ -11,8 +11,9 @@ from nnstreamer_tpu.models.zoo import get_model, model_names
 
 def test_zoo_catalog_complete():
     names = model_names()
-    for required in ["mobilenet_v2", "ssd_mobilenet_v2", "deeplab_v3",
-                     "posenet", "lstm_cell", "lenet", "mnist",
+    for required in ["mobilenet_v1", "mobilenet_v2", "ssd_mobilenet_v2", "deeplab_v3",
+                     "posenet", "lstm_cell", "lenet", "mnist", "causal_lm",
+                     "moe_transformer", "stream_transformer",
                      "passthrough", "scaler"]:
         assert required in names
 
@@ -457,3 +458,46 @@ def test_user_factory_beats_builtin_alias():
         # restore the builtin alias
         _factories.pop("mnist", None)
         register_alias("mnist", "lenet")
+
+
+class TestMobileNetV1:
+    """The reference's flagship test model (mobilenet_v1 quant tflite):
+    native v1 + quant=w8 mirrors the quantized serving shape."""
+
+    def test_forward_shapes_and_param_count(self):
+        import jax
+
+        b = get_model("zoo://mobilenet_v1?width=0.25&size=32&num_classes=16"
+                      "&dtype=float32")
+        x = np.random.default_rng(0).integers(
+            0, 255, (1, 32, 32, 3)).astype(np.uint8)
+        out = jax.jit(b.fn())(x)
+        assert out.shape == (1, 16)
+        assert np.isfinite(np.asarray(out)).all()
+        # v1@0.25 must be a different (smaller) network than v2@0.25
+        v2 = get_model("zoo://mobilenet_v2?width=0.25&size=32"
+                       "&num_classes=16&dtype=float32")
+        n1 = sum(np.asarray(p).size
+                 for p in jax.tree_util.tree_leaves(b.params))
+        n2 = sum(np.asarray(p).size
+                 for p in jax.tree_util.tree_leaves(v2.params))
+        assert n1 != n2
+
+    def test_quantized_label_pipeline(self, tmp_path):
+        labels = tmp_path / "l.txt"
+        labels.write_text("\n".join(f"c{i}" for i in range(16)))
+        p = Pipeline()
+        src = p.add_new("videotestsrc", width=32, height=32, num_buffers=3,
+                        pattern="random")
+        conv = p.add_new("tensor_converter")
+        filt = p.add_new("tensor_filter", framework="xla-tpu",
+                         model="zoo://mobilenet_v1?width=0.25&size=32"
+                               "&num_classes=16&dtype=float32",
+                         custom="quant=w8")
+        dec = p.add_new("tensor_decoder", mode="image_labeling",
+                        option1=str(labels))
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, filt, dec, sink)
+        p.run(timeout=180)
+        assert sink.num_buffers == 3
+        assert sink.buffers[0].meta["label"].startswith("c")
