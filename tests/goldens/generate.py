@@ -125,6 +125,24 @@ def build_cases():
         "direct_video", "direct_video", {},
         [vid],
         TensorsConfig(TensorsInfo.from_strings("3:12:8:1", "uint8"))))
+
+    # -- variants: non-1:1 output scaling draw paths ------------------------- #
+    # (appended AFTER all original draws so the rng sequence — and thus
+    # every committed original array — stays bit-identical)
+    locs2 = rng.normal(size=(1, n_anchors, 4)).astype(np.float32)
+    scores2 = (rng.normal(size=(1, n_anchors, 6)) * 4).astype(np.float32)
+    cases.append((
+        "bbox_mobilenet_ssd_upscale", "bounding_box",
+        {1: "mobilenet-ssd", 2: labels_path, 3: priors_path,
+         4: "96:96", 5: "192:160"},  # model dims ≠ draw dims
+        [locs2, scores2],
+        TensorsConfig(TensorsInfo.from_strings(
+            f"4:{n_anchors}:1,6:{n_anchors}:1", "float32,float32"))))
+    hm2 = rng.normal(size=(1, 9, 9, 17)).astype(np.float32)
+    cases.append((
+        "pose_upscale", "pose_estimation", {1: "192:128", 2: "33:33"},
+        [hm2],
+        TensorsConfig(TensorsInfo.from_strings("17:9:9:1", "float32"))))
     return cases
 
 
