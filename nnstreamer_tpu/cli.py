@@ -26,6 +26,8 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print bus messages")
     ap.add_argument("--list-elements", action="store_true")
+    ap.add_argument("--list-models", action="store_true",
+                    help="zoo model names usable as model=zoo://<name>")
     ap.add_argument("--inspect", metavar="ELEMENT",
                     help="describe an element: pads, properties, defaults")
     args = ap.parse_args(argv)
@@ -34,6 +36,12 @@ def main(argv=None) -> int:
         from .graph.element import all_element_names
 
         for n in all_element_names():
+            print(n)
+        return 0
+    if args.list_models:
+        from .models.zoo import model_names
+
+        for n in model_names():
             print(n)
         return 0
     if args.inspect:

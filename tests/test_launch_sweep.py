@@ -158,3 +158,16 @@ def test_hash_in_prop_value_not_a_comment():
     branches = _split_branches("a ! b opt=x#y ! c")
     assert branches[0][1] == ("b", {"opt": "x#y"})
     assert branches[0][2] == ("c", {})
+
+
+def test_list_models_includes_zoo_families():
+    import io
+    from contextlib import redirect_stdout
+
+    out = io.StringIO()
+    with redirect_stdout(out):
+        assert cli_main(["--list-models"]) == 0
+    listing = out.getvalue()
+    for m in ("mobilenet_v1", "mobilenet_v2", "ssd_mobilenet_v2",
+              "deeplab_v3", "posenet", "causal_lm", "moe_transformer"):
+        assert m in listing
