@@ -61,3 +61,72 @@ def test_long_lived_queue_backpressure():
     Pipeline.link(src, q, sink)
     p.run(timeout=120)
     assert seen == list(range(n))
+
+
+def test_adaptive_batch_soak_order_and_count():
+    """1000 frames through batch→filter→unbatch: nothing dropped,
+    nothing reordered, partial tail flushed."""
+    import numpy as np
+
+    from nnstreamer_tpu.core import Caps
+    from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.graph import Pipeline
+
+    n = 1000
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings("4:1", "float32"), 0)),
+        data=(np.full((1, 4), i, np.float32) for i in range(n)))
+    bat = p.add_new("tensor_batch", max_batch=16, budget_ms=50.0)
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model="zoo://scaler?scale=3&dims=4:16&types=float32")
+    unb = p.add_new("tensor_unbatch")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, bat, filt, unb, sink)
+    p.run(timeout=300)
+    assert sink.num_buffers == n
+    vals = [int(b.memories[0].host()[0, 0]) for b in sink.buffers]
+    assert vals == [3 * i for i in range(n)]
+
+
+def test_pipelined_offload_soak():
+    """500 frames through the pipelined query path: complete and in order."""
+    import socket
+    import time
+
+    import numpy as np
+
+    from nnstreamer_tpu.core import Caps
+    from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+    from nnstreamer_tpu.graph import Pipeline
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    sp = Pipeline("server")
+    ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                      port=port, id=9, dims="4:1", types="float32")
+    filt = sp.add_new("tensor_filter", model=lambda x: x + 1)
+    ssink = sp.add_new("tensor_query_serversink", id=9, async_depth=32)
+    Pipeline.link(ssrc, filt, ssink)
+    sp.start()
+    try:
+        time.sleep(0.2)
+        n = 500
+        cp = Pipeline("client")
+        src = cp.add_new("appsrc", caps=Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("4:1", "float32"), 0)),
+            data=(np.full((1, 4), i, np.float32) for i in range(n)))
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1",
+                        port=port, async_depth=32)
+        sink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(src, qc, sink)
+        cp.run(timeout=300)
+        assert sink.num_buffers == n
+        for i in (0, n // 2, n - 1):
+            np.testing.assert_array_equal(
+                sink.buffers[i].memories[0].host(),
+                np.full((1, 4), i + 1, np.float32))
+    finally:
+        sp.stop()
