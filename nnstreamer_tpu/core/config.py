@@ -37,6 +37,7 @@ DEFAULT_FRAMEWORK_PRIORITY: Dict[str, List[str]] = {
     ".jax": ["xla-tpu"],
     ".stablehlo": ["xla-tpu"],
     ".mlir": ["xla-tpu"],
+    ".tflite": ["xla-tpu"],
     ".msgpack": ["xla-tpu"],
     ".ckpt": ["xla-tpu"],
     ".orbax": ["xla-tpu"],

@@ -89,6 +89,10 @@ def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> Model
             return get_model(model, **options)  # options pre-stripped
         if model.endswith(".py"):
             return _bundle_from_pyfile(model, options)
+        if model.lower().endswith(".tflite"):
+            from ..models.tflite_import import load_tflite
+
+            return load_tflite(model)
         if model.lower().endswith(deploy.EXPORT_EXTS):
             return deploy.load_exported(model)
         if model.lower().endswith(deploy.CKPT_EXTS) or os.path.isdir(model):
@@ -160,7 +164,13 @@ class XLAFilter(FilterFramework):
     """framework=xla-tpu (aliases: xla, jax)."""
 
     NAME = "xla-tpu"
-    ALIASES = ("xla", "jax")
+    #: "tensorflow-lite"/"tensorflow2-lite"/"tensorflow1-lite" are accepted
+    #: so reference pipeline strings (framework=tensorflow-lite
+    #: model=foo.tflite) run unmodified — the .tflite flatbuffer is imported
+    #: and compiled by XLA (models/tflite_import.py) instead of the TFLite
+    #: Interpreter (tensor_filter_tensorflow_lite.cc:154)
+    ALIASES = ("xla", "jax", "tensorflow-lite", "tensorflow2-lite",
+               "tensorflow1-lite", "tflite")
     ALLOCATE_IN_INVOKE = True
 
     def __init__(self) -> None:

@@ -1,0 +1,819 @@
+"""TFLite model importer: serve the reference's ``.tflite`` files natively.
+
+The reference's flagship model format is an opaque ``.tflite`` flatbuffer
+served through the TFLite Interpreter (``tensor_filter_tensorflow_lite.cc:154``
+class TFLiteInterpreter; its golden pipelines pass
+``model=mobilenet_v2_1.0_224_quant.tflite`` etc.).  There is no TFLite
+runtime in this stack — and running an interpreter would be the wrong design
+for TPU anyway.  Instead this module:
+
+1. parses the flatbuffer **directly** (a ~150-line generic reader over the
+   wire format — same skill as ``converters/fb_io.py``, applied to the
+   public TFLite schema, field ids documented inline), and
+2. **lowers the op graph to one JAX function** compiled by XLA, with
+   weights exposed as a params pytree (hot-reload / donation friendly).
+
+Quantized (uint8) models execute in *dequantized float*: weights are
+dequantized at load time (per-tensor or per-channel ``scale``/``zero_point``),
+the input is dequantized inside the XLA program, and outputs are requantized
+to the model's stated uint8 contract — so the pipeline sees exactly the
+reference caps (e.g. in uint8 3:224:224:1, out uint8 1001:1) while the MXU
+runs large float convolutions.  This intentionally trades tflite's bit-exact
+integer requantization for XLA-fusable float math; classification/seg
+results match (golden: the reference's orange.png classifies to "orange",
+``tests/test_tflite_import.py``).
+
+Op coverage targets the reference's shipped models
+(``mobilenet_v2_1.0_224_quant.tflite``, ``deeplabv3_257_mv_gpu.tflite``,
+``add.tflite``) plus the common mobile-vision subset around them.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.log import logger
+from ..core.types import TensorInfo, TensorsInfo
+from .zoo import ModelBundle
+
+log = logger("tflite")
+
+# --------------------------------------------------------------------------- #
+# Generic flatbuffer reader (little-endian wire format, flatbuffers.md spec)
+# --------------------------------------------------------------------------- #
+
+
+class _FB:
+    """Minimal flatbuffer accessor: tables, vtables, scalars, vectors,
+    strings. Positions are absolute byte offsets into ``buf``."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
+
+    # scalar readers
+    def u8(self, p): return self.buf[p]
+    def i8(self, p): return struct.unpack_from("<b", self.buf, p)[0]
+    def u16(self, p): return struct.unpack_from("<H", self.buf, p)[0]
+    def i32(self, p): return struct.unpack_from("<i", self.buf, p)[0]
+    def u32(self, p): return struct.unpack_from("<I", self.buf, p)[0]
+    def i64(self, p): return struct.unpack_from("<q", self.buf, p)[0]
+    def f32(self, p): return struct.unpack_from("<f", self.buf, p)[0]
+
+    def root(self) -> int:
+        """Root table position (file identifier, if any, is skipped)."""
+        return self.u32(0)
+
+    def indirect(self, p: int) -> int:
+        return p + self.u32(p)
+
+    def field(self, table: int, fid: int) -> int:
+        """Byte offset of field ``fid`` within ``table``, or 0 if absent
+        (vtable lookup: soffset at table start points BACK to the vtable;
+        slot for field id N sits at vtable + 4 + 2N)."""
+        vtable = table - self.i32(table)
+        vsize = self.u16(vtable)
+        slot = 4 + 2 * fid
+        if slot >= vsize:
+            return 0
+        off = self.u16(vtable + slot)
+        return table + off if off else 0
+
+    def scalar(self, table: int, fid: int, reader: Callable[[int], Any],
+               default: Any) -> Any:
+        p = self.field(table, fid)
+        return reader(p) if p else default
+
+    def offset(self, table: int, fid: int) -> Optional[int]:
+        """Position of an offset-typed field's target (string/vector/table)."""
+        p = self.field(table, fid)
+        return self.indirect(p) if p else None
+
+    def string(self, table: int, fid: int) -> Optional[str]:
+        p = self.offset(table, fid)
+        if p is None:
+            return None
+        n = self.u32(p)
+        return self.buf[p + 4:p + 4 + n].decode("utf-8", "replace")
+
+    def vector(self, table: int, fid: int) -> Optional[Tuple[int, int]]:
+        """(element count, position of first element) or None."""
+        p = self.offset(table, fid)
+        if p is None:
+            return None
+        return self.u32(p), p + 4
+
+    def vec_np(self, table: int, fid: int, dtype: str) -> Optional[np.ndarray]:
+        v = self.vector(table, fid)
+        if v is None:
+            return None
+        n, p = v
+        return np.frombuffer(self.buf, dtype=dtype, count=n, offset=p).copy()
+
+    def vec_tables(self, table: int, fid: int) -> List[int]:
+        """Positions of tables in a vector-of-tables field."""
+        v = self.vector(table, fid)
+        if v is None:
+            return []
+        n, p = v
+        return [self.indirect(p + 4 * i) for i in range(n)]
+
+
+# --------------------------------------------------------------------------- #
+# TFLite schema walk (field ids per the public tensorflow/lite schema.fbs)
+# --------------------------------------------------------------------------- #
+
+#: schema TensorType enum → numpy dtype
+_TENSORTYPE_NP = {
+    0: np.float32, 1: np.float16, 2: np.int32, 3: np.uint8, 4: np.int64,
+    6: np.bool_, 7: np.int16, 9: np.int8, 10: np.float64,
+    16: np.uint32, 17: np.uint16,
+}
+
+#: deprecated_builtin_code → op name (subset; stable public enum)
+_BUILTIN_OPS = {
+    0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
+    4: "DEPTHWISE_CONV_2D", 5: "DEPTH_TO_SPACE", 6: "DEQUANTIZE",
+    9: "FULLY_CONNECTED", 14: "LOGISTIC", 17: "MAX_POOL_2D", 18: "MUL",
+    19: "RELU", 21: "RELU6", 22: "RESHAPE", 23: "RESIZE_BILINEAR",
+    25: "SOFTMAX", 26: "SPACE_TO_DEPTH", 28: "TANH", 32: "CUSTOM",
+    34: "PAD", 36: "GATHER", 39: "TRANSPOSE", 40: "MEAN", 41: "SUB",
+    42: "DIV", 43: "SQUEEZE", 45: "STRIDED_SLICE", 47: "EXP",
+    49: "SPLIT", 53: "CAST", 54: "PRELU", 55: "MAXIMUM", 56: "ARG_MAX",
+    57: "MINIMUM", 60: "PAD_V2", 65: "SLICE", 67: "TRANSPOSE_CONV",
+    70: "EXPAND_DIMS", 74: "SUM", 75: "SQRT", 76: "RSQRT", 77: "SHAPE",
+    78: "POW", 79: "ARG_MIN", 83: "PACK", 88: "UNPACK", 97: "RESIZE_NEAREST",
+    98: "LEAKY_RELU", 101: "ABS", 114: "QUANTIZE", 117: "HARD_SWISH",
+}
+
+_ACT_NONE, _ACT_RELU, _ACT_RELU_N1, _ACT_RELU6, _ACT_TANH = 0, 1, 2, 3, 4
+
+
+@dataclass
+class QuantParams:
+    """Per-tensor (or per-channel along ``axis``) affine quantization:
+    real = scale * (q - zero_point)."""
+
+    scale: np.ndarray          # shape () or (C,)
+    zero_point: np.ndarray     # same shape, int64
+    axis: int = 0              # quantized_dimension for per-channel
+
+    @property
+    def per_channel(self) -> bool:
+        return self.scale.ndim > 0 and self.scale.size > 1
+
+
+@dataclass
+class TFLTensor:
+    index: int
+    name: str
+    shape: Tuple[int, ...]
+    np_dtype: Any
+    buffer_index: int
+    quant: Optional[QuantParams]
+    data: Optional[np.ndarray] = None   # constant payload (typed, undequantized)
+
+
+@dataclass
+class TFLOperator:
+    op: str                              # name from _BUILTIN_OPS / custom code
+    inputs: List[int]                    # tensor indices (-1 = absent optional)
+    outputs: List[int]
+    options: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TFLModel:
+    path: str
+    version: int
+    description: str
+    tensors: List[TFLTensor]
+    operators: List[TFLOperator]
+    inputs: List[int]
+    outputs: List[int]
+
+
+def _parse_quant(fb: _FB, qpos: Optional[int]) -> Optional[QuantParams]:
+    # QuantizationParameters: 0 min, 1 max, 2 scale[f32], 3 zero_point[i64],
+    # 4 details(union: ids 4+5), 6 quantized_dimension
+    if qpos is None:
+        return None
+    scale = fb.vec_np(qpos, 2, "<f4")
+    if scale is None or scale.size == 0:
+        return None
+    zp = fb.vec_np(qpos, 3, "<i8")
+    if zp is None or zp.size == 0:
+        zp = np.zeros_like(scale, dtype=np.int64)
+    axis = fb.scalar(qpos, 6, fb.i32, 0)
+    if scale.size == 1:
+        scale, zp = scale.reshape(()), zp.reshape(())
+    return QuantParams(scale, zp, axis)
+
+
+def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
+    """Builtin options table → dict, dispatched on the op (the union type
+    field is redundant with the opcode for the supported subset)."""
+    o: Dict[str, Any] = {}
+    if opos is None:
+        return o
+    if op in ("CONV_2D", "TRANSPOSE_CONV"):
+        # Conv2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 activation,
+        # 4 dilation_w, 5 dilation_h  (TransposeConvOptions: 0-2 same slots)
+        o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
+        o["dilation_w"] = fb.scalar(opos, 4, fb.i32, 1)
+        o["dilation_h"] = fb.scalar(opos, 5, fb.i32, 1)
+    elif op == "DEPTHWISE_CONV_2D":
+        # DepthwiseConv2DOptions: 0 padding, 1 stride_w, 2 stride_h,
+        # 3 depth_multiplier, 4 activation, 5 dilation_w, 6 dilation_h
+        o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["depth_multiplier"] = fb.scalar(opos, 3, fb.i32, 1)
+        o["activation"] = fb.scalar(opos, 4, fb.i8, 0)
+        o["dilation_w"] = fb.scalar(opos, 5, fb.i32, 1)
+        o["dilation_h"] = fb.scalar(opos, 6, fb.i32, 1)
+    elif op in ("AVERAGE_POOL_2D", "MAX_POOL_2D"):
+        # Pool2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 filter_width,
+        # 4 filter_height, 5 activation
+        o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["filter_w"] = fb.scalar(opos, 3, fb.i32, 1)
+        o["filter_h"] = fb.scalar(opos, 4, fb.i32, 1)
+        o["activation"] = fb.scalar(opos, 5, fb.i8, 0)
+    elif op == "SOFTMAX":
+        o["beta"] = fb.scalar(opos, 0, fb.f32, 1.0)
+    elif op == "CONCATENATION":
+        o["axis"] = fb.scalar(opos, 0, fb.i32, 0)
+        o["activation"] = fb.scalar(opos, 1, fb.i8, 0)
+    elif op in ("ADD", "MUL", "SUB", "DIV"):
+        o["activation"] = fb.scalar(opos, 0, fb.i8, 0)
+    elif op == "RESHAPE":
+        ns = fb.vec_np(opos, 0, "<i4")
+        if ns is not None:
+            o["new_shape"] = [int(x) for x in ns]
+    elif op == "RESIZE_BILINEAR":
+        # ResizeBilinearOptions: 0/1 deprecated new_h/new_w,
+        # 2 align_corners, 3 half_pixel_centers
+        o["align_corners"] = bool(fb.scalar(opos, 2, fb.u8, 0))
+        o["half_pixel_centers"] = bool(fb.scalar(opos, 3, fb.u8, 0))
+    elif op == "RESIZE_NEAREST":
+        # ResizeNearestNeighborOptions: 0 align_corners, 1 half_pixel_centers
+        o["align_corners"] = bool(fb.scalar(opos, 0, fb.u8, 0))
+        o["half_pixel_centers"] = bool(fb.scalar(opos, 1, fb.u8, 0))
+    elif op == "FULLY_CONNECTED":
+        o["activation"] = fb.scalar(opos, 0, fb.i8, 0)
+        o["keep_num_dims"] = bool(fb.scalar(opos, 2, fb.u8, 0))
+    elif op in ("MEAN", "SUM"):
+        o["keep_dims"] = bool(fb.scalar(opos, 0, fb.u8, 0))
+    elif op in ("ARG_MAX", "ARG_MIN"):
+        o["output_type"] = fb.scalar(opos, 0, fb.i8, 2)  # TensorType enum
+    elif op == "SQUEEZE":
+        sq = fb.vec_np(opos, 0, "<i4")
+        o["squeeze_dims"] = [] if sq is None else [int(x) for x in sq]
+    elif op == "STRIDED_SLICE":
+        for i, k in enumerate(("begin_mask", "end_mask", "ellipsis_mask",
+                               "new_axis_mask", "shrink_axis_mask")):
+            o[k] = fb.scalar(opos, i, fb.i32, 0)
+    elif op == "SPLIT":
+        o["num_splits"] = fb.scalar(opos, 0, fb.i32, 0)
+    elif op == "LEAKY_RELU":
+        o["alpha"] = fb.scalar(opos, 0, fb.f32, 0.0)
+    elif op in ("DEPTH_TO_SPACE", "SPACE_TO_DEPTH"):
+        o["block_size"] = fb.scalar(opos, 0, fb.i32, 1)
+    elif op == "CAST":
+        # CastOptions: 0 in_data_type, 1 out_data_type; the table is
+        # commonly omitted (dtype inferable from the output tensor) —
+        # keep None in that case so the evaluator falls back correctly
+        p = fb.field(opos, 1)
+        if p:
+            o["out_type"] = fb.i8(p)
+    elif op == "PACK":
+        # PackOptions: 0 values_count, 1 axis
+        o["axis"] = fb.scalar(opos, 1, fb.i32, 0)
+    return o
+
+
+def parse_tflite(path: str) -> TFLModel:
+    """Parse a .tflite flatbuffer (single-subgraph) into a TFLModel."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < 8:
+        raise ValueError(f"{path}: not a tflite flatbuffer (too small)")
+    ident = buf[4:8]
+    if ident not in (b"TFL3", b"TFL2", b"TFL1"):
+        raise ValueError(f"{path}: missing TFL3 file identifier "
+                         f"(got {ident!r})")
+    fb = _FB(buf)
+    # Model: 0 version, 1 operator_codes, 2 subgraphs, 3 description,
+    # 4 buffers, 5 metadata_buffer, 6 metadata, 7 signature_defs
+    model = fb.root()
+    version = fb.scalar(model, 0, fb.u32, 0)
+    desc = fb.string(model, 3) or ""
+
+    # operator codes → names
+    op_names: List[str] = []
+    for oc in fb.vec_tables(model, 1):
+        # OperatorCode: 0 deprecated_builtin_code(i8), 1 custom_code,
+        # 2 version, 3 builtin_code(i32, post-2020 codes >127)
+        code = fb.scalar(oc, 3, fb.i32, 0) or fb.scalar(oc, 0, fb.i8, 0)
+        if code == 32:  # CUSTOM
+            op_names.append("CUSTOM:" + (fb.string(oc, 1) or "?"))
+        else:
+            op_names.append(_BUILTIN_OPS.get(code, f"UNKNOWN_{code}"))
+
+    # buffers (0 data:[ubyte])
+    buffers: List[Optional[Tuple[int, int]]] = []
+    for b in fb.vec_tables(model, 4):
+        buffers.append(fb.vector(b, 0))  # (nbytes, pos) or None
+
+    subgraphs = fb.vec_tables(model, 2)
+    if len(subgraphs) != 1:
+        raise ValueError(f"{path}: {len(subgraphs)} subgraphs; only "
+                         "single-subgraph models are supported")
+    sg = subgraphs[0]
+    # SubGraph: 0 tensors, 1 inputs, 2 outputs, 3 operators, 4 name
+    tensors: List[TFLTensor] = []
+    for i, t in enumerate(fb.vec_tables(sg, 0)):
+        # Tensor: 0 shape[i32], 1 type(i8), 2 buffer(u32), 3 name,
+        # 4 quantization, 5 is_variable, 6 sparsity, 7 shape_signature
+        shape_v = fb.vec_np(t, 0, "<i4")
+        shape = tuple(int(d) for d in shape_v) if shape_v is not None else ()
+        ttype = fb.scalar(t, 1, fb.i8, 0)
+        np_dtype = _TENSORTYPE_NP.get(ttype)
+        if np_dtype is None:
+            raise ValueError(f"{path}: tensor {i} has unsupported "
+                             f"TensorType {ttype}")
+        bufidx = fb.scalar(t, 2, fb.u32, 0)
+        quant = _parse_quant(fb, fb.offset(t, 4))
+        data = None
+        if 0 < bufidx < len(buffers) and buffers[bufidx] is not None:
+            nbytes, pos = buffers[bufidx]
+            if nbytes:
+                flat = np.frombuffer(
+                    buf, dtype=np.dtype(np_dtype),
+                    count=nbytes // np.dtype(np_dtype).itemsize, offset=pos)
+                data = flat.reshape(shape if shape else (-1,)).copy()
+        tensors.append(TFLTensor(i, fb.string(t, 3) or f"t{i}", shape,
+                                 np_dtype, bufidx, quant, data))
+
+    operators: List[TFLOperator] = []
+    for opr in fb.vec_tables(sg, 3):
+        # Operator: 0 opcode_index, 1 inputs[i32], 2 outputs[i32],
+        # 3 builtin_options_type(u8), 4 builtin_options(table),
+        # 5 custom_options[ubyte]
+        idx = fb.scalar(opr, 0, fb.u32, 0)
+        name = op_names[idx] if idx < len(op_names) else f"BADCODE_{idx}"
+        ins = fb.vec_np(opr, 1, "<i4")
+        outs = fb.vec_np(opr, 2, "<i4")
+        options = _parse_options(fb, name, fb.offset(opr, 4))
+        operators.append(TFLOperator(
+            name, [int(x) for x in (ins if ins is not None else [])],
+            [int(x) for x in (outs if outs is not None else [])], options))
+
+    inputs_v = fb.vec_np(sg, 1, "<i4")
+    outputs_v = fb.vec_np(sg, 2, "<i4")
+    return TFLModel(
+        path, version, desc, tensors, operators,
+        [int(x) for x in (inputs_v if inputs_v is not None else [])],
+        [int(x) for x in (outputs_v if outputs_v is not None else [])])
+
+
+# --------------------------------------------------------------------------- #
+# Lowering: TFLite op graph → one JAX function
+# --------------------------------------------------------------------------- #
+
+
+def _dequant_const(t: TFLTensor) -> np.ndarray:
+    """Constant tensor → float32 (weights/bias of quantized models are
+    dequantized once at load; float constants pass through)."""
+    a = t.data
+    assert a is not None
+    if np.issubdtype(a.dtype, np.floating):
+        return a.astype(np.float32)
+    if t.quant is None:
+        return a  # integer constant used as shape/axes — keep typed
+    q = t.quant
+    if q.per_channel:
+        # broadcast scale along quantized_dimension
+        bshape = [1] * a.ndim
+        bshape[q.axis] = q.scale.size
+        scale = q.scale.reshape(bshape)
+        zp = q.zero_point.reshape(bshape)
+    else:
+        scale, zp = q.scale, q.zero_point
+    return ((a.astype(np.float32) - zp.astype(np.float32))
+            * scale.astype(np.float32))
+
+
+def _fused_act(x, code: int):
+    import jax.numpy as jnp
+
+    if code == _ACT_NONE:
+        return x
+    if code == _ACT_RELU:
+        return jnp.maximum(x, 0.0)
+    if code == _ACT_RELU_N1:
+        return jnp.clip(x, -1.0, 1.0)
+    if code == _ACT_RELU6:
+        return jnp.clip(x, 0.0, 6.0)
+    if code == _ACT_TANH:
+        return jnp.tanh(x)
+    raise ValueError(f"unsupported fused activation {code}")
+
+
+_PAD_MODES = {0: "SAME", 1: "VALID"}
+
+
+def _resize_bilinear(x, out_h: int, out_w: int, align_corners: bool,
+                     half_pixel: bool):
+    """Gather-based bilinear resize matching TFLite's coordinate
+    conventions (align_corners / half_pixel_centers), NHWC."""
+    import jax.numpy as jnp
+
+    n, h, w, c = x.shape
+    if align_corners and out_h > 1:
+        ys = jnp.arange(out_h, dtype=jnp.float32) * ((h - 1) / (out_h - 1))
+    elif half_pixel:
+        ys = (jnp.arange(out_h, dtype=jnp.float32) + 0.5) * (h / out_h) - 0.5
+    else:
+        ys = jnp.arange(out_h, dtype=jnp.float32) * (h / out_h)
+    if align_corners and out_w > 1:
+        xs = jnp.arange(out_w, dtype=jnp.float32) * ((w - 1) / (out_w - 1))
+    elif half_pixel:
+        xs = (jnp.arange(out_w, dtype=jnp.float32) + 0.5) * (w / out_w) - 0.5
+    else:
+        xs = jnp.arange(out_w, dtype=jnp.float32) * (w / out_w)
+    ys = jnp.clip(ys, 0.0, h - 1)
+    xs = jnp.clip(xs, 0.0, w - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    a = x[:, y0][:, :, x0]
+    b = x[:, y0][:, :, x1]
+    cc = x[:, y1][:, :, x0]
+    d = x[:, y1][:, :, x1]
+    return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+            + cc * wy * (1 - wx) + d * wy * wx)
+
+
+def _avg_pool_same_countvalid(x, fh, fw, sh, sw):
+    """AVERAGE_POOL_2D with SAME padding counts only in-bounds elements
+    (TFLite semantics); implemented as sum-pool / ones-pool."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    ones = jnp.ones(x.shape[:1] + x.shape[1:3] + (1,), x.dtype)
+    dims = (1, fh, fw, 1)
+    strides = (1, sh, sw, 1)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, "SAME")
+    n = lax.reduce_window(ones, 0.0, lax.add, dims, strides, "SAME")
+    return s / n
+
+
+class _Lowerer:
+    """Per-model lowering state: maps tensor index → traced value."""
+
+    def __init__(self, m: TFLModel):
+        self.m = m
+        self.params: Dict[str, np.ndarray] = {}
+        self.const_idx: set = set()
+        for t in m.tensors:
+            if t.data is not None:
+                self.params[f"t{t.index}"] = _dequant_const(t)
+                self.const_idx.add(t.index)
+                t.data = None  # raw payload no longer needed; the params
+                # copy is the only one that must outlive the load
+
+    # -- graph evaluation --------------------------------------------------- #
+    def build_apply(self) -> Callable:
+        m = self.m
+        const_idx = self.const_idx
+
+        def apply(params, *inputs):
+            import jax.numpy as jnp
+
+            env: Dict[int, Any] = {}
+            for idx in const_idx:
+                env[idx] = params[f"t{idx}"]
+            if len(inputs) != len(m.inputs):
+                raise ValueError(
+                    f"{os.path.basename(m.path)}: expected "
+                    f"{len(m.inputs)} inputs, got {len(inputs)}")
+            for idx, x in zip(m.inputs, inputs):
+                t = m.tensors[idx]
+                x = jnp.asarray(x)
+                if x.shape != t.shape and int(np.prod(x.shape)) == int(
+                        np.prod(t.shape)):
+                    x = x.reshape(t.shape)
+                if t.quant is not None and not np.issubdtype(
+                        np.dtype(t.np_dtype), np.floating):
+                    x = (x.astype(jnp.float32)
+                         - np.float32(t.quant.zero_point)) \
+                        * np.float32(t.quant.scale)
+                env[idx] = x
+            for op in m.operators:
+                self._eval_op(op, env)
+            outs = []
+            for idx in m.outputs:
+                t = m.tensors[idx]
+                y = env[idx]
+                if t.quant is not None and not np.issubdtype(
+                        np.dtype(t.np_dtype), np.floating):
+                    q = jnp.round(y / np.float32(t.quant.scale)
+                                  + np.float32(t.quant.zero_point))
+                    info = np.iinfo(t.np_dtype)
+                    y = jnp.clip(q, info.min, info.max).astype(t.np_dtype)
+                outs.append(y)
+            return tuple(outs)
+
+        return apply
+
+    def _eval_op(self, op: TFLOperator, env: Dict[int, Any]) -> None:
+        import jax.numpy as jnp
+        from jax import lax
+
+        o = op.options
+        get = lambda i: env[op.inputs[i]] if (  # noqa: E731
+            i < len(op.inputs) and op.inputs[i] >= 0) else None
+
+        name = op.op
+        if name == "CONV_2D":
+            x, w, b = get(0), get(1), get(2)
+            # tflite kernel is OHWI → HWIO for lax
+            w = jnp.transpose(w, (1, 2, 3, 0))
+            y = lax.conv_general_dilated(
+                x, w, (o["stride_h"], o["stride_w"]),
+                _PAD_MODES[o["padding"]],
+                rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if b is not None:
+                y = y + b
+            y = _fused_act(y, o["activation"])
+        elif name == "DEPTHWISE_CONV_2D":
+            x, w, b = get(0), get(1), get(2)
+            # tflite dw kernel is (1, H, W, in*mult) → HWIO w/ I=1
+            cin = x.shape[-1]
+            w = jnp.transpose(w, (1, 2, 0, 3))  # H W 1 C
+            y = lax.conv_general_dilated(
+                x, w, (o["stride_h"], o["stride_w"]),
+                _PAD_MODES[o["padding"]],
+                rhs_dilation=(o["dilation_h"], o["dilation_w"]),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=cin)
+            if b is not None:
+                y = y + b
+            y = _fused_act(y, o["activation"])
+        elif name == "AVERAGE_POOL_2D":
+            x = get(0)
+            if _PAD_MODES[o["padding"]] == "SAME":
+                y = _avg_pool_same_countvalid(
+                    x, o["filter_h"], o["filter_w"],
+                    o["stride_h"], o["stride_w"])
+            else:
+                y = lax.reduce_window(
+                    x, 0.0, lax.add, (1, o["filter_h"], o["filter_w"], 1),
+                    (1, o["stride_h"], o["stride_w"], 1), "VALID") \
+                    / (o["filter_h"] * o["filter_w"])
+            y = _fused_act(y, o["activation"])
+        elif name == "MAX_POOL_2D":
+            x = get(0)
+            y = lax.reduce_window(
+                x, -np.inf, lax.max, (1, o["filter_h"], o["filter_w"], 1),
+                (1, o["stride_h"], o["stride_w"], 1),
+                _PAD_MODES[o["padding"]])
+            y = _fused_act(y, o["activation"])
+        elif name in ("ADD", "MUL", "SUB", "DIV"):
+            a, b = get(0), get(1)
+            fn = {"ADD": jnp.add, "MUL": jnp.multiply,
+                  "SUB": jnp.subtract, "DIV": jnp.divide}[name]
+            y = _fused_act(fn(a, b), o.get("activation", 0))
+        elif name in ("MAXIMUM", "MINIMUM"):
+            y = (jnp.maximum if name == "MAXIMUM" else jnp.minimum)(
+                get(0), get(1))
+        elif name == "CONCATENATION":
+            parts = [env[i] for i in op.inputs if i >= 0]
+            y = _fused_act(jnp.concatenate(parts, axis=o["axis"]),
+                           o.get("activation", 0))
+        elif name == "RESHAPE":
+            x = get(0)
+            shape_t = get(1)
+            if shape_t is not None:
+                new_shape = [int(v) for v in np.asarray(shape_t)]
+            else:
+                new_shape = o.get("new_shape") or list(
+                    self.m.tensors[op.outputs[0]].shape)
+            y = x.reshape(new_shape)
+        elif name == "SQUEEZE":
+            x = get(0)
+            dims = o.get("squeeze_dims") or [
+                i for i, d in enumerate(x.shape) if d == 1]
+            y = x.reshape([d for i, d in enumerate(x.shape) if i not in
+                           {d % x.ndim for d in dims}])
+        elif name == "EXPAND_DIMS":
+            x, ax = get(0), int(np.asarray(get(1)).reshape(()))
+            y = jnp.expand_dims(x, ax)
+        elif name == "SOFTMAX":
+            import jax
+
+            y = jax.nn.softmax(get(0) * np.float32(o.get("beta", 1.0)),
+                               axis=-1)
+        elif name == "LOGISTIC":
+            import jax
+
+            y = jax.nn.sigmoid(get(0))
+        elif name == "TANH":
+            y = jnp.tanh(get(0))
+        elif name == "RELU":
+            y = jnp.maximum(get(0), 0.0)
+        elif name == "RELU6":
+            y = jnp.clip(get(0), 0.0, 6.0)
+        elif name == "PRELU":
+            x, alpha = get(0), get(1)
+            y = jnp.where(x >= 0, x, x * alpha)
+        elif name == "LEAKY_RELU":
+            x = get(0)
+            y = jnp.where(x >= 0, x, x * np.float32(o.get("alpha", 0.0)))
+        elif name == "HARD_SWISH":
+            x = get(0)
+            y = x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+        elif name == "RESIZE_BILINEAR":
+            x = get(0)
+            size = np.asarray(get(1)).reshape(-1)
+            y = _resize_bilinear(x, int(size[0]), int(size[1]),
+                                 o.get("align_corners", False),
+                                 o.get("half_pixel_centers", False))
+        elif name == "RESIZE_NEAREST":
+            x = get(0)
+            size = np.asarray(get(1)).reshape(-1)
+            oh, ow = int(size[0]), int(size[1])
+            h, w = x.shape[1], x.shape[2]
+            if o.get("half_pixel_centers"):
+                iy = jnp.floor((jnp.arange(oh) + 0.5) * (h / oh))
+                ix = jnp.floor((jnp.arange(ow) + 0.5) * (w / ow))
+            elif o.get("align_corners") and oh > 1 and ow > 1:
+                iy = jnp.round(jnp.arange(oh) * ((h - 1) / (oh - 1)))
+                ix = jnp.round(jnp.arange(ow) * ((w - 1) / (ow - 1)))
+            else:
+                iy = (jnp.arange(oh) * h) // oh
+                ix = (jnp.arange(ow) * w) // ow
+            iy = jnp.clip(iy.astype(jnp.int32), 0, h - 1)
+            ix = jnp.clip(ix.astype(jnp.int32), 0, w - 1)
+            y = x[:, iy][:, :, ix]
+        elif name in ("MEAN", "SUM"):
+            x = get(0)
+            axes = tuple(int(a) for a in np.asarray(get(1)).reshape(-1))
+            red = jnp.mean if name == "MEAN" else jnp.sum
+            y = red(x, axis=axes, keepdims=o.get("keep_dims", False))
+        elif name in ("ARG_MAX", "ARG_MIN"):
+            x = get(0)
+            ax = int(np.asarray(get(1)).reshape(()))
+            fn = jnp.argmax if name == "ARG_MAX" else jnp.argmin
+            out_np = _TENSORTYPE_NP.get(o.get("output_type", 2), np.int32)
+            y = fn(x, axis=ax).astype(out_np)
+        elif name in ("PAD", "PAD_V2"):
+            x, p = get(0), np.asarray(get(1))
+            cval = 0.0
+            if name == "PAD_V2" and get(2) is not None:
+                cval = float(np.asarray(get(2)).reshape(()))
+            y = jnp.pad(x, [(int(a), int(b)) for a, b in p],
+                        constant_values=cval)
+        elif name == "TRANSPOSE":
+            x, perm = get(0), np.asarray(get(1)).reshape(-1)
+            y = jnp.transpose(x, tuple(int(v) for v in perm))
+        elif name == "FULLY_CONNECTED":
+            x, w, b = get(0), get(1), get(2)
+            x2 = x.reshape((-1, w.shape[-1])) if not o.get("keep_num_dims") \
+                else x
+            y = x2 @ w.T
+            if b is not None:
+                y = y + b
+            y = _fused_act(y, o["activation"])
+        elif name == "CAST":
+            x = get(0)
+            out_t = o.get("out_type")
+            y = x.astype(self.m.tensors[op.outputs[0]].np_dtype
+                         if out_t is None
+                         else _TENSORTYPE_NP.get(out_t, np.float32))
+        elif name in ("DEQUANTIZE", "QUANTIZE"):
+            # whole graph already runs dequantized float; both are identity
+            # up to the requantize applied at graph outputs
+            y = get(0)
+        elif name == "SPACE_TO_DEPTH":
+            x = get(0)
+            bs = o["block_size"]
+            n, h, w, c = x.shape
+            y = x.reshape(n, h // bs, bs, w // bs, bs, c) \
+                 .transpose(0, 1, 3, 2, 4, 5) \
+                 .reshape(n, h // bs, w // bs, c * bs * bs)
+        elif name == "DEPTH_TO_SPACE":
+            x = get(0)
+            bs = o["block_size"]
+            n, h, w, c = x.shape
+            y = x.reshape(n, h, w, bs, bs, c // (bs * bs)) \
+                 .transpose(0, 1, 3, 2, 4, 5) \
+                 .reshape(n, h * bs, w * bs, c // (bs * bs))
+        elif name == "SHAPE":
+            y = jnp.asarray(env[op.inputs[0]].shape, np.int32)
+        elif name in ("SQRT", "RSQRT", "EXP", "ABS", "POW"):
+            x = get(0)
+            y = {"SQRT": jnp.sqrt, "RSQRT": lambda v: 1.0 / jnp.sqrt(v),
+                 "EXP": jnp.exp, "ABS": jnp.abs}.get(name, None)
+            y = y(x) if y is not None else jnp.power(x, get(1))
+        elif name == "SLICE":
+            x = get(0)
+            begin = np.asarray(get(1)).reshape(-1)
+            size = np.asarray(get(2)).reshape(-1)
+            idx = tuple(slice(int(b), x.shape[i] if int(s) == -1
+                              else int(b) + int(s))
+                        for i, (b, s) in enumerate(zip(begin, size)))
+            y = x[idx]
+        elif name == "PACK":
+            y = jnp.stack([env[i] for i in op.inputs], axis=o.get("axis", 0))
+        else:
+            raise NotImplementedError(
+                f"{os.path.basename(self.m.path)}: TFLite op {name!r} is "
+                "not in the supported lowering subset")
+        outs = op.outputs
+        env[outs[0]] = self._fake_quant(outs[0], y)
+        if len(outs) > 1:
+            raise NotImplementedError(f"multi-output op {name}")
+
+    def _fake_quant(self, tensor_idx: int, y):
+        """Snap an op result onto its output tensor's quantization grid.
+
+        In a quantized graph the activation clamp is ENCODED IN THE QUANT
+        RANGE (e.g. relu6 = range [0, 6] with zero_point 0), not in the
+        fused_activation_function field — float execution must therefore
+        round-and-clamp every intermediate to its tensor's representable
+        grid or activations blow past their trained ranges and saturate
+        the final requantize. Pure elementwise math; XLA fuses it into the
+        producing op."""
+        import jax.numpy as jnp
+
+        t = self.m.tensors[tensor_idx]
+        if t.quant is None or np.issubdtype(np.dtype(t.np_dtype),
+                                            np.floating):
+            return y
+        if t.quant.per_channel or not np.issubdtype(y.dtype, np.floating):
+            return y  # per-channel activations don't occur in practice
+        info = np.iinfo(t.np_dtype)
+        scale = np.float32(t.quant.scale)
+        zp = np.float32(t.quant.zero_point)
+        q = jnp.clip(jnp.round(y / scale + zp), info.min, info.max)
+        return (q - zp) * scale
+
+
+def jax_softmax(x):
+    import jax.numpy as jnp
+
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+# --------------------------------------------------------------------------- #
+# Public entry: .tflite path → ModelBundle
+# --------------------------------------------------------------------------- #
+
+
+def _tensor_info(t: TFLTensor) -> TensorInfo:
+    shape = t.shape if t.shape else (1,)
+    return TensorInfo.from_shape(shape, np.dtype(t.np_dtype), t.name)
+
+
+def load_tflite(path: str) -> ModelBundle:
+    """``model=foo.tflite`` → ModelBundle (apply + params + I/O info).
+
+    The bundle's I/O contract mirrors the flatbuffer exactly (dims, dtype —
+    incl. uint8 for quantized models), so caps negotiation produces the
+    same ``other/tensor`` caps the reference's tflite subplugin reports
+    via ``getModelInfo`` (tensor_filter_tensorflow_lite.cc)."""
+    m = parse_tflite(path)
+    ops_used = sorted({op.op for op in m.operators})
+    low = _Lowerer(m)
+    apply = low.build_apply()
+    in_info = TensorsInfo(tuple(_tensor_info(m.tensors[i]) for i in m.inputs))
+    out_info = TensorsInfo(tuple(_tensor_info(m.tensors[i])
+                                 for i in m.outputs))
+    log.info("tflite import %s: %d ops (%s), %d params",
+             os.path.basename(path), len(m.operators), ",".join(ops_used),
+             len(low.params))
+    return ModelBundle(
+        os.path.basename(path), apply, params=low.params,
+        in_info=in_info, out_info=out_info,
+        metadata={"deployed_from": path, "format": "tflite",
+                  "tflite_ops": ops_used,
+                  "tflite_version": m.version})
