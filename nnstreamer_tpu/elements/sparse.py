@@ -1,10 +1,12 @@
 """tensor_sparse_enc / tensor_sparse_dec — dense↔sparse stream compression.
 
 Reference: gst/nnstreamer/elements/gsttensor_sparse*.c +
-tensor_sparse_util.c:31-162: COO-style packing (values + uint32 flat indices
-+ nnz in the per-tensor meta) used to cut bandwidth on query/edge links for
-sparse activations. Wire layout here: our 128-byte meta header
-(format=sparse, extra=nnz) followed by uint32 indices then raw values.
+tensor_sparse_util.c:31-162: COO-style packing used to cut bandwidth on
+query/edge links for sparse activations. Wire layout is reference-exact:
+the 128-byte GstTensorMetaInfo header (format=sparse, nnz in the union
+word) followed by the nnz raw VALUES then the nnz uint32 flat indices —
+values-first per gst_tensor_sparse_to_dense's
+``indices = input + element_size * nnz`` (tensor_sparse_util.c:59-61).
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ def sparse_encode(arr: np.ndarray, info: TensorInfo) -> bytes:
 
     nz, values = native.sparse_encode_arrays(arr)
     meta = TensorMetaInfo(info, TensorFormat.SPARSE, extra=int(nz.size))
-    return meta.pack() + nz.tobytes() + values.tobytes()
+    return meta.pack() + values.tobytes() + nz.tobytes()
 
 
 def sparse_decode(blob: bytes) -> Tuple[np.ndarray, TensorInfo]:
@@ -36,9 +38,9 @@ def sparse_decode(blob: bytes) -> Tuple[np.ndarray, TensorInfo]:
     nnz = meta.extra
     info = meta.info
     off = META_SIZE
-    idx = np.frombuffer(blob, np.uint32, count=nnz, offset=off)
-    off += nnz * 4
     values = np.frombuffer(blob, info.dtype.np_dtype, count=nnz, offset=off)
+    off += nnz * info.dtype.itemsize
+    idx = np.frombuffer(blob, np.uint32, count=nnz, offset=off)
     flat = native.sparse_decode_arrays(idx, values, info.num_elements,
                                        info.dtype.np_dtype)
     return flat.reshape(info.shape), info

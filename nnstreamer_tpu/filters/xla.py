@@ -85,7 +85,8 @@ def resolve_model(model: Any, options: Optional[Dict[str, str]] = None) -> Model
         from ..models import deploy
 
         if model.startswith("zoo://") or not os.path.sep in model and not os.path.exists(model) \
-                and not model.endswith(".py") and not deploy.is_deployable_path(model):
+                and not model.endswith((".py", ".tflite")) \
+                and not deploy.is_deployable_path(model):
             return get_model(model, **options)  # options pre-stripped
         if model.endswith(".py"):
             return _bundle_from_pyfile(model, options)

@@ -222,9 +222,9 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     o: Dict[str, Any] = {}
     if opos is None:
         return o
-    if op in ("CONV_2D", "TRANSPOSE_CONV"):
+    if op == "CONV_2D":
         # Conv2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 activation,
-        # 4 dilation_w, 5 dilation_h  (TransposeConvOptions: 0-2 same slots)
+        # 4 dilation_w, 5 dilation_h
         o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
         o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
         o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
@@ -280,12 +280,6 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "SQUEEZE":
         sq = fb.vec_np(opos, 0, "<i4")
         o["squeeze_dims"] = [] if sq is None else [int(x) for x in sq]
-    elif op == "STRIDED_SLICE":
-        for i, k in enumerate(("begin_mask", "end_mask", "ellipsis_mask",
-                               "new_axis_mask", "shrink_axis_mask")):
-            o[k] = fb.scalar(opos, i, fb.i32, 0)
-    elif op == "SPLIT":
-        o["num_splits"] = fb.scalar(opos, 0, fb.i32, 0)
     elif op == "LEAKY_RELU":
         o["alpha"] = fb.scalar(opos, 0, fb.f32, 0.0)
     elif op in ("DEPTH_TO_SPACE", "SPACE_TO_DEPTH"):
@@ -774,14 +768,6 @@ class _Lowerer:
         zp = np.float32(t.quant.zero_point)
         q = jnp.clip(jnp.round(y / scale + zp), info.min, info.max)
         return (q - zp) * scale
-
-
-def jax_softmax(x):
-    import jax.numpy as jnp
-
-    m = jnp.max(x, axis=-1, keepdims=True)
-    e = jnp.exp(x - m)
-    return e / jnp.sum(e, axis=-1, keepdims=True)
 
 
 # --------------------------------------------------------------------------- #

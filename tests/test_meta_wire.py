@@ -1,0 +1,149 @@
+"""Byte-golden fixtures for the flexible/sparse wire formats.
+
+The expected byte strings below are hand-built from the reference's struct
+layout — GstTensorMetaInfo memcpy'd into a 128-byte v1 header
+(tensor_typedef.h:282-297, tensor_common.c:1566-1639) and the sparse
+values-then-indices payload (tensor_sparse_util.c:59-61 ``indices = input +
+element_size * nnz``) — NOT from our own pack(), so a layout regression on
+either side fails the comparison (same method as
+test_mqtt.py::test_layout_offsets_match_reference).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.meta import (
+    META_SIZE,
+    META_VERSION,
+    TensorMetaInfo,
+    unwrap_flex,
+    wrap_flex,
+)
+from nnstreamer_tpu.core.types import TensorDType, TensorFormat, TensorInfo
+from nnstreamer_tpu.elements.sparse import sparse_decode, sparse_encode
+
+
+def _reference_header(type_enum, dims, fmt_enum, media_enum, nnz=0):
+    """Build the 128-byte header exactly as the reference's
+    gst_tensor_meta_info_update_header would: zero-filled buffer,
+    little-endian uint32 words version/type/dimension[16]/format/media/nnz."""
+    buf = bytearray(128)
+    struct.pack_into("<I", buf, 0, 0xDE001000)  # GST_TENSOR_META_VERSION 1.0
+    struct.pack_into("<I", buf, 4, type_enum)
+    for i, d in enumerate(dims):
+        struct.pack_into("<I", buf, 8 + 4 * i, d)
+    struct.pack_into("<I", buf, 8 + 4 * 16, fmt_enum)
+    struct.pack_into("<I", buf, 8 + 4 * 17, media_enum)
+    struct.pack_into("<I", buf, 8 + 4 * 18, nnz)
+    return bytes(buf)
+
+
+def test_version_word_matches_reference_macro():
+    # GST_TENSOR_META_MAKE_VERSION(1,0) = 1<<12 | 0 | 0xDE000000
+    assert META_VERSION == (1 << 12) | 0xDE000000 == 0xDE001000
+
+
+def test_flex_header_bytes_match_reference_layout():
+    # uint8 video frame 3:224:224 (rank 3, innermost-first like [3:224:224:0])
+    info = TensorInfo((3, 224, 224), TensorDType.UINT8)
+    got = TensorMetaInfo(info, TensorFormat.FLEXIBLE, "video/x-raw").pack()
+    want = _reference_header(
+        type_enum=5,             # _NNS_UINT8
+        dims=[3, 224, 224],      # 0-terminated at word 5
+        fmt_enum=1,              # _NNS_TENSOR_FORMAT_FLEXIBLE
+        media_enum=0)            # _NNS_VIDEO
+    assert len(got) == META_SIZE == 128
+    assert got == want
+
+
+def test_flex_header_float32_tensor_media():
+    info = TensorInfo((1001, 1), TensorDType.FLOAT32)
+    got = TensorMetaInfo(info, TensorFormat.FLEXIBLE).pack()
+    want = _reference_header(7, [1001, 1], 1, 4)  # _NNS_FLOAT32, _NNS_TENSOR
+    assert got == want
+
+
+def test_flex_header_parse_roundtrip_reference_bytes():
+    # parse a header built purely from the reference layout
+    raw = _reference_header(2, [16, 8], 1, 2)  # int16, text media
+    meta = TensorMetaInfo.parse(raw)
+    assert meta.info.dims == (16, 8)
+    assert meta.info.dtype is TensorDType.INT16
+    assert meta.format is TensorFormat.FLEXIBLE
+    assert meta.media_type == "text/x-raw"
+
+
+def test_flex_wrap_unwrap_roundtrip():
+    arr = np.arange(24, dtype=np.float32)
+    info = TensorInfo((24,), TensorDType.FLOAT32)
+    meta, payload = unwrap_flex(wrap_flex(arr.tobytes(), info))
+    assert meta.info.is_compatible(info)
+    assert np.frombuffer(payload, np.float32).tolist() == arr.tolist()
+
+
+def test_bf16_uses_extension_code_past_nns_end():
+    """bf16 packs with code 100 — past the reference's _NNS_END (10) so an
+    upstream peer's validate rejects the header cleanly instead of
+    misparsing, while TPU-to-TPU links round-trip."""
+    info = TensorInfo((4,), TensorDType.BFLOAT16)
+    raw = TensorMetaInfo(info, TensorFormat.FLEXIBLE).pack()
+    assert struct.unpack_from("<I", raw, 4)[0] == 100
+    assert struct.unpack_from("<I", raw, 4)[0] >= 10  # _NNS_END
+    meta = TensorMetaInfo.parse(raw)
+    assert meta.info.dtype is TensorDType.BFLOAT16
+
+
+def test_sparse_wire_layout_values_then_indices():
+    # dense float32 1-D tensor with nonzeros at flat indices 1 and 5
+    dense = np.zeros(8, np.float32)
+    dense[1], dense[5] = 2.5, -7.0
+    info = TensorInfo((8,), TensorDType.FLOAT32)
+    blob = sparse_encode(dense, info)
+
+    want_hdr = _reference_header(
+        type_enum=7, dims=[8], fmt_enum=2, media_enum=4, nnz=2)
+    assert blob[:128] == want_hdr
+    # reference pointer math: values first, then uint32 indices
+    values = np.frombuffer(blob, np.float32, count=2, offset=128)
+    indices = np.frombuffer(blob, np.uint32, count=2, offset=128 + 2 * 4)
+    assert values.tolist() == [2.5, -7.0]
+    assert indices.tolist() == [1, 5]
+
+
+def test_sparse_reference_to_dense_math_roundtrip():
+    """Decode exactly the way gst_tensor_sparse_to_dense walks the blob,
+    then check our own decoder agrees."""
+    rng = np.random.default_rng(7)
+    dense = np.where(rng.random((4, 6)) < 0.3,
+                     rng.standard_normal((4, 6)), 0.0).astype(np.float32)
+    info = TensorInfo.from_shape(dense.shape, np.float32)
+    blob = sparse_encode(dense, info)
+
+    nnz = struct.unpack_from("<I", blob, 8 + 4 * 18)[0]
+    esize = 4
+    values = np.frombuffer(blob, np.float32, count=nnz, offset=128)
+    indices = np.frombuffer(blob, np.uint32, count=nnz,
+                            offset=128 + esize * nnz)
+    ref_out = np.zeros(dense.size, np.float32)
+    ref_out[indices] = values           # the reference's scatter loop
+    assert np.array_equal(ref_out.reshape(dense.shape), dense)
+
+    ours, info2 = sparse_decode(blob)
+    assert np.array_equal(ours, dense)
+    assert info2.shape == dense.shape
+
+
+def test_sparse_uint8_itemsize_offsets():
+    # itemsize 1: indices must start at 128 + nnz, not 128 + 4*nnz
+    dense = np.zeros(10, np.uint8)
+    dense[3], dense[9] = 7, 200
+    info = TensorInfo((10,), TensorDType.UINT8)
+    blob = sparse_encode(dense, info)
+    values = np.frombuffer(blob, np.uint8, count=2, offset=128)
+    indices = np.frombuffer(blob, np.uint32, count=2, offset=128 + 2)
+    assert values.tolist() == [7, 200]
+    assert indices.tolist() == [3, 9]
+    out, _ = sparse_decode(blob)
+    assert np.array_equal(out, dense)
