@@ -78,6 +78,12 @@ class TensorBatch(Element):
         self._worker.start()
 
     def stop(self) -> None:
+        # Teardown semantics (deliberate): an abrupt stop() WITHOUT a
+        # prior EOS discards the partially accumulated group — same as a
+        # GStreamer queue dropping in-flight buffers on the NULL
+        # transition. Draining streams end with EOS, which the worker
+        # flushes in-order before the boundary (see _drain); pushing from
+        # stop() instead would race downstream elements already stopping.
         with self._cv:
             self._flushing = True
             self._cv.notify_all()

@@ -58,6 +58,11 @@ class TensorFilter(Element):
         self.inputtype: Optional[str] = None
         self.output: Optional[str] = None
         self.outputtype: Optional[str] = None
+        # data layouts, comma-separated per tensor: none/any/NHWC/NCHW
+        # (tensor_filter_common.c:913-940). NCHW on the XLA backend fuses
+        # the channel-first<->channel-last transpose into the XLA program.
+        self.inputlayout: Optional[str] = None
+        self.outputlayout: Optional[str] = None
         self.input_combination: Optional[str] = None   # e.g. "0,2"
         self.output_combination: Optional[str] = None  # e.g. "i0,o0"
         self.shared_tensor_filter_key: Optional[str] = None
@@ -84,6 +89,40 @@ class TensorFilter(Element):
     def throughput(self) -> int:
         """FPS×1000 since first invoke (reference prop)."""
         return self.stats.throughput
+
+    @property
+    def inputranks(self) -> str:
+        """Comma-separated ranks of the model's input tensors (readable
+        prop, PROP_INPUTRANKS)."""
+        return self._ranks_of(0)
+
+    @property
+    def outputranks(self) -> str:
+        """Comma-separated ranks of the model's output tensors (readable
+        prop, PROP_OUTPUTRANKS)."""
+        return self._ranks_of(1)
+
+    def _ranks_of(self, which: int) -> str:
+        if self.fw is None:
+            return ""
+        info = self.fw.get_model_info()[which]
+        if info is None:
+            return ""
+        return ",".join(str(t.rank) for t in info)
+
+    _LAYOUTS = ("", "none", "any", "nhwc", "nchw")
+
+    @classmethod
+    def _parse_layout(cls, spec: Optional[str]) -> tuple:
+        if not spec:
+            return ()
+        vals = tuple(p.strip().lower() for p in str(spec).split(","))
+        for v in vals:
+            if v not in cls._LAYOUTS:
+                raise ValueError(
+                    f"tensor_filter: unknown layout {v!r} "
+                    "(allowed: none/any/NHWC/NCHW)")
+        return vals
 
     def _parse_combinations(self) -> None:
         if self.input_combination:
@@ -122,6 +161,8 @@ class TensorFilter(Element):
             input_info=self._override_info(self.input, self.inputtype),
             output_info=self._override_info(self.output, self.outputtype),
             is_updatable=self.is_updatable,
+            input_layout=self._parse_layout(self.inputlayout),
+            output_layout=self._parse_layout(self.outputlayout),
         )
         if self.shared_tensor_filter_key:
             key = self.shared_tensor_filter_key

@@ -47,6 +47,11 @@ class FilterProps:
     output_info: Optional[TensorsInfo] = None
     num_threads: int = 0
     is_updatable: bool = False
+    #: per-tensor data layouts declared by the inputlayout/outputlayout
+    #: props ("none"/"any"/"nhwc"/"nchw" — tensor_filter_common.c:913-940);
+    #: empty tuple = unspecified
+    input_layout: tuple = ()
+    output_layout: tuple = ()
 
     @property
     def model_path(self) -> Optional[str]:

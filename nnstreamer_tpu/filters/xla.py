@@ -152,6 +152,24 @@ def _as_tuple(out: Any) -> Tuple[Any, ...]:
     return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
 
+def _layout_infos(infos: Optional[TensorsInfo],
+                  layouts: Sequence[str]) -> Optional[TensorsInfo]:
+    """Model-layout (NHWC) TensorsInfo → stream-layout: tensors declared
+    NCHW report channel-first dims so caps negotiation matches the wire."""
+    if infos is None or not layouts:
+        return infos
+    out = []
+    for i, t in enumerate(infos):
+        shape = t.shape
+        if i < len(layouts) and layouts[i] == "nchw" and len(shape) == 4:
+            n, h, w, c = shape
+            out.append(TensorInfo.from_shape((n, c, h, w), t.dtype.np_dtype,
+                                             t.name))
+        else:
+            out.append(t)
+    return TensorsInfo(tuple(out))
+
+
 def _coerce_info(v: Any) -> Optional[TensorsInfo]:
     if v is None or isinstance(v, TensorsInfo):
         return v
@@ -195,6 +213,11 @@ class XLAFilter(FilterFramework):
         self._precision = opts.get("precision", "")
         self._donate = opts.get("donate", "false").lower() in ("1", "true", "yes")
         self._bucket = int(opts.get("bucket", "0") or 0)
+        # inputlayout/outputlayout=NCHW: the stream is channel-first while
+        # XLA/zoo models are channel-last — the permutes compile INTO the
+        # XLA program (free to fuse, never a host-side copy)
+        self._in_layout = tuple(props.input_layout or ())
+        self._out_layout = tuple(props.output_layout or ())
         resize = opts.get("resize", "")
         if resize:
             parts = tuple(int(v) for v in resize.split(":"))
@@ -205,8 +228,10 @@ class XLAFilter(FilterFramework):
             self._resize = None
         self.flexible_output = self._bucket > 0
         self._build_jit()
-        self._in_info = props.input_info or self._bundle.in_info
-        self._out_info = props.output_info or self._bundle.out_info
+        self._in_info = props.input_info or _layout_infos(
+            self._bundle.in_info, self._in_layout)
+        self._out_info = props.output_info or _layout_infos(
+            self._bundle.out_info, self._out_layout)
         if self._in_info is not None and self._out_info is None:
             self._out_info = self._infer_out_info(self._in_info)
         log.info("xla-tpu opened model=%s device=%s sync=%s",
@@ -260,6 +285,25 @@ class XLAFilter(FilterFramework):
         fn = self._bundle.fn()
         precision = self._precision
         pre = getattr(self, "_fused_pre", None)
+        in_layout = getattr(self, "_in_layout", ())
+        out_layout = getattr(self, "_out_layout", ())
+
+        def to_model_layout(i, x):
+            # stream NCHW -> model NHWC (rank-4 only; others pass through,
+            # matching the reference's "layout of the data" scope)
+            if i < len(in_layout) and in_layout[i] == "nchw" and x.ndim == 4:
+                import jax.numpy as jnp
+
+                return jnp.transpose(x, (0, 2, 3, 1))
+            return x
+
+        def to_stream_layout(j, y):
+            if j < len(out_layout) and out_layout[j] == "nchw" \
+                    and getattr(y, "ndim", 0) == 4:
+                import jax.numpy as jnp
+
+                return jnp.transpose(y, (0, 3, 1, 2))
+            return y
         if self._bundle.metadata.get("jit") is False:
             # bundle fn is already a compiled/pjit program (sharded
             # serving): an outer jit would re-stage it against the wrong
@@ -268,10 +312,17 @@ class XLAFilter(FilterFramework):
             if self._donate:
                 log.warning("donate=true ignored for pre-compiled (jit "
                             "False) bundle %s", self._bundle.name)
-            if pre is not None or precision in ("bf16", "bfloat16"):
-                def stage(x):
+            if pre is not None or precision in ("bf16", "bfloat16") \
+                    or in_layout or out_layout:
+                def stage(i, x):
+                    # fused preprocess FIRST: inputlayout describes the
+                    # stream entering the filter, i.e. the fused
+                    # transform's OUTPUT — fusion hands us the raw
+                    # upstream data, so the transform must run before
+                    # the layout permute
                     if pre is not None:
                         x = pre(x)
+                    x = to_model_layout(i, x)
                     if precision in ("bf16", "bfloat16"):
                         import jax.numpy as jnp
 
@@ -280,9 +331,13 @@ class XLAFilter(FilterFramework):
                             x = x.astype(jnp.bfloat16)
                     return x
 
-                stage_jit = jax.jit(stage)
-                self._jitted = lambda *xs: _as_tuple(
-                    fn(*(stage_jit(x) for x in xs)))
+                stage_jit = jax.jit(stage, static_argnums=0)
+                post_jit = jax.jit(to_stream_layout, static_argnums=0) \
+                    if out_layout else None
+                self._jitted = lambda *xs: tuple(
+                    post_jit(j, y) if post_jit is not None else y
+                    for j, y in enumerate(_as_tuple(
+                        fn(*(stage_jit(i, x) for i, x in enumerate(xs))))))
             else:
                 self._jitted = lambda *xs: _as_tuple(fn(*xs))
             return
@@ -291,7 +346,7 @@ class XLAFilter(FilterFramework):
         # executable per pipeline construction and never actually share
         cache = None if pre is not None \
             else self._bundle.metadata.setdefault("_jit_cache", {})
-        cache_key = (precision, self._donate)
+        cache_key = (precision, self._donate, in_layout, out_layout)
         if cache is not None:
             hit = cache.get(cache_key)
             if hit is not None:
@@ -299,15 +354,19 @@ class XLAFilter(FilterFramework):
                 return
 
         def wrapped(*xs):
+            # fused preprocess BEFORE the layout permute (inputlayout
+            # describes the fused transform's output stream — see stage())
             if pre is not None:
                 xs = tuple(pre(x) for x in xs)
+            xs = tuple(to_model_layout(i, x) for i, x in enumerate(xs))
             if precision in ("bf16", "bfloat16"):
                 import jax.numpy as jnp
 
                 xs = tuple(x.astype(jnp.bfloat16)
                            if np.issubdtype(np.dtype(str(x.dtype)), np.floating) else x
                            for x in xs)
-            return _as_tuple(fn(*xs))
+            return tuple(to_stream_layout(j, y)
+                         for j, y in enumerate(_as_tuple(fn(*xs))))
 
         kw: Dict[str, Any] = {}
         if self._donate:

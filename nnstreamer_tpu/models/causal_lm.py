@@ -195,6 +195,13 @@ def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
     token: (B, 1) int32; kcache/vcache: (L·B·H, max_len, hd) flat transport
     layout; pos: (1,) int32 — next write position. Returns
     (logits (B, vocab), kcache', vcache', pos+1).
+
+    Cache-capacity contract: callers must stop at ``pos == max_len``
+    (prompt + generated tokens ≤ the cache's max_len). Decoding past
+    capacity cannot raise from inside the compiled program (pos is a
+    traced value), so the step NaN-poisons the logits instead —
+    ``dynamic_update_slice`` would otherwise clamp the write onto the
+    last slot and return silently wrong results.
     """
     with jax.default_matmul_precision(_PRECISION):
         return _lm_decode_step(params, token, kcache, vcache, pos, n_heads)
@@ -235,6 +242,9 @@ def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
         block, x, (params["wqkv"], params["wo"], params["w1"],
                    params["w2"], params["ln1"], params["ln2"], kc, vc))
     logits = (_ln(x, params["lnf"]) @ params["embed"].T)[:, 0]
+    # cache overflow (pos past capacity) surfaces as NaN logits, not as a
+    # silent overwrite of the last cache slot — see lm_decode_step doc
+    logits = jnp.where(p >= max_len, jnp.nan, logits)
     flat = (n_layers * b * n_heads, max_len, hd)
     return (logits, kc.reshape(flat), vc.reshape(flat),
             (p + 1).reshape(1).astype(jnp.int32))

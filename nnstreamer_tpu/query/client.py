@@ -68,9 +68,11 @@ class TensorQueryClient(Element):
         self._reader: Optional[threading.Thread] = None
         self._reader_error: Optional[Exception] = None
         self._pong = False
-        #: entries appended to _pending whose DATA has not gone out yet —
-        #: the reader must not count them as lost in-flight frames
-        self._unsent = 0
+        # _pending entries are mutable [pts, duration, offset, sent]
+        # records; `sent` flips True under _cv immediately after
+        # send_message returns, so the reader counts lost frames exactly
+        # per entry (an aggregate counter would race the flip and
+        # misattribute a just-sent frame as never-transmitted)
         self._last_activity = 0.0
         #: reused connections idle longer than this get a PING/PONG probe
         #: before the next frame (a peer that died while idle is only
@@ -153,7 +155,6 @@ class TensorQueryClient(Element):
         self._reader = None
         with self._cv:
             self._pending.clear()
-            self._unsent = 0
             self._cv.notify_all()
 
     # -- negotiation --------------------------------------------------------- #
@@ -180,7 +181,7 @@ class TensorQueryClient(Element):
                 with self._cv:
                     if not self._pending:
                         raise QueryProtocolError("unsolicited RESULT")
-                    pts, duration, offset = self._pending[0]
+                    pts, duration, offset = self._pending[0][:3]
                 out = payload_to_buffer(rmeta, rpayload)
                 out.pts, out.duration, out.offset = pts, duration, offset
                 self.push(out)
@@ -191,16 +192,15 @@ class TensorQueryClient(Element):
                     self._cv.notify_all()
         except (ConnectionError, OSError, QueryProtocolError) as e:
             with self._cv:
-                # SENT frames are lost; entries never transmitted
-                # (_unsent, a chain call mid-send-failure) are NOT — their
-                # chain call pops and retries them itself
-                lost = len(self._pending) - self._unsent
+                # SENT frames are lost; entries never transmitted (a chain
+                # call mid-send-failure) are NOT — their chain call pops
+                # and retries them itself
+                lost = sum(1 for entry in self._pending if entry[3])
                 if lost > 0 or not isinstance(e, OSError):
                     self._reader_error = e
                     self.post_error(f"query reader failed with "
                                     f"{lost} in flight: {e}", exc=e)
                     self._pending.clear()
-                    self._unsent = 0
                 self._cv.notify_all()
 
     def _reset_conn(self) -> None:
@@ -277,19 +277,20 @@ class TensorQueryClient(Element):
                     self._cv.wait(0.1)
                 if self._reader_error is not None:
                     return FlowReturn.ERROR
-                self._pending.append((buf.pts, buf.duration, buf.offset))
-                self._unsent += 1
+                entry = [buf.pts, buf.duration, buf.offset, False]
+                self._pending.append(entry)
             try:
                 send_message(sock, Cmd.DATA, meta, payload)
                 with self._cv:
-                    self._unsent = max(0, self._unsent - 1)
+                    entry[3] = True  # on the wire: reader now owns its fate
                 self._last_activity = time.monotonic()
                 return FlowReturn.OK
             except OSError:
                 with self._cv:
-                    if self._pending:
-                        self._pending.pop()  # this frame never went out
-                    self._unsent = max(0, self._unsent - 1)
+                    try:
+                        self._pending.remove(entry)  # never went out
+                    except ValueError:
+                        pass  # reader error path already cleared it
                     others = bool(self._pending)
                 if others or self._reader_error is not None:
                     # sent frames are (or already were) reported lost

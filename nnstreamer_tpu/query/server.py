@@ -242,6 +242,13 @@ class TensorQueryServerSink(Element):
                 self._route(buf)
             except RuntimeError as e:
                 self.post_error(str(e), exc=e)
+                with self._cv:
+                    # release any producer blocked on a full queue so its
+                    # chain() returns ERROR promptly instead of spinning
+                    # until an external stop() (mirrors TensorBatch's
+                    # _quit_worker teardown)
+                    self._draining = False
+                    self._cv.notify_all()
                 return
             finally:
                 with self._cv:

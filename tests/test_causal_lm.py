@@ -239,3 +239,20 @@ def test_sp_prefill_rejects_missing_axis(bundle):
         lm_prefill(bundle.params, jnp.zeros((1, 8), jnp.int32),
                    meta["heads"], meta["max_len"],
                    mesh=make_mesh({"data": 8}))
+
+
+def test_decode_past_cache_capacity_poisons_logits(bundle):
+    """pos >= max_len cannot raise inside the compiled step, so the
+    overflow surfaces as NaN logits instead of silently overwriting the
+    last cache slot (ADVICE r3: lm_decode_step bound guard)."""
+    meta = bundle.metadata
+    k, v, pos = empty_cache(meta["layers"], 1, meta["heads"],
+                            meta["max_len"], meta["head_dim"])
+    step = jax.jit(bundle.fn())
+    tok = np.zeros((1, 1), np.int32)
+    for _ in range(meta["max_len"]):
+        logits, k, v, pos = step(tok, k, v, pos)
+        assert np.isfinite(np.asarray(logits)).all()
+    # one past capacity: poisoned, not silently wrong
+    logits, k, v, pos = step(tok, k, v, pos)
+    assert np.isnan(np.asarray(logits)).all()

@@ -1,0 +1,144 @@
+"""tensor_filter inputlayout/outputlayout/inputranks property parity.
+
+Reference surface: tensor_filter_common.c:891-992 (PROP_INPUTLAYOUT /
+PROP_OUTPUTLAYOUT accept none/any/NHWC/NCHW per tensor; PROP_INPUTRANKS /
+PROP_OUTPUTRANKS are readable rank lists). On the XLA backend a declared
+NCHW stream is permuted to the model's native NHWC INSIDE the compiled
+program (and back for outputs) — a fused transpose, not a host copy.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+
+def caps_of(dims, types, rate=30):
+    return Caps.tensors(
+        TensorsConfig(TensorsInfo.from_strings(dims, types), rate))
+
+
+def test_nchw_stream_into_nhwc_model():
+    """Channel-first frames (1,3,4,5) reach an NHWC channel-reduce model;
+    result must equal reducing the original's axis 1."""
+    x = np.arange(60, dtype=np.float32).reshape(1, 3, 4, 5)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("5:4:3:1", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model=lambda a: a.sum(axis=3), inputlayout="NCHW")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    out = sink.buffers[0].memories[0].host()
+    np.testing.assert_allclose(out, x.sum(axis=1))
+
+
+def test_nchw_roundtrip_identity_preserves_layout():
+    """inputlayout+outputlayout NCHW: data comes back exactly, and the
+    negotiated output caps stay channel-first."""
+    x = np.random.default_rng(0).standard_normal((1, 3, 4, 5)).astype(
+        np.float32)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("5:4:3:1", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model=lambda a: a * 1.0,
+                     inputlayout="NCHW", outputlayout="NCHW")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    out = sink.buffers[0].memories[0].host()
+    assert out.shape == (1, 3, 4, 5)
+    np.testing.assert_allclose(out, x)
+
+
+def test_nchw_model_info_reported_in_stream_layout():
+    """A bundle with NHWC in_info declared NCHW must negotiate
+    channel-first caps (dims permuted) — the is_compatible check passes
+    for a channel-first stream."""
+    from nnstreamer_tpu.models.zoo import ModelBundle
+
+    bundle = ModelBundle(
+        "idconv", lambda x: x,
+        in_info=TensorsInfo.from_strings("3:8:8:1", "float32"),   # NHWC
+        out_info=TensorsInfo.from_strings("3:8:8:1", "float32"))
+    x = np.zeros((1, 3, 8, 8), np.float32)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("8:8:3:1", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=bundle,
+                     inputlayout="NCHW", outputlayout="NCHW")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    filt._open_fw()
+    # backend reports the bundle's NHWC info permuted to the declared
+    # channel-first stream layout — that's what caps negotiation compares
+    assert filt.fw.get_model_info()[0][0].dim_string == "8:8:3:1"
+    assert filt.inputranks == "4"
+    p.run(timeout=60)
+    assert sink.buffers[0].memories[0].host().shape == (1, 3, 8, 8)
+
+
+def test_inputranks_outputranks_readable_props():
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    filt = TensorFilter(framework="xla-tpu",
+                        model=lambda a: (a.sum(axis=3), a[:, 0, 0, 0]))
+    assert filt.inputranks == ""          # backend not opened yet
+    filt._open_fw()
+    filt.fw.set_input_info(TensorsInfo.from_strings("5:4:3:1", "float32"))
+    assert filt.inputranks == "4"
+    assert filt.outputranks == "3,1"
+    filt.stop()
+
+
+def test_unknown_layout_value_rejected():
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                    data=[np.zeros((1, 4), np.float32)])
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model=lambda a: a, inputlayout="NHCW")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    with pytest.raises(Exception, match="layout"):
+        p.run(timeout=60)
+
+
+def test_non_rank4_tensors_pass_through_unchanged():
+    """Layout only applies to rank-4 tensors (the reference's scope);
+    a rank-2 stream with inputlayout=NCHW is untouched."""
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("4:2", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model=lambda a: a + 1, inputlayout="NCHW")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    np.testing.assert_allclose(sink.buffers[0].memories[0].host(), x + 1)
+
+
+def test_fused_transform_runs_before_layout_permute():
+    """inputlayout describes the stream ENTERING the filter — i.e. the
+    fused transform's output. With auto_fuse, the transform must run
+    before the NCHW permute or fused vs unfused results diverge."""
+    x = np.random.default_rng(1).standard_normal((2, 3, 4, 5)).astype(
+        np.float32)
+
+    def run(fuse):
+        p = Pipeline()
+        p.auto_fuse = fuse
+        src = p.add_new("appsrc", caps=caps_of("5:4:3:2", "float32"),
+                        data=[x])
+        tr = p.add_new("tensor_transform", mode="transpose",
+                       option="1:0:2:3")
+        filt = p.add_new("tensor_filter", framework="xla-tpu",
+                         model=lambda a: a * 1.0,
+                         inputlayout="NCHW", outputlayout="NCHW")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, tr, filt, sink)
+        p.run(timeout=60)
+        return sink.buffers[0].memories[0].host()
+
+    fused, unfused = run(True), run(False)
+    assert fused.shape == unfused.shape
+    np.testing.assert_allclose(fused, unfused)
