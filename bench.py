@@ -4,20 +4,26 @@ Mirrors BASELINE.md's headline config (videotestsrc ! tensor_converter !
 tensor_filter framework=xla-tpu model=mobilenet_v2 ! tensor_decoder
 mode=image_labeling ! sink) end-to-end on the real TPU chip.
 
-Reported (BASELINE.md "numbers to produce" + VERDICT r2 #3 methodology):
-  * ``value``/``fps_median`` — steady-state pipeline FPS, best and median
-    64-frame window (peak shows capability; median is the honest
-    sustained number over the jittery tunnel);
+Reported (BASELINE.md "numbers to produce" + VERDICT r3 #1/#4/#5):
+  * ``value``/``fps_median`` — steady-state pipeline FPS; the headline
+    throughput run repeats BENCH_REPEATS (default 3) times and reports
+    the median-of-medians with min/max spread (the tunnel swings 89-205
+    FPS run-to-run on identical code — single shots are noise);
   * ``p50_invoke_us`` — synchronous per-invoke latency (reference
     tensor_filter.c:366-380 ``latency`` prop contract: includes transfer);
-  * ``split`` — amortized per-frame H2D/compute/D2H + one-shot RTT
-    (utils/probes.phase_split), separating tunnel cost from chip cost;
+  * ``split`` (+ per-config ``*_split``) — amortized per-frame
+    H2D/compute/D2H + one-shot RTT (utils/probes.phase_split) for the
+    headline AND the SSD/DeepLab/PoseNet configs;
   * ``mfu`` — model FLOPs (XLA cost analysis) × FPS / chip peak;
-  * ``vs_baseline`` — speedup over the same pipeline on same-host jax-CPU
-    (the reference's tflite-CPU analog, run in a subprocess); falls back
-    to FPS/30 (real-time camera rate) if the CPU run fails;
-  * extras: SSD / DeepLab / PoseNet pipeline FPS (peak + median), batched
-    serving scaling, and the on-chip smoke lane (utils/probes.tpu_smoke).
+  * ``batch_sweep`` — frames-per-tensor batch 8..128 FPS+MFU curve (+ a
+    w8-quant point): the compute-bound operating point and its knee;
+  * ``transformer_prefill_*`` — causal-LM prefill scoring pipeline
+    (bf16 params, 1K context): tokens/sec + MFU, the MXU-saturating row;
+  * ``vs_baseline`` — speedup over the STRONGEST same-host jax-CPU run
+    (best of per-frame and batch-8 serving, subprocess); falls back to
+    FPS/30 (real-time camera rate) if the CPU run fails;
+  * extras: SSD / DeepLab / PoseNet FPS (peak + median), adaptive
+    micro-batching, and the on-chip smoke lane (utils/probes.tpu_smoke).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -42,7 +48,7 @@ _partial: dict = {}
 
 
 def _arm_watchdog() -> None:
-    budget = float(os.environ.get("BENCH_BUDGET_SECS", "1200"))
+    budget = float(os.environ.get("BENCH_BUDGET_SECS", "1500"))
     if budget <= 0:
         return
 
@@ -77,12 +83,18 @@ DECODE_DEPTH = int(os.environ.get("BENCH_DEPTH", "64"))
 
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: repeat bench runs skip the slow
-    first compile (harmless no-op if the backend rejects it)."""
+    first compile (harmless no-op if the backend rejects it). The dir is
+    per-hostname: entries written by ANOTHER machine load with
+    machine-feature mismatches (XLA:CPU AOT warns about possible SIGILL)
+    and have been observed to make cache reads pathologically slow."""
+    import platform
+
     import jax
 
     try:
+        default = f"/tmp/jax_cache_{platform.node() or 'host'}"
         jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_CACHE_DIR", "/tmp/jax_cache"))
+                          os.environ.get("JAX_CACHE_DIR", default))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
@@ -143,8 +155,10 @@ def _pipeline_fps(model_spec: str, size: int, dec_mode: str, dec_opts: dict,
     conv = p.add_new("tensor_converter")
     chain = [src, conv]
     if adaptive_batch > 1:
+        # budget must cover the source-rate group fill time (see the
+        # adaptive-SSD note in _extra_benches / docs/performance.md)
         chain.append(p.add_new("tensor_batch", max_batch=adaptive_batch,
-                               budget_ms=50.0))
+                               budget_ms=200.0))
         model_spec = _with_batch(model_spec, adaptive_batch)
     filt = p.add_new("tensor_filter", framework="xla-tpu", model=model_spec)
     chain.append(filt)
@@ -193,13 +207,19 @@ def _extra_benches(tmpdir: str) -> dict:
             peak, med = _pipeline_fps(spec, size, mode, opts)
             out[key] = round(peak, 2)
             out[key.replace("_fps", "_fps_median")] = round(med, 2)
+            out[key.replace("_fps", "_split")] = _config_split(spec, size)
         except Exception:
             traceback.print_exc(file=sys.stderr)
             out[key] = None
         _partial.update(out)  # stream rows as they land (watchdog-visible)
     try:
         # detection through the adaptive serving path: batched H2D+invoke
-        # with the per-frame device-NMS decode restored after unbatch
+        # with the per-frame device-NMS decode restored after unbatch.
+        # budget_ms must exceed the time the source takes to FILL a group
+        # (8 frames at ~120 FPS ≈ 68 ms): r3 used 50 ms, so every group
+        # flushed partial at ~6 frames and was padded to 8 — 25% wasted
+        # invoke compute, measured BELOW the unbatched path. See
+        # docs/performance.md (adaptive batching: budget vs fill time).
         _mark("extra bench ssd adaptive batch starting")
         spec, size, mode, opts = configs["ssd_mobilenet_300_fps"]
         peak, med = _pipeline_fps(spec, size, mode, opts, adaptive_batch=8)
@@ -210,6 +230,26 @@ def _extra_benches(tmpdir: str) -> dict:
         out["ssd_mobilenet_300_adaptive8_fps"] = None
     _partial.update(out)
     return out
+
+
+def _config_split(spec: str, size: int):
+    """Per-config phase split (VERDICT r3 #3: says in one run whether a
+    config is invoke-, transfer-, or host-bound)."""
+    import jax
+
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.utils import probes
+
+    try:
+        bundle = get_model(spec)
+        example = np.zeros((1, size, size, 3), np.uint8)
+        return probes.phase_split(bundle.fn(), [example],
+                                  device=jax.devices()[0], k=16)
+    except Exception:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        return None
 
 
 def _composite_bench() -> dict:
@@ -329,7 +369,7 @@ def _adaptive_bench(labels_path: str) -> dict:
         src = p.add_new("videotestsrc", width=SIZE, height=SIZE,
                         num_buffers=n_frames + warm, pattern="random")
         conv = p.add_new("tensor_converter")
-        bat = p.add_new("tensor_batch", max_batch=batch, budget_ms=50.0)
+        bat = p.add_new("tensor_batch", max_batch=batch, budget_ms=200.0)
         filt = p.add_new("tensor_filter", framework="xla-tpu",
                          model=_with_batch(MODEL, batch))
         unb = p.add_new("tensor_unbatch")
@@ -352,35 +392,169 @@ def _adaptive_bench(labels_path: str) -> dict:
         return {}
 
 
-def _batched_bench(labels_path: str) -> dict:
-    """Batched serving (VERDICT r2 #4): same model at batch=8 via the
-    converter's frames-per-tensor regrouping; FPS counts source frames."""
+def _batched_point(labels_path: str, batch: int, quant: str = "",
+                   n_batches: int = 24, warm: int = 4) -> tuple:
+    """(fps, fps_median) for frames-per-tensor serving at ``batch`` —
+    counts source frames. The source is an appsrc cycling pre-generated
+    frames: at batch>=64 the equivalent frame rate passes 1 kFPS and a
+    generate-per-frame videotestsrc would become the bottleneck being
+    measured."""
+    from nnstreamer_tpu.graph import Pipeline
+
+    rng = np.random.default_rng(1)
+    pool = [rng.integers(0, 255, (SIZE, SIZE, 3)).astype(np.uint8)
+            for _ in range(8)]
+    total = (n_batches + warm) * batch
+    # shallow decode depth: one H2D per BATCH already amortizes transfer,
+    # and the EOS-drain tail exclusion in _windowed_fps removes `depth`
+    # arrivals — a deep pipeline would swallow the whole short run
+    depth = 4
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=_video_caps(),
+                    data=(pool[i % len(pool)] for i in range(total)))
+    conv = p.add_new("tensor_converter", frames_per_tensor=batch)
+    filt = p.add_new("tensor_filter", framework="xla-tpu",
+                     model=_with_batch(MODEL, batch),
+                     custom=f"quant={quant}" if quant else "")
+    dec = p.add_new("tensor_decoder", mode="image_labeling",
+                    option1=labels_path, async_depth=depth)
+    sink = p.add_new("tensor_sink")
+    arrivals = []
+    sink.new_data = lambda buf: arrivals.append(time.monotonic())
+    Pipeline.link(src, conv, filt, dec, sink)
+    p.run(timeout=600)
+    peak, med = _windowed_fps(arrivals, warm, depth, window=8)
+    return peak * batch, med * batch
+
+
+def _batch_sweep(labels_path: str, flops, device) -> dict:
+    """VERDICT r3 #1: sweep the batch axis to (or past) the compute-bound
+    knee; report FPS + MFU per point and a w8-quant point at the largest
+    batch. Keys batch8_* keep round-over-round continuity."""
+    import traceback
+
+    from nnstreamer_tpu.utils import probes
+
+    out: dict = {}
+    sweep: dict = {}
+    # 4 points span the curve; each batch size is its own XLA compile
+    # (~40-60 s over the tunnel), so resolution trades against the
+    # watchdog budget
+    for batch in (8, 32, 64, 128):
+        try:
+            _mark(f"batch sweep b={batch} starting")
+            peak, med = _batched_point(labels_path, batch, n_batches=16)
+            if not np.isfinite(med):
+                continue
+            point = {"fps": round(peak, 2), "fps_median": round(med, 2)}
+            if flops:
+                point["mfu"] = round(
+                    probes.mfu(flops, med, device) or 0.0, 6)
+            sweep[str(batch)] = point
+            if batch == 8:
+                out["batch8_fps"] = point["fps"]
+                out["batch8_fps_median"] = point["fps_median"]
+                if "mfu" in point:
+                    out["batch8_mfu"] = point["mfu"]
+            _partial.update({"batch_sweep": sweep})
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+    try:
+        _mark("batch sweep w8 quant point starting")
+        peak, med = _batched_point(labels_path, 64, quant="w8",
+                                   n_batches=16)
+        if np.isfinite(med):
+            point = {"fps": round(peak, 2), "fps_median": round(med, 2)}
+            if flops:
+                point["mfu"] = round(probes.mfu(flops, med, device) or 0.0,
+                                     6)
+            sweep["64_w8"] = point
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    if sweep:
+        out["batch_sweep"] = sweep
+    _partial.update(out)
+    return out
+
+
+def _transformer_bench() -> dict:
+    """VERDICT r3 #1: a transformer tokens/sec + MFU row. Causal-LM
+    prefill scoring as a real pipeline (appsrc token batches →
+    tensor_filter → sink materializing results): per the environment's
+    own evidence, only wall-clock arrivals at a sink are honest through
+    the tunnel — no device-timer microbenchmarks. bf16 params + default
+    TPU matmul precision (the production serving configuration; the
+    exactness-pinned f32 zoo path stays as is). Output is last-token
+    logits only so D2H stays small."""
     import traceback
 
     try:
-        from nnstreamer_tpu.graph import Pipeline
+        import jax
+        import jax.numpy as jnp
 
-        batch = 8
-        n_batches, warm, depth = 40, 4, 16
-        p = Pipeline()
-        src = p.add_new("videotestsrc", width=SIZE, height=SIZE,
-                        num_buffers=(n_batches + warm) * batch,
-                        pattern="random")
-        conv = p.add_new("tensor_converter", frames_per_tensor=batch)
+        from nnstreamer_tpu.core import Caps
+        from nnstreamer_tpu.core.types import TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.models.zoo import ModelBundle
+        from nnstreamer_tpu.utils import probes
+
+        V, D, H, L = 8192, 1024, 16, 8
+        B, T = int(os.environ.get("BENCH_LM_BATCH", "8")), \
+            int(os.environ.get("BENCH_LM_SEQ", "1024"))
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, T)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), params)
+
+        def score(p, tokens):
+            # full prefill forward, last-token logits (f32 for the host)
+            out = causal_lm._lm_forward(p, tokens.astype(jnp.int32), H)
+            return out[:, -1].astype(jnp.float32)
+
+        bundle = ModelBundle(
+            "lm_prefill_bench", score, params=params,
+            in_info=TensorsInfo.from_strings(f"{T}:{B}", "int32"),
+            out_info=TensorsInfo.from_strings(f"{V}:{B}", "float32"))
+        n, warm = 24, 4
+        rng = np.random.default_rng(0)
+        toks = [rng.integers(0, V, (B, T)).astype(np.int32)
+                for _ in range(4)]
+        p = Pipeline("bench-lm")
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings(f"{T}:{B}", "int32")))
+        src = p.add_new("appsrc", caps=caps,
+                        data=(toks[i % 4] for i in range(n + warm)))
         filt = p.add_new("tensor_filter", framework="xla-tpu",
-                         model=_with_batch(MODEL, batch))
-        dec = p.add_new("tensor_decoder", mode="image_labeling",
-                        option1=labels_path, async_depth=depth)
+                         model=bundle)
         sink = p.add_new("tensor_sink")
-        arrivals = []
-        sink.new_data = lambda buf: arrivals.append(time.monotonic())
-        Pipeline.link(src, conv, filt, dec, sink)
+        arrivals: list = []
+
+        def on_data(buf):
+            buf.memories[0].host()  # materialize: honest wall-clock
+            arrivals.append(time.monotonic())
+
+        sink.new_data = on_data
+        Pipeline.link(src, filt, sink)
         p.run(timeout=600)
-        peak, med = _windowed_fps(arrivals, warm, depth, window=16)
-        if not np.isfinite(peak):
+        if len(arrivals) < warm + 8:
             return {}
-        row = {"batch8_fps": round(peak * batch, 2),
-               "batch8_fps_median": round(med * batch, 2)}
+        peak, med = _windowed_fps(arrivals, warm, 0, window=8)
+        if not np.isfinite(med):
+            return {}
+        device = jax.devices()[0]
+        flops = probes.model_flops(bundle.fn(), toks[0])
+        row = {
+            "transformer_prefill_tokens_per_s": round(peak * B * T, 1),
+            "transformer_prefill_tokens_per_s_median":
+                round(med * B * T, 1),
+            "transformer_prefill_config":
+                f"d{D} L{L} h{H} V{V} batch{B} seq{T} bf16",
+        }
+        if flops:
+            row["transformer_gflops_per_prefill"] = round(flops / 1e9, 1)
+            row["transformer_prefill_mfu"] = round(
+                probes.mfu(flops, med, device) or 0.0, 6)
         _partial.update(row)
         return row
     except Exception:
@@ -388,15 +562,15 @@ def _batched_bench(labels_path: str) -> dict:
         return {}
 
 
-def _cpu_reference() -> float:
-    """Same-host CPU run of the headline pipeline (reference tflite-CPU
-    analog, BASELINE.md row 1) in a subprocess so backends don't collide."""
+def _cpu_child_run(extra_env: dict) -> float:
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                BENCH_CPU_CHILD="1",
                BENCH_FRAMES="144",
                BENCH_DEPTH="8",
-               BENCH_EXTRAS="0")
+               BENCH_EXTRAS="0",
+               BENCH_REPEATS="1",
+               **extra_env)
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -411,6 +585,26 @@ def _cpu_reference() -> float:
     except Exception:
         pass
     return float("nan")
+
+
+def _cpu_reference() -> dict:
+    """Strongest same-host CPU numbers (VERDICT r3 #5): the per-frame
+    pipeline AND batch-8 frames-per-tensor serving (XLA-CPU threads
+    across cores; batching amortizes per-frame pipeline overhead the
+    same way the reference's tflite+XNNPACK batch path would). Both run
+    in subprocesses so backends don't collide; vs_baseline uses the
+    best of the two."""
+    plain = _cpu_child_run({})
+    batched = _cpu_child_run({"BENCH_CPU_BATCH": "8"})
+    out = {}
+    if np.isfinite(plain):
+        out["cpu_reference_fps"] = round(plain, 2)
+    if np.isfinite(batched):
+        out["cpu_reference_batch8_fps"] = round(batched, 2)
+    candidates = [v for v in (plain, batched) if np.isfinite(v) and v > 0]
+    if candidates:
+        out["cpu_reference_best_fps"] = round(max(candidates), 2)
+    return out
 
 
 def _mark(msg: str) -> None:
@@ -477,6 +671,15 @@ def main() -> None:
         f.write("\n".join(f"label{i}" for i in range(CLASSES)))
         labels_path = f.name
 
+    cpu_batch = int(os.environ.get("BENCH_CPU_BATCH", "0"))
+    if cpu_child and cpu_batch > 1:
+        # batched-CPU child lane: one frames-per-tensor measurement, one
+        # JSON line (the parent takes the strongest CPU number)
+        peak, med = _batched_point(labels_path, cpu_batch, n_batches=12)
+        print(json.dumps(_sanitize(
+            {"value": round(peak, 2), "fps_median": round(med, 2)})))
+        return
+
     _mark("latency run (sync) starting")
     # -- latency run (synchronous invokes, per-frame timing) ----------------- #
     lat_frames = [frames[i % len(frames)] for i in range(n_warmup + 64)]
@@ -487,19 +690,35 @@ def main() -> None:
     p.run(timeout=600)
     p50_us = float(np.percentile(np.asarray(lats[n_warmup:]) / 1000.0, 50))
 
-    _mark("throughput run starting")
-    # -- throughput run (async dispatch, end-to-end pipeline FPS) ------------ #
-    tp_frames = [frames[i % len(frames)] for i in range(n_warmup + n_frames)]
-    p2, filt2, sink2 = build_pipeline(tp_frames, labels_path, sync=False)
-    arrivals = []
-
-    sink2.new_data = lambda buf: arrivals.append(time.monotonic())
-    p2.run(timeout=600)
-    fps, fps_median = _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
-    # r1/r2 methodology for cross-round comparability: peak window with the
-    # EOS drain burst INCLUDED (the in-flight async_depth frames land in one
-    # burst at EOS; rounds 1-2 reported this, overstating steady state)
-    fps_r2_method, _ = _windowed_fps(arrivals, n_warmup, 0)
+    # -- throughput runs (async dispatch, end-to-end pipeline FPS) ----------- #
+    # >=3 repeats (VERDICT r3 #4): the tunnel swings 89-205 FPS run-to-run
+    # on identical code, so cross-round deltas need median-of-medians plus
+    # the observed spread, not a single shot
+    n_repeats = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    peaks, medians, r2_peaks = [], [], []
+    for rep in range(n_repeats):
+        _mark(f"throughput run {rep + 1}/{n_repeats} starting")
+        tp_frames = [frames[i % len(frames)]
+                     for i in range(n_warmup + n_frames)]
+        p2, filt2, sink2 = build_pipeline(tp_frames, labels_path,
+                                          sync=False)
+        arrivals = []
+        sink2.new_data = lambda buf: arrivals.append(time.monotonic())
+        p2.run(timeout=600)
+        rep_peak, rep_med = _windowed_fps(arrivals, n_warmup, DECODE_DEPTH)
+        # r1/r2 methodology for cross-round comparability: peak window
+        # with the EOS drain burst INCLUDED (overstates steady state)
+        rep_r2, _ = _windowed_fps(arrivals, n_warmup, 0)
+        if np.isfinite(rep_med):
+            peaks.append(rep_peak)
+            medians.append(rep_med)
+            r2_peaks.append(rep_r2)
+        _partial["fps_median_runs"] = [round(m, 2) for m in medians]
+    if not medians:
+        peaks = medians = r2_peaks = [float("nan")]
+    fps = float(np.max(peaks))
+    fps_median = float(np.median(medians))
+    fps_r2_method = float(np.max(r2_peaks))
 
     import jax
 
@@ -529,9 +748,13 @@ def main() -> None:
         "value": round(fps, 2),
         "unit": "frames/sec",
         "fps_median": round(fps_median, 2),
+        "fps_median_runs": [round(m, 2) for m in medians],
+        "fps_median_spread": [round(float(np.min(medians)), 2),
+                              round(float(np.max(medians)), 2)],
         "fps_peak_r2_method": round(fps_r2_method, 2),
         "p50_invoke_us": round(p50_us, 1),
         "frames": n_frames,
+        "repeats": n_repeats,
         "device": str(device),
     })
     if split is not None:
@@ -543,11 +766,13 @@ def main() -> None:
 
     if not cpu_child and os.environ.get("BENCH_CPU_REF", "1") != "0":
         _mark("same-host CPU reference starting")
-        cpu_fps = _cpu_reference()
-        if np.isfinite(cpu_fps) and cpu_fps > 0:
-            result["cpu_reference_fps"] = round(cpu_fps, 2)
-            result["vs_baseline"] = round(fps_median / cpu_fps, 3)
-            result["vs_baseline_kind"] = "speedup_vs_same_host_jax_cpu"
+        cpu = _cpu_reference()
+        result.update(cpu)
+        best = cpu.get("cpu_reference_best_fps")
+        if best:
+            result["vs_baseline"] = round(fps_median / best, 3)
+            result["vs_baseline_kind"] = \
+                "speedup_vs_strongest_same_host_jax_cpu"
     if "vs_baseline" not in result:
         # fallback: the 30 FPS real-time camera rate the reference
         # pipelines are built around
@@ -560,10 +785,12 @@ def main() -> None:
 
             with _tf.TemporaryDirectory() as td:
                 result.update(_extra_benches(td))
-            _mark("batched bench starting")
-            result.update(_batched_bench(labels_path))
+            _mark("batch sweep starting")
+            result.update(_batch_sweep(labels_path, flops, device))
             _mark("adaptive batch bench starting")
             result.update(_adaptive_bench(labels_path))
+            _mark("transformer prefill bench starting")
+            result.update(_transformer_bench())
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if flops and result.get("adaptive_batch16_fps_median"):
@@ -571,10 +798,6 @@ def main() -> None:
                     probes.mfu(flops,
                                result["adaptive_batch16_fps_median"],
                                device) or 0.0, 6)
-            if flops and result.get("batch8_fps_median"):
-                result["batch8_mfu"] = round(
-                    probes.mfu(flops, result["batch8_fps_median"], device)
-                    or 0.0, 6)
         except Exception:  # never lose the headline measurement
             import traceback
 

@@ -113,10 +113,11 @@ class TensorMetaInfo:
         if (version & _VERSION_MASK) != _VERSION_MASK:
             raise ValueError(f"bad meta version word 0x{version:08x} "
                              "(GST_TENSOR_META_VERSION_VALID fails)")
-        if not ((version & 0x00FFF000) & (1 << 12)):
-            # GST_TENSOR_META_IS_V1 (tensor_common.c:1487): only v1
-            # headers have a defined 128-byte layout; a future v2 must
-            # not be silently parsed with v1 field offsets
+        if ((version >> 12) & 0xFFF) != 1:
+            # only v1 headers have a defined 128-byte layout
+            # (GST_TENSOR_META_IS_V1, tensor_common.c:1487 — strict major
+            # equality here: the reference's bit-test would let a v3/v5
+            # header parse with v1 field offsets)
             raise ValueError(f"meta version word 0x{version:08x} is not v1")
         if dtype_c not in _CODE_TO_DTYPE:
             raise ValueError(f"unknown tensor_type enum {dtype_c}")

@@ -154,6 +154,15 @@ class TensorFilter(Element):
         cls = find_filter(fw_name)
         if cls is None:
             raise ValueError(f"tensor_filter: unknown framework {fw_name!r}")
+        in_layout = self._parse_layout(self.inputlayout)
+        out_layout = self._parse_layout(self.outputlayout)
+        if "nchw" in in_layout + out_layout and not cls.SUPPORTS_LAYOUT:
+            # a backend that ignores the declared layout would run
+            # unpermuted data and return silently wrong results
+            raise ValueError(
+                f"tensor_filter {self.name}: framework {fw_name!r} does "
+                "not implement NCHW layout conversion (the xla-tpu "
+                "backend does; torch models are NCHW-native already)")
         props = FilterProps(
             model=self.model,
             custom=self.custom,
@@ -161,8 +170,8 @@ class TensorFilter(Element):
             input_info=self._override_info(self.input, self.inputtype),
             output_info=self._override_info(self.output, self.outputtype),
             is_updatable=self.is_updatable,
-            input_layout=self._parse_layout(self.inputlayout),
-            output_layout=self._parse_layout(self.outputlayout),
+            input_layout=in_layout,
+            output_layout=out_layout,
         )
         if self.shared_tensor_filter_key:
             key = self.shared_tensor_filter_key

@@ -86,6 +86,10 @@ class FilterFramework:
     ALLOCATE_IN_INVOKE = True
     #: backend works without a model file (e.g. custom-easy callable)
     RUN_WITHOUT_MODEL = False
+    #: backend consumes inputlayout/outputlayout=NCHW (permutes data);
+    #: declaring NCHW on a backend that would silently ignore it is
+    #: rejected at open (tensor_filter element)
+    SUPPORTS_LAYOUT = False
 
     def __init__(self) -> None:
         self.props: Optional[FilterProps] = None

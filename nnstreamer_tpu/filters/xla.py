@@ -152,6 +152,12 @@ def _as_tuple(out: Any) -> Tuple[Any, ...]:
     return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
 
+def _active_layouts(layouts: Optional[Sequence[str]]) -> Tuple[str, ...]:
+    """Layout tuple → itself if any entry permutes (nchw), else ()."""
+    layouts = tuple(layouts or ())
+    return layouts if any(v == "nchw" for v in layouts) else ()
+
+
 def _layout_infos(infos: Optional[TensorsInfo],
                   layouts: Sequence[str]) -> Optional[TensorsInfo]:
     """Model-layout (NHWC) TensorsInfo → stream-layout: tensors declared
@@ -191,6 +197,7 @@ class XLAFilter(FilterFramework):
     ALIASES = ("xla", "jax", "tensorflow-lite", "tensorflow2-lite",
                "tensorflow1-lite", "tflite")
     ALLOCATE_IN_INVOKE = True
+    SUPPORTS_LAYOUT = True  # NCHW permutes fuse into the XLA program
 
     def __init__(self) -> None:
         super().__init__()
@@ -215,9 +222,11 @@ class XLAFilter(FilterFramework):
         self._bucket = int(opts.get("bucket", "0") or 0)
         # inputlayout/outputlayout=NCHW: the stream is channel-first while
         # XLA/zoo models are channel-last — the permutes compile INTO the
-        # XLA program (free to fuse, never a host-side copy)
-        self._in_layout = tuple(props.input_layout or ())
-        self._out_layout = tuple(props.output_layout or ())
+        # XLA program (free to fuse, never a host-side copy). Normalized
+        # to () unless something actually permutes, so none/any/nhwc
+        # declarations never cost the layout staging path.
+        self._in_layout = _active_layouts(props.input_layout)
+        self._out_layout = _active_layouts(props.output_layout)
         resize = opts.get("resize", "")
         if resize:
             parts = tuple(int(v) for v in resize.split(":"))

@@ -1,0 +1,74 @@
+"""Composite mesh-scale topology check: sharded serving under the real
+pipeline scheduler, behind the query offload layer.
+
+Shared by the driver's ``dryrun_multichip`` and the CPU-mesh test suite
+(tests/test_parallel.py) so the two stay in lockstep: client pipeline →
+TCP → tensor_query_serversrc → tensor_filter(sharded pjit program) →
+tensor_query_serversink → TCP → client, results exact vs the unsharded
+oracle.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def composite_sharded_query_check(bundle: Any, served: Any, batch: int,
+                                  size: int, n_frames: int = 3,
+                                  seed: int = 3, rtol: float = 2e-4,
+                                  atol: float = 2e-5) -> None:
+    """Serve ``served`` (a parallel.sharded_bundle of ``bundle``) inside a
+    full server Pipeline and stream ``n_frames`` uint8 frames through a
+    query client; every result must match ``bundle``'s unsharded oracle.
+    Raises AssertionError on any divergence."""
+    import jax
+    import numpy as np
+
+    from ..core.types import Caps, TensorsConfig, TensorsInfo
+    from ..graph import Pipeline
+
+    port = free_port()
+    dims = f"3:{size}:{size}:{batch}"
+    sp = Pipeline("mesh-server")
+    ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                      port=port, id=0, dims=dims, types="uint8")
+    sfilt = sp.add_new("tensor_filter", framework="xla-tpu", model=served)
+    ssink = sp.add_new("tensor_query_serversink", id=0)
+    Pipeline.link(ssrc, sfilt, ssink)
+    sp.start()
+    try:
+        time.sleep(0.3)
+        rng = np.random.default_rng(seed)
+        # uint8 frames: the zoo serving contract (in_info uint8; the
+        # [-1,1] preprocess runs inside the compiled program)
+        frames = [rng.integers(0, 255, (batch, size, size, 3))
+                  .astype(np.uint8) for _ in range(n_frames)]
+        cp = Pipeline("mesh-client")
+        caps = Caps.tensors(
+            TensorsConfig(TensorsInfo.from_strings(dims, "uint8")))
+        csrc = cp.add_new("appsrc", caps=caps, data=list(frames))
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1",
+                        port=port, timeout_s=120.0)
+        csink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(csrc, qc, csink)
+        cp.run(timeout=300)
+        assert csink.num_buffers == n_frames, \
+            f"composite: {csink.num_buffers}/{n_frames} frames returned"
+        oracle = jax.jit(bundle.fn())
+        for i, fx in enumerate(frames):
+            got = csink.buffers[i].memories[0].host()
+            ref = np.asarray(oracle(fx))
+            assert np.allclose(got, ref, rtol=rtol, atol=atol), \
+                f"composite sharded pipeline frame {i} diverged"
+    finally:
+        sp.stop()

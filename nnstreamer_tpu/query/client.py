@@ -69,10 +69,12 @@ class TensorQueryClient(Element):
         self._reader_error: Optional[Exception] = None
         self._pong = False
         # _pending entries are mutable [pts, duration, offset, sent]
-        # records; `sent` flips True under _cv immediately after
-        # send_message returns, so the reader counts lost frames exactly
-        # per entry (an aggregate counter would race the flip and
-        # misattribute a just-sent frame as never-transmitted)
+        # records; `sent` flips True under _cv once send_message returns.
+        # The reader's error path counts only sent entries as lost; a
+        # frame whose send raced the connection death is caught by its
+        # own chain call via _reader_dead (see _reader_loop / the
+        # post-send check in _chain_pipelined) — no silent-loss window.
+        self._reader_dead = False
         self._last_activity = 0.0
         #: reused connections idle longer than this get a PING/PONG probe
         #: before the next frame (a peer that died while idle is only
@@ -192,9 +194,13 @@ class TensorQueryClient(Element):
                     self._cv.notify_all()
         except (ConnectionError, OSError, QueryProtocolError) as e:
             with self._cv:
-                # SENT frames are lost; entries never transmitted (a chain
-                # call mid-send-failure) are NOT — their chain call pops
-                # and retries them itself
+                # SENT frames (send_message returned) are lost; entries
+                # still mid-send are NOT counted — their chain call owns
+                # them: either its send raises (it pops and retries) or
+                # its send "succeeded" into a dead connection, which it
+                # detects via _reader_dead after flipping the sent flag
+                # (closing the silent-loss window either way)
+                self._reader_dead = True
                 lost = sum(1 for entry in self._pending if entry[3])
                 if lost > 0 or not isinstance(e, OSError):
                     self._reader_error = e
@@ -202,6 +208,16 @@ class TensorQueryClient(Element):
                                     f"{lost} in flight: {e}", exc=e)
                     self._pending.clear()
                 self._cv.notify_all()
+
+    def _remove_entry(self, entry) -> None:
+        """Remove a pending record by IDENTITY (value equality would
+        delete a different in-flight frame with equal pts/dur/offset —
+        e.g. two untimestamped frames); no-op if the reader's error path
+        already cleared the deque."""
+        for i, e in enumerate(self._pending):
+            if e is entry:
+                del self._pending[i]
+                return
 
     def _reset_conn(self) -> None:
         """Drop the connection + reader so the next attempt dials fresh.
@@ -256,6 +272,7 @@ class TensorQueryClient(Element):
             sock = self._sock
             fresh = self._reader is None
             if fresh:
+                self._reader_dead = False
                 # the reader blocks in recv indefinitely (stop() unblocks
                 # it via shutdown); the connect timeout must NOT ride
                 # along or a >timeout_s gap between results (e.g. a
@@ -282,15 +299,24 @@ class TensorQueryClient(Element):
             try:
                 send_message(sock, Cmd.DATA, meta, payload)
                 with self._cv:
-                    entry[3] = True  # on the wire: reader now owns its fate
+                    entry[3] = True  # on the wire: reader owns its fate
+                    if self._reader_error is not None or self._reader_dead:
+                        # the connection died around this send and the
+                        # reader could not have counted this entry (it
+                        # was unsent when the reader examined pending):
+                        # report the possible loss here instead of
+                        # silently returning OK
+                        if self._reader_error is None:
+                            self.post_error(
+                                "query connection lost with a frame "
+                                "just handed to the transport")
+                        self._remove_entry(entry)
+                        return FlowReturn.ERROR
                 self._last_activity = time.monotonic()
                 return FlowReturn.OK
             except OSError:
                 with self._cv:
-                    try:
-                        self._pending.remove(entry)  # never went out
-                    except ValueError:
-                        pass  # reader error path already cleared it
+                    self._remove_entry(entry)  # never went out
                     others = bool(self._pending)
                 if others or self._reader_error is not None:
                     # sent frames are (or already were) reported lost

@@ -63,3 +63,12 @@ def test_cli_entry(tmp_path, capsys):
     out = capsys.readouterr().out
     assert str(tmp_path / "gencli.py") in out
     assert main(["gencli", "--dir", str(tmp_path)]) == 1  # exists
+
+
+def test_second_c_filter_shares_makefile(tmp_path):
+    generate("f_one", "c", str(tmp_path))
+    generate("f_two", "c", str(tmp_path))  # Makefile reused, no collision
+    subprocess.run(["make", "-C", str(tmp_path)], check=True,
+                   capture_output=True)
+    assert (tmp_path / "libf_one.so").exists()
+    assert (tmp_path / "libf_two.so").exists()

@@ -142,3 +142,15 @@ def test_fused_transform_runs_before_layout_permute():
     fused, unfused = run(True), run(False)
     assert fused.shape == unfused.shape
     np.testing.assert_allclose(fused, unfused)
+
+
+def test_nchw_rejected_on_backend_without_layout_support(tmp_path):
+    """A backend that would silently ignore the declared layout must be
+    rejected at open, not run unpermuted data."""
+    from nnstreamer_tpu.codegen import generate
+    from nnstreamer_tpu.elements.filter import TensorFilter
+
+    (path,) = generate("layoutless", "py", str(tmp_path))
+    filt = TensorFilter(framework="python3", model=path, inputlayout="NCHW")
+    with pytest.raises(ValueError, match="NCHW layout"):
+        filt._open_fw()

@@ -376,3 +376,23 @@ def test_gpipe_rejects_stage_count_mismatch():
     pp = make_gpipe_apply(lambda p, h: h @ p["w"], mesh)
     with pytest.raises(ValueError, match="stages"):
         pp(stacked, jnp.zeros((8, 4)))
+
+
+def test_composite_sharded_pipeline_with_query_offload():
+    """The composite topology at mesh scale (VERDICT r3 #7): a
+    sharded_bundle filter served INSIDE a full Pipeline behind the query
+    offload layer, concurrently with the pipeline scheduler — results
+    exact vs the unsharded oracle (shared helper, same code the driver's
+    dryrun_multichip runs)."""
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import sharded_bundle
+    from nnstreamer_tpu.parallel.composite import (
+        composite_sharded_query_check,
+    )
+
+    mesh = auto_mesh_2d(8)
+    batch, size = 8, 16
+    bundle = get_model(f"zoo://mobilenet_v2?width=0.25&size={size}"
+                       f"&num_classes=8&batch={batch}&dtype=float32")
+    served = sharded_bundle(bundle, mesh)
+    composite_sharded_query_check(bundle, served, batch, size)

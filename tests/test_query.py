@@ -202,7 +202,7 @@ Pipeline.link(ssrc, f, ssink)
 p.start()
 print("READY", flush=True)
 import time
-time.sleep(20)
+time.sleep(60)  # lifetime window; the test terminates us once done
 p.stop()
 """
         import os
