@@ -22,6 +22,10 @@ from .base import FilterFramework, FilterProps, register_filter
 class TorchFilter(FilterFramework):
     NAME = "torch"
     ALIASES = ("pytorch",)
+    #: torch convnets consume channel-first data natively, so declaring
+    #: inputlayout/outputlayout=NCHW is a correct no-op (the data already
+    #: matches the model) — accept it rather than reject at open
+    SUPPORTS_LAYOUT = True
     ALLOCATE_IN_INVOKE = True
 
     def __init__(self) -> None:

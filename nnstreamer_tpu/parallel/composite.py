@@ -10,17 +10,8 @@ oracle.
 
 from __future__ import annotations
 
-import socket
 import time
 from typing import Any
-
-
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def composite_sharded_query_check(bundle: Any, served: Any, batch: int,
@@ -37,17 +28,22 @@ def composite_sharded_query_check(bundle: Any, served: Any, batch: int,
     from ..core.types import Caps, TensorsConfig, TensorsInfo
     from ..graph import Pipeline
 
-    port = free_port()
     dims = f"3:{size}:{size}:{batch}"
     sp = Pipeline("mesh-server")
+    # port=0: the OS assigns and serversrc publishes bound_port — no
+    # probe-close-rebind race
     ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
-                      port=port, id=0, dims=dims, types="uint8")
+                      port=0, id=0, dims=dims, types="uint8")
     sfilt = sp.add_new("tensor_filter", framework="xla-tpu", model=served)
     ssink = sp.add_new("tensor_query_serversink", id=0)
     Pipeline.link(ssrc, sfilt, ssink)
     sp.start()
     try:
-        time.sleep(0.3)
+        deadline = time.monotonic() + 10
+        while not hasattr(ssrc, "bound_port") \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        port = ssrc.bound_port
         rng = np.random.default_rng(seed)
         # uint8 frames: the zoo serving contract (in_info uint8; the
         # [-1,1] preprocess runs inside the compiled program)

@@ -1,0 +1,557 @@
+"""Op-level goldens for the TFLite importer.
+
+Each test BUILDS a minimal single-op .tflite flatbuffer in memory (using
+the flatbuffers runtime's low-level object API with the public schema's
+field ids — the same ids models/tflite_import.py reads) and checks the
+lowered JAX function against a hand-computed numpy oracle. This pins the
+op semantics (padding conventions, depthwise grouping, count-valid
+average pooling, resize coordinate modes, quantization) independently of
+the big reference models.
+"""
+
+import flatbuffers
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models.tflite_import import load_tflite, parse_tflite
+
+F32, UINT8, INT32 = 0, 3, 2  # schema TensorType
+
+
+# --------------------------------------------------------------------------- #
+# Minimal in-memory tflite builder (single subgraph)
+# --------------------------------------------------------------------------- #
+
+
+def _vec_i32(b, values):
+    b.StartVector(4, len(values), 4)
+    for v in reversed(values):
+        b.PrependInt32(int(v))
+    return b.EndVector()
+
+
+def _vec_f32(b, values):
+    b.StartVector(4, len(values), 4)
+    for v in reversed(values):
+        b.PrependFloat32(float(v))
+    return b.EndVector()
+
+
+def _vec_i64(b, values):
+    b.StartVector(8, len(values), 8)
+    for v in reversed(values):
+        b.PrependInt64(int(v))
+    return b.EndVector()
+
+
+def _vec_offsets(b, offs):
+    b.StartVector(4, len(offs), 4)
+    for o in reversed(offs):
+        b.PrependUOffsetTRelative(o)
+    return b.EndVector()
+
+
+def _quant(b, scale, zero_point, axis=0):
+    scale_off = _vec_f32(b, np.atleast_1d(scale))
+    zp_off = _vec_i64(b, np.atleast_1d(zero_point))
+    b.StartObject(7)
+    b.PrependUOffsetTRelativeSlot(2, scale_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, zp_off, 0)
+    b.PrependInt32Slot(6, int(axis), 0)
+    return b.EndObject()
+
+
+def build_tflite(tensors, operators, inputs, outputs):
+    """tensors: list of dicts {shape, type, data(np or None), quant
+    (scale, zp[, axis]) or None}; operators: list of dicts {code,
+    inputs, outputs, options: (union_type, builder_fn) or None}.
+    Returns serialized .tflite bytes."""
+    b = flatbuffers.Builder(4096)
+
+    # buffers: index 0 is the canonical empty buffer
+    buffer_offsets = []
+    b.StartObject(1)
+    buffer_offsets.append(b.EndObject())
+    tensor_buffer_idx = []
+    for t in tensors:
+        data = t.get("data")
+        if data is None:
+            tensor_buffer_idx.append(0)
+            continue
+        raw = np.ascontiguousarray(data).tobytes()
+        data_off = b.CreateByteVector(raw)
+        b.StartObject(1)            # Buffer: 0 data
+        b.PrependUOffsetTRelativeSlot(0, data_off, 0)
+        buffer_offsets.append(b.EndObject())
+        tensor_buffer_idx.append(len(buffer_offsets) - 1)
+
+    tensor_offsets = []
+    for t, bufidx in zip(tensors, tensor_buffer_idx):
+        shape_off = _vec_i32(b, t["shape"])
+        name_off = b.CreateString(t.get("name", "t"))
+        q = t.get("quant")
+        q_off = _quant(b, *q) if q else None
+        b.StartObject(8)            # Tensor
+        b.PrependUOffsetTRelativeSlot(0, shape_off, 0)
+        b.PrependInt8Slot(1, t["type"], 0)
+        b.PrependUint32Slot(2, bufidx, 0)
+        b.PrependUOffsetTRelativeSlot(3, name_off, 0)
+        if q_off is not None:
+            b.PrependUOffsetTRelativeSlot(4, q_off, 0)
+        tensor_offsets.append(b.EndObject())
+
+    opcode_offsets = []
+    codes = []
+    for op in operators:
+        if op["code"] not in codes:
+            codes.append(op["code"])
+    for code in codes:
+        b.StartObject(4)            # OperatorCode
+        b.PrependInt8Slot(0, min(code, 127), 0)
+        b.PrependInt32Slot(3, code, 0)
+        opcode_offsets.append(b.EndObject())
+
+    operator_offsets = []
+    for op in operators:
+        ins_off = _vec_i32(b, op["inputs"])
+        outs_off = _vec_i32(b, op["outputs"])
+        opt = op.get("options")
+        opt_off = opt[1](b) if opt else None
+        b.StartObject(9)            # Operator
+        b.PrependUint32Slot(0, codes.index(op["code"]), 0)
+        b.PrependUOffsetTRelativeSlot(1, ins_off, 0)
+        b.PrependUOffsetTRelativeSlot(2, outs_off, 0)
+        if opt is not None:
+            b.PrependUint8Slot(3, opt[0], 0)       # builtin_options_type
+            b.PrependUOffsetTRelativeSlot(4, opt_off, 0)
+        operator_offsets.append(b.EndObject())
+
+    tensors_off = _vec_offsets(b, tensor_offsets)
+    sg_in_off = _vec_i32(b, inputs)
+    sg_out_off = _vec_i32(b, outputs)
+    operators_off = _vec_offsets(b, operator_offsets)
+    b.StartObject(5)                # SubGraph
+    b.PrependUOffsetTRelativeSlot(0, tensors_off, 0)
+    b.PrependUOffsetTRelativeSlot(1, sg_in_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, sg_out_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, operators_off, 0)
+    sg_off = b.EndObject()
+
+    subgraphs_off = _vec_offsets(b, [sg_off])
+    opcodes_off = _vec_offsets(b, opcode_offsets)
+    buffers_off = _vec_offsets(b, buffer_offsets)
+    desc_off = b.CreateString("unit-test model")
+    b.StartObject(8)                # Model
+    b.PrependUint32Slot(0, 3, 0)
+    b.PrependUOffsetTRelativeSlot(1, opcodes_off, 0)
+    b.PrependUOffsetTRelativeSlot(2, subgraphs_off, 0)
+    b.PrependUOffsetTRelativeSlot(3, desc_off, 0)
+    b.PrependUOffsetTRelativeSlot(4, buffers_off, 0)
+    model = b.EndObject()
+    b.Finish(model, b"TFL3")
+    return bytes(b.Output())
+
+
+def _run(blob_bytes, tmp_path, *inputs):
+    import jax
+
+    path = tmp_path / "m.tflite"
+    path.write_bytes(blob_bytes)
+    bundle = load_tflite(str(path))
+    outs = jax.jit(bundle.fn())(*inputs)
+    return [np.asarray(o) for o in outs]
+
+
+# options builders ----------------------------------------------------------- #
+
+def conv_options(stride=1, padding=0, activation=0, dilation=1):
+    def build(b):
+        b.StartObject(6)            # Conv2DOptions
+        b.PrependInt8Slot(0, padding, 0)
+        b.PrependInt32Slot(1, stride, 1)
+        b.PrependInt32Slot(2, stride, 1)
+        b.PrependInt8Slot(3, activation, 0)
+        b.PrependInt32Slot(4, dilation, 1)
+        b.PrependInt32Slot(5, dilation, 1)
+        return b.EndObject()
+
+    return (1, build)               # BuiltinOptions.Conv2DOptions
+
+
+def dwconv_options(stride=1, padding=0, mult=1, activation=0):
+    def build(b):
+        b.StartObject(7)            # DepthwiseConv2DOptions
+        b.PrependInt8Slot(0, padding, 0)
+        b.PrependInt32Slot(1, stride, 1)
+        b.PrependInt32Slot(2, stride, 1)
+        b.PrependInt32Slot(3, mult, 1)
+        b.PrependInt8Slot(4, activation, 0)
+        return b.EndObject()
+
+    return (2, build)
+
+
+def pool_options(filt=2, stride=2, padding=0):
+    def build(b):
+        b.StartObject(6)            # Pool2DOptions
+        b.PrependInt8Slot(0, padding, 0)
+        b.PrependInt32Slot(1, stride, 1)
+        b.PrependInt32Slot(2, stride, 1)
+        b.PrependInt32Slot(3, filt, 1)
+        b.PrependInt32Slot(4, filt, 1)
+        return b.EndObject()
+
+    return (5, build)
+
+
+def resize_bilinear_options(align_corners=False, half_pixel=False):
+    def build(b):
+        b.StartObject(4)            # ResizeBilinearOptions
+        b.PrependBoolSlot(2, align_corners, 0)
+        b.PrependBoolSlot(3, half_pixel, 0)
+        return b.EndObject()
+
+    return (15, build)
+
+
+def fc_options(activation=0):
+    def build(b):
+        b.StartObject(5)            # FullyConnectedOptions
+        b.PrependInt8Slot(0, activation, 0)
+        return b.EndObject()
+
+    return (8, build)
+
+
+def reducer_options(keep_dims=False):
+    def build(b):
+        b.StartObject(1)            # ReducerOptions
+        b.PrependBoolSlot(0, keep_dims, 0)
+        return b.EndObject()
+
+    return (27, build)
+
+
+# --------------------------------------------------------------------------- #
+# Oracles (pure numpy)
+# --------------------------------------------------------------------------- #
+
+
+def np_conv2d(x, w, stride, padding):
+    """NHWC x, OHWI w → NHWC, VALID or tflite-SAME padding."""
+    n, h, wid, cin = x.shape
+    co, kh, kw, _ = w.shape
+    if padding == "SAME":
+        oh = -(-h // stride)
+        ow = -(-wid // stride)
+        ph = max((oh - 1) * stride + kh - h, 0)
+        pw = max((ow - 1) * stride + kw - wid, 0)
+        x = np.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                       (pw // 2, pw - pw // 2), (0, 0)))
+    else:
+        oh = (h - kh) // stride + 1
+        ow = (wid - kw) // stride + 1
+    out = np.zeros((n, oh, ow, co), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.einsum("nhwc,ohwc->no", patch, w)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Tests
+# --------------------------------------------------------------------------- #
+
+
+def test_conv2d_valid_stride1(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 5, 5, 2)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 2, 2)).astype(np.float32)
+    bias = rng.standard_normal(3).astype(np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 5, 5, 2), type=F32),
+            dict(shape=(3, 2, 2, 2), type=F32, data=w),
+            dict(shape=(3,), type=F32, data=bias),
+            dict(shape=(1, 4, 4, 3), type=F32),
+        ],
+        operators=[dict(code=3, inputs=[0, 1, 2], outputs=[3],
+                        options=conv_options(padding=1))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out, np_conv2d(x, w, 1, "VALID") + bias,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_same_stride2(tmp_path):
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 5, 5, 1)).astype(np.float32)
+    w = rng.standard_normal((1, 3, 3, 1)).astype(np.float32)
+    bias = np.zeros(1, np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 5, 5, 1), type=F32),
+            dict(shape=(1, 3, 3, 1), type=F32, data=w),
+            dict(shape=(1,), type=F32, data=bias),
+            dict(shape=(1, 3, 3, 1), type=F32),
+        ],
+        operators=[dict(code=3, inputs=[0, 1, 2], outputs=[3],
+                        options=conv_options(stride=2, padding=0))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out, np_conv2d(x, w, 2, "SAME"),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_fused_relu6(tmp_path):
+    x = np.full((1, 2, 2, 1), 10.0, np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    b0 = np.zeros(1, np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 2, 2, 1), type=F32),
+            dict(shape=(1, 1, 1, 1), type=F32, data=w),
+            dict(shape=(1,), type=F32, data=b0),
+            dict(shape=(1, 2, 2, 1), type=F32),
+        ],
+        operators=[dict(code=3, inputs=[0, 1, 2], outputs=[3],
+                        options=conv_options(padding=1, activation=3))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    assert np.all(out == 6.0)  # RELU6 clamp
+
+
+def test_depthwise_conv_identity_per_channel(tmp_path):
+    """3-channel depthwise with one-hot 1x1 kernels = identity per
+    channel scaled by channel index."""
+    x = np.arange(2 * 2 * 3, dtype=np.float32).reshape(1, 2, 2, 3)
+    # dw kernel (1, kh, kw, cin*mult): scale channel c by (c+1)
+    w = np.array([1.0, 2.0, 3.0], np.float32).reshape(1, 1, 1, 3)
+    b0 = np.zeros(3, np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 2, 2, 3), type=F32),
+            dict(shape=(1, 1, 1, 3), type=F32, data=w),
+            dict(shape=(3,), type=F32, data=b0),
+            dict(shape=(1, 2, 2, 3), type=F32),
+        ],
+        operators=[dict(code=4, inputs=[0, 1, 2], outputs=[3],
+                        options=dwconv_options(padding=1))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out, x * np.array([1.0, 2.0, 3.0]),
+                               rtol=1e-6)
+
+
+def test_average_pool_same_counts_valid_only(tmp_path):
+    """SAME avg pooling divides edge windows by the number of IN-BOUNDS
+    elements (tflite semantics), not the full window size."""
+    x = np.ones((1, 3, 3, 1), np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 3, 3, 1), type=F32),
+            dict(shape=(1, 2, 2, 1), type=F32),
+        ],
+        operators=[dict(code=1, inputs=[0], outputs=[1],
+                        options=pool_options(filt=2, stride=2, padding=0))],
+        inputs=[0], outputs=[1])
+    (out,) = _run(blob, tmp_path, x)
+    # all-ones input: count-valid average is exactly 1 everywhere,
+    # full-window division would give 0.25/0.5 at the padded edges
+    np.testing.assert_allclose(out, np.ones((1, 2, 2, 1)), rtol=1e-6)
+
+
+def test_max_pool_valid(tmp_path):
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 4, 4, 1), type=F32),
+            dict(shape=(1, 2, 2, 1), type=F32),
+        ],
+        operators=[dict(code=17, inputs=[0], outputs=[1],
+                        options=pool_options(filt=2, stride=2, padding=1))],
+        inputs=[0], outputs=[1])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_array_equal(
+        out.reshape(2, 2), [[5, 7], [13, 15]])
+
+
+@pytest.mark.parametrize("align,half,expected", [
+    # upscale [0, 1] (1x2) to 1x4 under each coordinate convention
+    (False, False, [0.0, 0.5, 1.0, 1.0]),      # legacy: x*w/ow
+    (True, False, [0.0, 1 / 3, 2 / 3, 1.0]),   # align_corners
+    (False, True, [0.0, 0.25, 0.75, 1.0]),     # half_pixel_centers
+])
+def test_resize_bilinear_coordinate_modes(tmp_path, align, half, expected):
+    x = np.array([0.0, 1.0], np.float32).reshape(1, 1, 2, 1)
+    size = np.array([1, 4], np.int32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 1, 2, 1), type=F32),
+            dict(shape=(2,), type=INT32, data=size),
+            dict(shape=(1, 1, 4, 1), type=F32),
+        ],
+        operators=[dict(code=23, inputs=[0, 1], outputs=[2],
+                        options=resize_bilinear_options(align, half))],
+        inputs=[0], outputs=[2])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out.reshape(-1), expected, atol=1e-6)
+
+
+def test_fully_connected(tmp_path):
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 4)).astype(np.float32)
+    w = rng.standard_normal((3, 4)).astype(np.float32)   # (out, in)
+    bias = rng.standard_normal(3).astype(np.float32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 4), type=F32),
+            dict(shape=(3, 4), type=F32, data=w),
+            dict(shape=(3,), type=F32, data=bias),
+            dict(shape=(1, 3), type=F32),
+        ],
+        operators=[dict(code=9, inputs=[0, 1, 2], outputs=[3],
+                        options=fc_options(activation=1))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out, np.maximum(x @ w.T + bias, 0.0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mean_keep_dims(tmp_path):
+    x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+    axes = np.array([1, 2], np.int32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 2, 3, 4), type=F32),
+            dict(shape=(2,), type=INT32, data=axes),
+            dict(shape=(1, 1, 1, 4), type=F32),
+        ],
+        operators=[dict(code=40, inputs=[0, 1], outputs=[2],
+                        options=reducer_options(keep_dims=True))],
+        inputs=[0], outputs=[2])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out, x.mean(axis=(1, 2), keepdims=True),
+                               rtol=1e-6)
+
+
+def test_pad_and_concat(tmp_path):
+    x = np.ones((1, 2, 2, 1), np.float32)
+    pads = np.array([[0, 0], [1, 1], [1, 1], [0, 0]], np.int32)
+
+    def concat_opts(b):
+        b.StartObject(2)            # ConcatenationOptions: 0 axis
+        b.PrependInt32Slot(0, 3, 0)
+        return b.EndObject()
+
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 2, 2, 1), type=F32),
+            dict(shape=(4, 2), type=INT32, data=pads),
+            dict(shape=(1, 4, 4, 1), type=F32),
+            dict(shape=(1, 4, 4, 2), type=F32),
+        ],
+        operators=[
+            dict(code=34, inputs=[0, 1], outputs=[2]),           # PAD
+            dict(code=2, inputs=[2, 2], outputs=[3],             # CONCAT
+                 options=(10, concat_opts)),
+        ],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    padded = np.pad(x, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    np.testing.assert_allclose(out, np.concatenate([padded, padded], -1))
+
+
+def test_quantized_conv_per_tensor(tmp_path):
+    """uint8 conv with per-tensor quant: dequantized-float execution with
+    output grid snapping must match the affine-arithmetic oracle."""
+    x_q = np.array([[130, 126], [128, 132]], np.uint8).reshape(1, 2, 2, 1)
+    in_scale, in_zp = 0.5, 128
+    w_q = np.array([3], np.uint8).reshape(1, 1, 1, 1)  # real: (3-2)*1 = 1
+    w_scale, w_zp = 1.0, 2
+    bias_q = np.array([4], np.int32)                    # real: 4*0.5 = 2
+    out_scale, out_zp = 0.25, 10
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 2, 2, 1), type=UINT8, quant=(in_scale, in_zp)),
+            dict(shape=(1, 1, 1, 1), type=UINT8, data=w_q,
+                 quant=(w_scale, w_zp)),
+            dict(shape=(1,), type=INT32, data=bias_q,
+                 quant=(in_scale * w_scale, 0)),
+            dict(shape=(1, 2, 2, 1), type=UINT8,
+                 quant=(out_scale, out_zp)),
+        ],
+        operators=[dict(code=3, inputs=[0, 1, 2], outputs=[3],
+                        options=conv_options(padding=1))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x_q)
+    real_in = (x_q.astype(np.float32) - in_zp) * in_scale
+    real = real_in * 1.0 + 2.0                      # w_real=1, b_real=2
+    expect_q = np.clip(np.round(real / out_scale + out_zp), 0, 255)
+    np.testing.assert_array_equal(out.astype(np.int32),
+                                  expect_q.astype(np.int32))
+
+
+def test_quantized_conv_per_channel_weights(tmp_path):
+    """int8-style per-channel weight scales along the output-channel
+    axis (quantized_dimension=0 for OHWI)."""
+    x = np.ones((1, 1, 1, 2), np.float32)
+    # two output channels; quantized weights all 2 with per-channel
+    # scales [1, 0.5] and zero_points 0 → real kernels [2,2] and [1,1]
+    w_q = np.full((2, 1, 1, 2), 2, np.int8)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 1, 1, 2), type=F32),
+            dict(shape=(2, 1, 1, 2), type=9, data=w_q,   # INT8
+                 quant=([1.0, 0.5], [0, 0], 0)),
+            dict(shape=(2,), type=F32, data=np.zeros(2, np.float32)),
+            dict(shape=(1, 1, 1, 2), type=F32),
+        ],
+        operators=[dict(code=3, inputs=[0, 1, 2], outputs=[3],
+                        options=conv_options(padding=1))],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_allclose(out.reshape(-1), [4.0, 2.0], rtol=1e-6)
+
+
+def test_softmax_argmax_chain(tmp_path):
+    x = np.array([[1.0, 3.0, 2.0]], np.float32)
+    ax = np.array(1, np.int32)
+
+    def softmax_opts(b):
+        b.StartObject(1)
+        b.PrependFloat32Slot(0, 1.0, 0.0)
+        return b.EndObject()
+
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(1, 3), type=F32),
+            dict(shape=(1, 3), type=F32),
+            dict(shape=(), type=INT32, data=ax),
+            dict(shape=(1,), type=INT32),
+        ],
+        operators=[
+            dict(code=25, inputs=[0], outputs=[1], options=(9, softmax_opts)),
+            dict(code=56, inputs=[1, 2], outputs=[3]),
+        ],
+        inputs=[0], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    assert out.reshape(()) == 1
+
+
+def test_unsupported_op_reports_name(tmp_path):
+    blob = build_tflite(
+        tensors=[dict(shape=(1, 4), type=F32), dict(shape=(1, 4), type=F32)],
+        operators=[dict(code=16, inputs=[0], outputs=[1])],   # LSTM
+        inputs=[0], outputs=[1])
+    path = tmp_path / "m.tflite"
+    path.write_bytes(blob)
+    m = parse_tflite(str(path))
+    assert m.operators[0].op == "UNKNOWN_16"  # LSTM: outside the subset
+    with pytest.raises(NotImplementedError):
+        import jax
+
+        bundle = load_tflite(str(path))
+        jax.jit(bundle.fn())(np.zeros((1, 4), np.float32))
