@@ -16,6 +16,12 @@ ONE invoke, then restore the per-frame stream.
     frame on an idle stream is delayed at most the budget). Partial groups
     are padded by repeating the last frame: downstream XLA sees exactly one
     static shape (one compile), and the pad rows are dropped at unbatch.
+  * ``budget_ms=0`` — AUTO budget: the deadline adapts to the observed
+    inter-arrival rate (EMA), targeting ``~1.3 × max_batch × interval`` so
+    groups normally FILL before flushing. A fixed budget shorter than the
+    group fill time makes every group partial and its padding pure waste
+    (docs/performance.md "when adaptive batching pays"); auto sizes the
+    window from the stream itself, clamped to [2 ms, 500 ms].
   * ``tensor_unbatch`` — splits a batched buffer back into per-frame
     buffers (device-resident slices — no D2H), restoring each frame's
     PTS/offset from the batch metadata.
@@ -55,13 +61,22 @@ class TensorBatch(Element):
 
     def __init__(self, name: Optional[str] = None, **props: Any):
         self.max_batch = 8
-        self.budget_ms = 5.0
+        self.budget_ms = 5.0  # 0 = auto (adapt to the arrival rate)
         #: producer-side bound (frames) before backpressure blocks upstream
         self.max_pending = 0  # 0 = 4 * max_batch
         super().__init__(name, **props)
+        #: observability: groups emitted and valid frames grouped (the
+        #: ratio exposes pad waste — frames_grouped / (groups * max_batch))
+        self.groups_emitted = 0
+        self.frames_grouped = 0
+        self._ema_interval: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         if self.max_batch < 1:
             raise ValueError(f"tensor_batch: max_batch must be >= 1, "
                              f"got {self.max_batch}")
+        if self.budget_ms < 0:
+            raise ValueError(f"tensor_batch: budget_ms must be >= 0 "
+                             f"(0 = auto), got {self.budget_ms}")
         self.add_sink_pad(template=Caps.any_tensors())
         self.add_src_pad(template=Caps.any_tensors())
         self._dq: collections.deque = collections.deque()
@@ -127,6 +142,15 @@ class TensorBatch(Element):
         bound = self.max_pending or 4 * self.max_batch
         with self._cv:
             if isinstance(item, Buffer):
+                now = time.monotonic()
+                if self._last_arrival is not None:
+                    gap = now - self._last_arrival
+                    # EMA of inter-arrival for the auto budget; ignore
+                    # idle gaps (>1 s) — they are stream pauses, not rate
+                    if gap < 1.0:
+                        self._ema_interval = gap if self._ema_interval \
+                            is None else 0.8 * self._ema_interval + 0.2 * gap
+                self._last_arrival = now
                 while not self._flushing and \
                         sum(1 for it in self._dq
                             if isinstance(it, Buffer)) >= bound:
@@ -135,6 +159,17 @@ class TensorBatch(Element):
                 return
             self._dq.append(item)
             self._cv.notify_all()
+
+    def _budget_s(self) -> float:
+        """Flush window for a new group. Fixed budget unless budget_ms=0
+        (auto): ~1.3 × the time the stream needs to FILL max_batch at its
+        observed rate, so groups normally reach full size and padding
+        stays exceptional (see module doc)."""
+        if self.budget_ms > 0:
+            return self.budget_ms / 1000.0
+        interval = self._ema_interval if self._ema_interval is not None \
+            else 0.005
+        return min(max(1.3 * self.max_batch * interval, 0.002), 0.5)
 
     def _quit_worker(self) -> None:
         """Mark the element flushing before the worker exits early, so
@@ -175,7 +210,7 @@ class TensorBatch(Element):
                 elif isinstance(item, Buffer):
                     group.append(item)
                     if len(group) == 1:
-                        deadline = time.monotonic() + self.budget_ms / 1000.0
+                        deadline = time.monotonic() + self._budget_s()
                     if len(group) >= self.max_batch:
                         if self._emit(group) is not FlowReturn.OK:
                             self._quit_worker()
@@ -203,6 +238,8 @@ class TensorBatch(Element):
 
     def _emit(self, group: List[Buffer]) -> FlowReturn:
         n = len(group)
+        self.groups_emitted += 1
+        self.frames_grouped += n
         # pad by repeating the last frame: ONE static shape downstream
         frames = group + [group[-1]] * (self.max_batch - n)
         mems: List[TensorMemory] = []

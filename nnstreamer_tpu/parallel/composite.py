@@ -10,7 +10,6 @@ oracle.
 
 from __future__ import annotations
 
-import time
 from typing import Any
 
 
@@ -39,11 +38,9 @@ def composite_sharded_query_check(bundle: Any, served: Any, batch: int,
     Pipeline.link(ssrc, sfilt, ssink)
     sp.start()
     try:
-        deadline = time.monotonic() + 10
-        while not hasattr(ssrc, "bound_port") \
-                and time.monotonic() < deadline:
-            time.sleep(0.05)
-        port = ssrc.bound_port
+        from ..query.server import wait_bound_port
+
+        port = wait_bound_port(ssrc)
         rng = np.random.default_rng(seed)
         # uint8 frames: the zoo serving contract (in_info uint8; the
         # [-1,1] preprocess runs inside the compiled program)

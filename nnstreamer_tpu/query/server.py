@@ -41,6 +41,23 @@ _pairs_lock = threading.Lock()
 _server_pairs: Dict[int, "TensorQueryServerSrc"] = {}
 
 
+def wait_bound_port(src: "TensorQueryServerSrc",
+                    timeout_s: float = 10.0) -> int:
+    """Block until a started serversrc has bound its listener (it binds in
+    negotiate() on the src thread) and return the real port. Raises
+    RuntimeError — naming the element — on timeout, e.g. when negotiation
+    failed, instead of the bare AttributeError a direct ``src.bound_port``
+    read would produce."""
+    deadline = time.monotonic() + timeout_s
+    while not hasattr(src, "bound_port"):
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"{src.name}: serversrc did not bind within {timeout_s}s "
+                "(negotiation failed? check the pipeline bus)")
+        time.sleep(0.02)
+    return src.bound_port
+
+
 @register_element
 class TensorQueryServerSrc(SourceElement):
     ELEMENT_NAME = "tensor_query_serversrc"

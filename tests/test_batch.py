@@ -308,3 +308,56 @@ class TestBatchedServingPipeline:
                             for b in sink.buffers]
         assert len(results["batched"]) == 6
         assert results["batched"] == results["ref"]
+
+
+class TestAutoBudget:
+    def test_auto_budget_fills_groups_at_steady_rate(self):
+        """budget_ms=0: with a ~4ms-interval source and max_batch=4, the
+        adaptive window must let groups FILL (fill ratio near 1), where a
+        fixed 5ms budget would flush partial pairs."""
+        import time as _t
+
+        import numpy as np
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        def timed_gen():
+            for i in range(24):
+                _t.sleep(0.004)
+                yield np.full((1, 4), float(i), np.float32)
+
+        p = Pipeline()
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("4:1", "float32")))
+        src = p.add_new("appsrc", caps=caps, data=timed_gen())
+        bat = p.add_new("tensor_batch", max_batch=4, budget_ms=0)
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, bat, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 24
+        # pad-waste observability: fill ratio = frames / (groups * max)
+        assert bat.frames_grouped == 24
+        fill = bat.frames_grouped / (bat.groups_emitted * 4)
+        assert fill >= 0.6, (bat.groups_emitted, fill)
+
+    def test_auto_budget_lone_frame_not_stuck(self):
+        """An idle stream's lone frame flushes within the clamped window,
+        not never."""
+        import numpy as np
+
+        from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+        from nnstreamer_tpu.graph import Pipeline
+
+        p = Pipeline()
+        caps = Caps.tensors(TensorsConfig(
+            TensorsInfo.from_strings("4:1", "float32")))
+        src = p.add_new("appsrc", caps=caps,
+                        data=[np.ones((1, 4), np.float32)])
+        bat = p.add_new("tensor_batch", max_batch=8, budget_ms=0)
+        unb = p.add_new("tensor_unbatch")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, bat, unb, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 1

@@ -168,11 +168,9 @@ def test_query_offload_to_mesh_sharded_server():
     Pipeline.link(ssrc, filt, ssink)
     sp.start()
     try:
-        deadline = time.monotonic() + 10
-        while not hasattr(ssrc, "bound_port") and time.monotonic() < deadline:
-            time.sleep(0.05)
-        assert hasattr(ssrc, "bound_port"), "server did not bind within 10s"
-        port = ssrc.bound_port
+        from nnstreamer_tpu.query.server import wait_bound_port
+
+        port = wait_bound_port(ssrc)
 
         cp = Pipeline("client")
         batches = [np.random.default_rng(i).integers(
