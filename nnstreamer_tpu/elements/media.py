@@ -100,14 +100,36 @@ class ImageDec(Element):
         self.add_sink_pad()
         self.add_src_pad()
         self._caps_sent = False
+        self._acc = bytearray()
+        self._decode_err: Optional[Exception] = None
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
         self._caps_sent = False  # actual size known at first frame
+        self._acc = bytearray()
+        self._decode_err = None
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        data = b"".join(m.tobytes() for m in buf.memories)
-        frame = _decode_image(data, self.format)
+        # upstream may deliver the encoded file in blocksize chunks
+        # (filesrc ! pngdec): accumulate until a complete image decodes —
+        # gst's pngdec buffers exactly the same way
+        for m in buf.memories:
+            self._acc += m.tobytes()
+        # skip futile decode attempts while a PNG/JPEG is visibly
+        # truncated (no IEND/EOI near the tail) — otherwise a 4096-byte
+        # blocksize means O(chunks) full parses of a growing buffer
+        head, tail = bytes(self._acc[:4]), bytes(self._acc[-64:])
+        if head.startswith(b"\x89PNG") and b"IEND" not in tail:
+            return FlowReturn.OK
+        if head.startswith(b"\xff\xd8") and b"\xff\xd9" not in tail:
+            return FlowReturn.OK
+        try:
+            frame = _decode_image(bytes(self._acc), self.format)
+        except Exception as e:  # noqa: BLE001 — truncated OR corrupt
+            self._decode_err = e  # kept for the EOS diagnostic
+            return FlowReturn.OK  # wait for more bytes
+        self._acc = bytearray()
+        self._decode_err = None
         if not self._caps_sent:
             self._caps_sent = True
             h, w = frame.shape[:2]
@@ -116,6 +138,72 @@ class ImageDec(Element):
                                      "height": h,
                                      "framerate": Fraction(0, 1)}))
         return self.push(buf.with_memories([TensorMemory(frame)]))
+
+    def on_eos(self) -> None:
+        if self._acc:
+            err = getattr(self, "_decode_err", None)
+            raise ValueError(
+                f"{self.name}: stream ended with {len(self._acc)} bytes of "
+                f"undecodable image data"
+                + (f" (last decode error: {err})" if err else "")) from err
+        super().on_eos()
+
+
+@register_element
+class PngDec(ImageDec):
+    """gst pngdec name for the image decoder (reference pipeline strings
+    use ``filesrc ! pngdec``; PIL decodes by content, not extension)."""
+
+    ELEMENT_NAME = "pngdec"
+
+
+@register_element
+class JpegDec(ImageDec):
+    """gst jpegdec name (same decoder — see PngDec)."""
+
+    ELEMENT_NAME = "jpegdec"
+
+
+@register_element
+class ImageFreeze(Element):
+    """Repeats a still frame as a video stream (gst imagefreeze).
+
+    The reference's golden pipelines use it to turn one decoded PNG into
+    a stream (tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:74).
+    gst's default repeats FOREVER and relies on an external stop;
+    a pull-less in-process pipeline wants an EOS, so ``num_buffers``
+    defaults to 1 (set higher for a longer freeze) — the one documented
+    divergence."""
+
+    ELEMENT_NAME = "imagefreeze"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.num_buffers = 1
+        self.framerate = 30
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self._frozen = False
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        pad.caps = caps
+        self.send_caps_all(caps)
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        if self._frozen:
+            return FlowReturn.OK  # gst semantics: freeze the FIRST frame
+        self._frozen = True
+        rate = Fraction(str(self.framerate))  # accepts 30, "30", "30/1"
+        dur = int(NS_PER_SEC / rate) if rate else NS_PER_SEC // 30
+        for i in range(int(self.num_buffers)):
+            out = buf.with_memories(list(buf.memories))
+            out.pts = i * dur
+            out.duration = dur
+            out.offset = i
+            ret = self.push(out)
+            if ret not in (None, FlowReturn.OK):
+                return ret
+        return FlowReturn.OK
 
 
 @register_element
@@ -136,15 +224,24 @@ class VideoScale(Element):
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         if caps.media_type != "video/x-raw":
             raise ValueError("videoscale accepts video/x-raw")
-        if not (self.width and self.height):
-            raise ValueError("videoscale requires width and height")
         pad.caps = caps
+        if bool(self.width) != bool(self.height):
+            raise ValueError(
+                "videoscale needs BOTH width and height (or neither "
+                "for passthrough)")
+        if not (self.width and self.height):
+            # no target size: passthrough (gst videoscale with no
+            # downstream size constraint does not resample either)
+            self.send_caps_all(caps)
+            return
         self.send_caps_all(caps.with_fields(width=int(self.width),
                                             height=int(self.height)))
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         from PIL import Image
 
+        if not (self.width and self.height):
+            return self.push(buf)
         frame = buf.memories[0].host()
         img = Image.fromarray(frame)
         img = img.resize((int(self.width), int(self.height)), Image.BILINEAR)
