@@ -88,6 +88,58 @@ class ImageFileSrc(SourceElement):
 
 
 @register_element
+class MultiFileSrc(SourceElement):
+    """gst multifilesrc: reads ``location`` as a printf pattern
+    (``testsequence_%1d.png``) starting at ``index``, one whole ENCODED
+    file per buffer (pair with ``pngdec``/``jpegdec`` downstream — the
+    reference's converter/transform SSAT strings use exactly this shape).
+    ``caps`` is accepted as the declared stream caps string; its
+    framerate drives the synthesized pts."""
+
+    ELEMENT_NAME = "multifilesrc"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.location: Optional[str] = None
+        self.index = 0
+        self.stop_index = -1      # -1: until the first missing file
+        self.caps: Optional[str] = None
+        super().__init__(name, **props)
+        self._idx = 0
+        self._rate = Fraction(30, 1)
+
+    def negotiate(self) -> Caps:
+        if not self.location or "%" not in self.location:
+            raise ValueError(
+                "multifilesrc needs a printf-style location pattern")
+        self._idx = int(self.index)
+        media = "application/octet-stream"
+        if self.caps:
+            from ..graph.parse import parse_caps_string
+
+            parsed = parse_caps_string(str(self.caps))
+            media = parsed.media_type
+            rate = parsed.fields.get("framerate")
+            if rate is not None:  # 0/1 (still image) is meaningful
+                self._rate = Fraction(rate)
+        return Caps(media)
+
+    def create(self) -> Optional[Buffer]:
+        if self.stop_index >= 0 and self._idx > int(self.stop_index):
+            return None
+        path = self.location % self._idx
+        if not os.path.isfile(path):
+            return None  # first gap ends the stream (gst EOS behavior)
+        data = np.frombuffer(open(path, "rb").read(), np.uint8)
+        dur = int(NS_PER_SEC / self._rate) if self._rate > 0 else None
+        buf = Buffer.of(data, pts=((self._idx - int(self.index)) * dur
+                                   if dur else self._idx),
+                        duration=dur)
+        buf.offset = self._idx
+        self._idx += 1
+        return buf
+
+
+@register_element
 class ImageDec(Element):
     """Decodes encoded image bytes (PNG/JPEG/...) → video/x-raw
     (pngdec/jpegdec equivalent; upstream delivers whole files per buffer)."""
@@ -119,15 +171,25 @@ class ImageDec(Element):
         # truncated (no IEND/EOI near the tail) — otherwise a 4096-byte
         # blocksize means O(chunks) full parses of a growing buffer
         head, tail = bytes(self._acc[:4]), bytes(self._acc[-64:])
-        if head.startswith(b"\x89PNG") and b"IEND" not in tail:
-            return FlowReturn.OK
-        if head.startswith(b"\xff\xd8") and b"\xff\xd9" not in tail:
+        complete = True
+        if head.startswith(b"\x89PNG"):
+            complete = b"IEND" in tail
+        elif head.startswith(b"\xff\xd8"):
+            complete = b"\xff\xd9" in tail
+        if not complete:
             return FlowReturn.OK
         try:
             frame = _decode_image(bytes(self._acc), self.format)
-        except Exception as e:  # noqa: BLE001 — truncated OR corrupt
-            self._decode_err = e  # kept for the EOS diagnostic
-            return FlowReturn.OK  # wait for more bytes
+        except Exception as e:  # noqa: BLE001
+            if head.startswith((b"\x89PNG", b"\xff\xd8")):
+                # end marker present yet undecodable: the image is
+                # CORRUPT, not truncated — fail at the bad frame (gst
+                # pngdec errors here too) instead of silently poisoning
+                # every later frame appended behind the garbage
+                raise ValueError(
+                    f"{self.name}: corrupt image data ({e})") from e
+            self._decode_err = e  # unknown format: keep accumulating
+            return FlowReturn.OK
         self._acc = bytearray()
         self._decode_err = None
         if not self._caps_sent:

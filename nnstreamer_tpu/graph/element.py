@@ -124,6 +124,13 @@ class Element:
         self.set_properties(**props)
 
     # -- properties --------------------------------------------------------- #
+    #: universally-accepted gst no-op props: every GstElement/BaseSink has
+    #: these and the reference's SSAT strings set them freely (silent=TRUE,
+    #: filesink sync=true …); they carry no behavior here but must not
+    #: fail verbatim pipeline strings. Elements with real semantics for
+    #: one (e.g. tensor_rate silent) simply shadow it with an attribute.
+    _GST_NOOP_PROPS = frozenset({"silent", "sync", "async", "qos"})
+
     def set_properties(self, **props: Any) -> None:
         """GObject-property equivalent: kwargs map to attributes. Unknown
         properties raise (reference: malformed props must fail; SSAT negative
@@ -134,6 +141,8 @@ class Element:
             if setter is not None:
                 setter(v)
             elif hasattr(self, attr) and not attr.startswith("_"):
+                setattr(self, attr, v)
+            elif attr in self._GST_NOOP_PROPS:
                 setattr(self, attr, v)
             else:
                 raise ValueError(f"{self.ELEMENT_NAME}: unknown property {k!r}")

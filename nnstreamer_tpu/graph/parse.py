@@ -127,27 +127,59 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
 
     for branch in branches:
         prev: Optional[Element] = None
+        prev_explicit: set = set()
         for seg in branch:
             if isinstance(seg, str):  # back-reference "name."
                 ref = seg.rstrip(".")
                 if ref not in named:
                     raise ValueError(f"unknown element reference {seg!r}")
                 prev = named[ref]
+                # restore the referenced element's own explicit props —
+                # a caps filter after "name." must still respect them
+                prev_explicit = getattr(prev, "_parse_explicit", set())
                 continue
             kind, props = seg
             if kind in _MEDIA_TYPES or kind.split(",")[0] in _MEDIA_TYPES:
-                el = CapsFilter(caps=parse_caps_string(_reassemble_caps(kind, props)))
+                caps = parse_caps_string(_reassemble_caps(kind, props))
+                el = CapsFilter(caps=caps)
                 p.add(el)
+                _configure_upstream_from_caps(prev, caps, prev_explicit)
+                explicit = set()
             else:
                 name = props.pop("name", None)
+                explicit = {k.replace("-", "_") for k in props}
                 el = make_element(kind, element_name=name, **props)
+                el._parse_explicit = explicit
                 p.add(el)
                 if name:
                     named[name] = el
             if prev is not None:
                 Pipeline.link(prev, el)
             prev = el
+            prev_explicit = explicit
     return p
+
+
+def _configure_upstream_from_caps(prev: Optional[Element], caps: Caps,
+                                  explicit: set) -> None:
+    """gst-launch semantics shortcut: in ``videotestsrc ! video/x-raw,
+    format=GRAY8,...`` or ``videoscale ! video/x-raw,width=224,...`` the
+    caps filter CONFIGURES the upstream element through negotiation.
+    Full upstream negotiation is out of scope for the push scheduler, so
+    the parser applies a caps filter's fields directly to the
+    directly-preceding element when it exposes a matching configurable
+    attribute (format/width/height/framerate/rate/channels) — sources,
+    videoconvert (format), videoscale (width/height) alike. Props the
+    user set EXPLICITLY stay authoritative: a conflicting caps filter
+    then fails negotiation (SSAT negative cases), and the CapsFilter
+    still validates whatever the element actually produces."""
+    if prev is None:
+        return
+    for key in ("format", "width", "height", "framerate", "rate",
+                "channels"):
+        if key in caps.fields and hasattr(prev, key) \
+                and key not in explicit:
+            setattr(prev, key, caps.fields[key])
 
 
 def _reassemble_caps(kind: str, props: Dict[str, Any]) -> str:

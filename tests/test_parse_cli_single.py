@@ -113,8 +113,11 @@ class TestCLI:
     def test_cli_error_exit_code(self, capsys):
         from nnstreamer_tpu.cli import main
 
-        ret = main(["videotestsrc num-buffers=1 ! video/x-raw,width=999 ! "
-                    "tensor_converter ! fakesink"])
+        # explicit source width conflicting with the caps filter: a
+        # genuine negotiation mismatch (a bare caps filter now CONFIGURES
+        # an unconstrained source, gst-launch semantics)
+        ret = main(["videotestsrc num-buffers=1 width=8 ! "
+                    "video/x-raw,width=999 ! tensor_converter ! fakesink"])
         assert ret == 1
 
 

@@ -91,3 +91,104 @@ def test_imagefreeze_repeats_frames(tmp_path):
             if e.ELEMENT_NAME == "tensor_sink"][0]
     assert sink.num_buffers == 5
     assert sink.buffers[4].offset == 4
+
+
+def _make_sequence(tmp_path, n=4, size=(16, 12)):
+    from PIL import Image
+
+    rng = np.random.default_rng(5)
+    for i in range(n):
+        arr = rng.integers(0, 255, (size[1], size[0], 3)).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"testsequence_{i}.png")
+
+
+def test_reference_typecast_tee_string(tmp_path):
+    """transform_typecast/runTest.sh case 1, verbatim: multifilesrc !
+    pngdec ! videoconvert ! caps ! tensor_converter ! tee ! two branches
+    (typecast=uint32 and direct); golden: typecast log == direct bytes
+    cast to uint32."""
+    _make_sequence(tmp_path)
+    tc_log = tmp_path / "testcase01.typecast.log"
+    di_log = tmp_path / "testcase01.direct.log"
+    p = parse_pipeline(
+        f'multifilesrc location="{tmp_path}/testsequence_%1d.png" index=0 '
+        'caps="image/png,framerate=(fraction)30/1" ! pngdec ! '
+        'videoconvert ! video/x-raw, format=RGB ! tensor_converter ! '
+        'tee name=t ! queue ! tensor_transform mode=typecast '
+        f'option=uint32 ! filesink location="{tc_log}" sync=true '
+        f't. ! queue ! filesink location="{di_log}" sync=true')
+    p.run(timeout=120)
+    direct = np.frombuffer(di_log.read_bytes(), np.uint8)
+    typecast = np.frombuffer(tc_log.read_bytes(), np.uint32)
+    np.testing.assert_array_equal(typecast, direct.astype(np.uint32))
+
+
+def test_reference_converter_gray8_string(tmp_path):
+    """nnstreamer_converter/runTest.sh 1G, verbatim: GRAY8 videotestsrc
+    through tensor_converter to a filesink dump."""
+    log = tmp_path / "test.gray8.log"
+    p = parse_pipeline(
+        "videotestsrc num-buffers=1 ! "
+        "video/x-raw,format=GRAY8,width=280,height=40,framerate=0/1 ! "
+        "queue ! tensor_converter silent=TRUE ! "
+        f'filesink location="{log}" sync=true')
+    p.run(timeout=120)
+    assert log.stat().st_size == 280 * 40  # one GRAY8 frame, dims 280x40
+
+
+def test_reference_typecast_invalid_type_fails(tmp_path):
+    """transform_typecast 2F_n: option=uint128 must fail."""
+    _make_sequence(tmp_path)
+    with pytest.raises(Exception):
+        p = parse_pipeline(
+            f'multifilesrc location="{tmp_path}/testsequence_%1d.png" '
+            'index=0 caps="image/png,framerate=(fraction)30/1" ! pngdec ! '
+            'videoconvert ! video/x-raw, format=RGB ! tensor_converter ! '
+            'tensor_transform mode=typecast option=uint128 ! '
+            f'filesink location="{tmp_path}/x.log" sync=true')
+        p.run(timeout=60)
+
+
+def test_caps_configures_intermediate_videoscale(tmp_path):
+    """The classic reference scaling shape: videoscale ! caps with
+    width/height configures the scaler (gst upstream negotiation)."""
+    log = tmp_path / "scaled.log"
+    p = parse_pipeline(
+        "videotestsrc num-buffers=1 width=64 height=64 ! videoscale ! "
+        "video/x-raw,width=16,height=16 ! tensor_converter ! "
+        f'filesink location="{log}"')
+    p.run(timeout=60)
+    assert log.stat().st_size == 16 * 16 * 3
+
+
+def test_caps_after_backreference_respects_explicit_props(tmp_path):
+    """A caps filter following a name. back-reference must not override
+    props set explicitly on the referenced element."""
+    from nnstreamer_tpu.graph import PipelineError
+
+    p = parse_pipeline(
+        "videotestsrc name=s width=8 height=8 num-buffers=1 ! "
+        "tee name=t ! queue ! fakesink "
+        "t. ! video/x-raw,width=999 ! fakesink")
+    with pytest.raises(Exception, match="incompatible"):
+        p.run(timeout=30)
+
+
+def test_corrupt_png_fails_at_bad_frame(tmp_path):
+    """A complete-but-corrupt PNG (IEND present, body garbage) must fail
+    the stream at that frame, not silently swallow it."""
+    from PIL import Image
+
+    good = tmp_path / "seq_0.png"
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(good)
+    bad = good.read_bytes()
+    # corrupt the IDAT payload, keep signature + IEND
+    idx = bad.index(b"IDAT") + 8
+    corrupt = bad[:idx] + bytes([b ^ 0xFF for b in bad[idx:idx + 8]]) \
+        + bad[idx + 8:]
+    (tmp_path / "seq_1.png").write_bytes(corrupt)
+    p = parse_pipeline(
+        f'multifilesrc location="{tmp_path}/seq_%1d.png" index=0 ! '
+        "pngdec ! tensor_converter ! fakesink")
+    with pytest.raises(Exception):
+        p.run(timeout=30)
