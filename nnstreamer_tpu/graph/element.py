@@ -158,6 +158,18 @@ class Element:
         self.src_pads.append(pad)
         return pad
 
+    def free_sink_pad(self) -> Pad:
+        """First unlinked sink pad, requesting a new one if none (the
+        link-time pad selection shared by Pipeline.link and the textual
+        parser)."""
+        pad = next((q for q in self.sink_pads if q.peer is None), None)
+        return pad if pad is not None else self.request_sink_pad()
+
+    def free_src_pad(self) -> Pad:
+        """First unlinked src pad, requesting a new one if none."""
+        pad = next((q for q in self.src_pads if q.peer is None), None)
+        return pad if pad is not None else self.request_src_pad()
+
     def request_sink_pad(self) -> Pad:
         """For N-input elements (mux/merge/join): new sink pad on demand."""
         return self.add_sink_pad(f"sink_{len(self.sink_pads)}")

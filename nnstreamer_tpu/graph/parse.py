@@ -126,17 +126,47 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
     named: Dict[str, Element] = {}
 
     for branch in branches:
-        prev: Optional[Element] = None
+        prev: Optional[Any] = None
         prev_explicit: set = set()
+        closed = False  # chain already sank into a named element/pad
         for seg in branch:
-            if isinstance(seg, str):  # back-reference "name."
-                ref = seg.rstrip(".")
+            if closed:
+                raise ValueError(
+                    "cannot continue a chain after linking into a named "
+                    f"element/pad (dangling segment {seg!r})")
+            if isinstance(seg, str):  # "name." or pad ref "name.sink_0"
+                if seg.endswith("."):
+                    ref = seg.rstrip(".")
+                    if ref not in named:
+                        raise ValueError(
+                            f"unknown element reference {seg!r}")
+                    target = named[ref]
+                    if prev is None:
+                        prev = target
+                        # restore the referenced element's own explicit
+                        # props — a following caps filter must respect them
+                        prev_explicit = getattr(
+                            target, "_parse_explicit", set())
+                    else:
+                        # "… ! name." links INTO the named element's next
+                        # free sink pad and ends the chain (gst-launch)
+                        _link(prev, target)
+                        prev = None
+                        closed = True
+                    continue
+                ref, pad_name = seg.split(".", 1)
                 if ref not in named:
                     raise ValueError(f"unknown element reference {seg!r}")
-                prev = named[ref]
-                # restore the referenced element's own explicit props —
-                # a caps filter after "name." must still respect them
-                prev_explicit = getattr(prev, "_parse_explicit", set())
+                target = named[ref]
+                if prev is None:
+                    # branch starts AT this src pad: demux.src_0 ! ...
+                    prev = (target, pad_name)
+                    prev_explicit = set()
+                else:
+                    # chain sinks INTO this pad: ... ! mux.sink_0
+                    _link(prev, (target, pad_name))
+                    prev = None
+                    closed = True
                 continue
             kind, props = seg
             if kind in _MEDIA_TYPES or kind.split(",")[0] in _MEDIA_TYPES:
@@ -154,10 +184,44 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
                 if name:
                     named[name] = el
             if prev is not None:
-                Pipeline.link(prev, el)
+                _link(prev, el)
             prev = el
             prev_explicit = explicit
     return p
+
+
+def _link(src_spec: Any, dst_spec: Any) -> None:
+    """Link with optional explicit pads: either side may be an Element
+    (first-free-pad semantics, shared with Pipeline.link) or an
+    ``(element, pad_name)`` tuple from a gst ``name.sink_0`` reference."""
+    src = _pad_by_name(*src_spec, "src") if isinstance(src_spec, tuple) \
+        else src_spec.free_src_pad()
+    sink = _pad_by_name(*dst_spec, "sink") if isinstance(dst_spec, tuple) \
+        else dst_spec.free_sink_pad()
+    src.link(sink)
+
+
+def _pad_by_name(el: Element, pad_name: str, direction: str) -> Any:
+    """Resolve ``sink_N``/``src_N``. Request pads are created strictly in
+    index order — referencing ``sink_1`` before ``sink_0`` would fabricate
+    an unlinked lower pad that stalls collect elements forever, so a
+    skipped index is an error instead."""
+    pads = el.sink_pads if direction == "sink" else el.src_pads
+    for q in pads:
+        if q.name == pad_name:
+            return q
+    m = re.fullmatch(rf"{direction}_(\d+)", pad_name)
+    if m is None:
+        raise ValueError(
+            f"{el.name}: no {direction} pad named {pad_name!r}")
+    want = int(m.group(1))
+    q = el.request_sink_pad() if direction == "sink" \
+        else el.request_src_pad()
+    if q.name != pad_name:
+        raise ValueError(
+            f"{el.name}: pad references must be used in index order "
+            f"(requested {pad_name!r}, next available is {q.name!r})")
+    return q
 
 
 def _configure_upstream_from_caps(prev: Optional[Element], caps: Caps,
@@ -211,8 +275,8 @@ def _split_branches(description: str):
         if not seg_tokens:
             return
         head = seg_tokens[0]
-        if head.endswith(".") and len(seg_tokens) == 1 and \
-                not any(c in head for c in "=/"):
+        if len(seg_tokens) == 1 and not any(c in head for c in "=/") and \
+                (head.endswith(".") or _PAD_REF_RE.fullmatch(head)):
             current.append(head)
         else:
             props: Dict[str, Any] = {}
@@ -236,7 +300,8 @@ def _split_branches(description: str):
         # a segment token arriving while another segment is open (no "!"
         # in between) ends the current branch and starts a new one
         if seg_tokens and "=" not in tok \
-                and (tok.endswith(".") or _looks_like_element(tok)):
+                and (tok.endswith(".") or _PAD_REF_RE.fullmatch(tok)
+                     or _looks_like_element(tok)):
             flush_segment()
             if current:
                 branches.append(current)
@@ -246,6 +311,11 @@ def _split_branches(description: str):
     if current:
         branches.append(current)
     return branches
+
+
+#: gst pad reference: ``name.sink_0`` / ``name.src_1`` (the mux/demux
+#: SSAT strings link through explicit pads)
+_PAD_REF_RE = re.compile(r"[A-Za-z_]\w*\.(sink|src)_\d+")
 
 
 def _looks_like_element(tok: str) -> bool:

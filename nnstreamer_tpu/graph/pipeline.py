@@ -292,13 +292,7 @@ class Pipeline:
         """Chain-link: a ! b ! c. Picks the first unlinked src/sink pad,
         requesting pads from tee/mux-style elements as needed."""
         for a, b in zip(elements, elements[1:]):
-            src = next((p for p in a.src_pads if p.peer is None), None)
-            if src is None:
-                src = a.request_src_pad()
-            sink = next((p for p in b.sink_pads if p.peer is None), None)
-            if sink is None:
-                sink = b.request_sink_pad()
-            src.link(sink)
+            a.free_src_pad().link(b.free_sink_pad())
 
     def add_linked(self, *elements: Element) -> Sequence[Element]:
         self.add(*elements)

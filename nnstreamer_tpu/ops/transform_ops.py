@@ -216,7 +216,19 @@ def _clamp(option: str) -> Transform:
     import jax.numpy as jnp
 
     def fn(x):
-        return jnp.clip(x, lo, hi)
+        # bounds cast to the INPUT dtype: the reference's clamp is typed
+        # scalar math that preserves the tensor type (python-float bounds
+        # would weakly promote int streams to float32). For int streams
+        # the bounds are first clamped into the dtype's representable
+        # range — a raw cast would WRAP (uint8 with lo=-50 → 206 > hi)
+        # and flatten the whole tensor to a constant.
+        l, h = lo, hi
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            info = jnp.iinfo(x.dtype)
+            l = int(np.clip(l, info.min, info.max))
+            h = int(np.clip(h, info.min, info.max))
+        return jnp.clip(x, jnp.asarray(l, x.dtype),
+                        jnp.asarray(h, x.dtype))
 
     return Transform(fn, lambda i: i, f"clamp:{option}")
 

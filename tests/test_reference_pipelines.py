@@ -192,3 +192,116 @@ def test_corrupt_png_fails_at_bad_frame(tmp_path):
         "pngdec ! tensor_converter ! fakesink")
     with pytest.raises(Exception):
         p.run(timeout=30)
+
+
+def test_reference_demux_string_single_stream(tmp_path):
+    """nnstreamer_demux/runTest.sh case 1, verbatim shape: mux+demux by
+    name with explicit pad references (mux.sink_0 / demux.src_0)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(9)
+    arr = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    img = tmp_path / "testcase_RGB.png"
+    Image.fromarray(arr).save(img)
+    log = tmp_path / "demux00.log"
+    p = parse_pipeline(
+        "tensor_mux name=mux ! tensor_demux name=demux "
+        f"filesrc location={img} ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw,format=RGB,width=16,height=16,"
+        "framerate=0/1 ! tensor_converter ! mux.sink_0 "
+        f"demux.src_0 !queue! filesink location={log}")
+    p.run(timeout=120)
+    np.testing.assert_array_equal(
+        np.frombuffer(log.read_bytes(), np.uint8).reshape(16, 16, 3), arr)
+
+
+def test_reference_demux_string_two_streams(tmp_path):
+    """nnstreamer_demux/runTest.sh case 2 shape: two muxed streams split
+    back out to two sinks via explicit pads."""
+    from PIL import Image
+
+    rng = np.random.default_rng(10)
+    arrs = [rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+            for _ in range(2)]
+    imgs = []
+    for i, a in enumerate(arrs):
+        path = tmp_path / f"img{i}.png"
+        Image.fromarray(a).save(path)
+        imgs.append(path)
+    logs = [tmp_path / "demux02_0.log", tmp_path / "demux02_1.log"]
+    p = parse_pipeline(
+        "tensor_mux name=mux ! tensor_demux name=demux "
+        f"filesrc location={imgs[0]} ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw,format=RGB,width=8,height=8,"
+        "framerate=0/1 ! tensor_converter ! mux.sink_0 "
+        f"filesrc location={imgs[1]} ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw,format=RGB,width=8,height=8,"
+        "framerate=0/1 ! tensor_converter ! mux.sink_1 "
+        f"demux.src_0 ! queue ! filesink location={logs[0]} "
+        f"demux.src_1 ! queue ! filesink location={logs[1]}")
+    p.run(timeout=120)
+    for log, a in zip(logs, arrs):
+        np.testing.assert_array_equal(
+            np.frombuffer(log.read_bytes(), np.uint8).reshape(8, 8, 3), a)
+
+
+def test_reference_clamp_octet_string(tmp_path):
+    """transform_clamp/runTest.sh case 1, verbatim: octet filesrc with
+    blocksize=-1 reinterpreted by tensor_converter, clamped, dumped."""
+    data = np.random.default_rng(11).integers(
+        -128, 127, 50 * 100).astype(np.int8)
+    src = tmp_path / "test_00.dat"
+    data.tofile(src)
+    out = tmp_path / "result_00.dat"
+    p = parse_pipeline(
+        f'filesrc location="{src}" blocksize=-1 ! '
+        "application/octet-stream ! "
+        "tensor_converter input-dim=50:100:1:1 input-type=int8 ! "
+        "tensor_transform mode=clamp option=-50:50 ! "
+        f'filesink location="{out}" sync=true')
+    p.run(timeout=120)
+    got = np.frombuffer(out.read_bytes(), np.int8)
+    np.testing.assert_array_equal(got, np.clip(data, -50, 50))
+
+
+class TestPadRefEdgeCases:
+    def test_bare_named_target_links(self, tmp_path):
+        """'... ! name.' links into the named element's free sink pad."""
+        log = tmp_path / "m.log"
+        p = parse_pipeline(
+            f"tensor_mux name=m ! filesink location={log} "
+            "videotestsrc num-buffers=1 width=4 height=4 ! "
+            "tensor_converter ! m.")
+        p.run(timeout=60)
+        assert log.stat().st_size == 4 * 4 * 3
+
+    def test_chain_after_sink_pad_ref_rejected(self):
+        with pytest.raises(ValueError, match="after linking"):
+            parse_pipeline(
+                "tensor_mux name=m ! fakesink "
+                "videotestsrc num-buffers=1 ! tensor_converter ! "
+                "m.sink_0 ! queue")
+
+    def test_out_of_order_pad_ref_rejected(self):
+        with pytest.raises(ValueError, match="index order"):
+            parse_pipeline(
+                "tensor_mux name=m ! fakesink "
+                "videotestsrc num-buffers=1 ! tensor_converter ! m.sink_1")
+
+    def test_uint8_clamp_with_negative_bound(self, tmp_path):
+        """clamp -50:50 on a uint8 stream: bounds clamp into range
+        instead of wrapping (206 > 50 would flatten the tensor)."""
+        data = np.arange(0, 200, dtype=np.uint8)
+        src = tmp_path / "u8.dat"
+        data.tofile(src)
+        out = tmp_path / "u8.out"
+        p = parse_pipeline(
+            f'filesrc location="{src}" blocksize=-1 ! '
+            "application/octet-stream ! "
+            "tensor_converter input-dim=200:1 input-type=uint8 ! "
+            "tensor_transform mode=clamp option=-50:50 ! "
+            f'filesink location="{out}"')
+        p.run(timeout=60)
+        np.testing.assert_array_equal(
+            np.frombuffer(out.read_bytes(), np.uint8),
+            np.clip(data, 0, 50))
