@@ -311,6 +311,73 @@ class VideoScale(Element):
 
 
 @register_element
+class AudioConvert(Element):
+    """Sample-format conversion among S8/U8/S16LE/S32LE/F32LE/F64LE (gst
+    audioconvert). ``format=`` picks the output (also settable by a
+    following caps filter); passthrough when formats match. Int samples
+    normalize through [-1, 1) float the way gst does (S16 -> F32 is
+    x/32768; F32 -> S16 clips then scales by 32767)."""
+
+    ELEMENT_NAME = "audioconvert"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.format: Optional[str] = None  # None: passthrough
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self._in_fmt = "S16LE"
+
+    def on_caps(self, pad: Pad, caps: Caps) -> None:
+        from ..core.types import AUDIO_FORMATS
+
+        if caps.media_type != "audio/x-raw":
+            raise ValueError("audioconvert accepts audio/x-raw")
+        self._in_fmt = caps.get("format", "S16LE")
+        if self._in_fmt not in AUDIO_FORMATS:
+            raise ValueError(
+                f"audioconvert: unsupported input format {self._in_fmt!r}")
+        out_fmt = self.format or self._in_fmt
+        if out_fmt not in AUDIO_FORMATS:
+            raise ValueError(f"audioconvert: unknown format {out_fmt!r}")
+        pad.caps = caps
+        self.send_caps_all(caps.with_fields(format=out_fmt))
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        from ..core.types import AUDIO_FORMATS
+
+        out_fmt = self.format or self._in_fmt
+        if out_fmt == self._in_fmt:
+            return self.push(buf)
+        samples = buf.memories[0].host()
+        src_dt = np.dtype(AUDIO_FORMATS[self._in_fmt])
+        dst_dt = np.dtype(AUDIO_FORMATS[out_fmt])
+        # normalize to [-1, 1) float64, scale by (max+1) with rounding —
+        # gives gst's shift semantics for int->int (S16 1 -> S32 65536)
+        # and EXACT int->float->int round trips (truncating by iinfo.max
+        # would decay every positive sample by 1 per round trip)
+        if src_dt.kind == "i":
+            norm = samples.astype(np.float64) / float(
+                np.iinfo(src_dt).max + 1)
+        elif src_dt.kind == "u":
+            mid = (np.iinfo(src_dt).max + 1) / 2.0
+            norm = (samples.astype(np.float64) - mid) / mid
+        else:
+            norm = samples.astype(np.float64)
+        if dst_dt.kind == "i":
+            info = np.iinfo(dst_dt)
+            out = np.rint(np.clip(norm, -1.0, 1.0) * (info.max + 1.0))
+            out = np.clip(out, info.min, info.max).astype(dst_dt)
+        elif dst_dt.kind == "u":
+            info = np.iinfo(dst_dt)
+            mid = (info.max + 1) / 2.0
+            out = np.rint(np.clip(norm, -1.0, 1.0) * mid + mid)
+            out = np.clip(out, 0, info.max).astype(dst_dt)
+        else:
+            out = norm.astype(dst_dt)
+        return self.push(buf.with_memories([TensorMemory(out)]))
+
+
+@register_element
 class VideoConvert(Element):
     """Pixel-format conversion among RGB/RGBA/BGR/GRAY8 (videoconvert
     equivalent). ``format=`` picks the output."""

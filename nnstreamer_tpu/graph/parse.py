@@ -210,11 +210,9 @@ def _pad_by_name(el: Element, pad_name: str, direction: str) -> Any:
     for q in pads:
         if q.name == pad_name:
             return q
-    m = re.fullmatch(rf"{direction}_(\d+)", pad_name)
-    if m is None:
+    if re.fullmatch(rf"{direction}_\d+", pad_name) is None:
         raise ValueError(
             f"{el.name}: no {direction} pad named {pad_name!r}")
-    want = int(m.group(1))
     q = el.request_sink_pad() if direction == "sink" \
         else el.request_src_pad()
     if q.name != pad_name:
@@ -237,13 +235,34 @@ def _configure_upstream_from_caps(prev: Optional[Element], caps: Caps,
     user set EXPLICITLY stay authoritative: a conflicting caps filter
     then fails negotiation (SSAT negative cases), and the CapsFilter
     still validates whatever the element actually produces."""
-    if prev is None:
+    if prev is None or isinstance(prev, tuple):
         return
     for key in ("format", "width", "height", "framerate", "rate",
                 "channels"):
-        if key in caps.fields and hasattr(prev, key) \
-                and key not in explicit:
-            setattr(prev, key, caps.fields[key])
+        if key not in caps.fields:
+            continue
+        # gst negotiation propagates through transparent elements
+        # (audioconvert/videoconvert/queue): walk upstream until an
+        # element exposes the attribute — e.g. `audiotestsrc !
+        # audioconvert ! audio/x-raw,rate=8000` configures the SOURCE's
+        # rate while audioconvert takes the format. The walk STOPS at
+        # media-type boundaries (tensor_converter/decoder) and at other
+        # caps filters: an other/tensors field must never clobber an
+        # upstream video element's attribute of the same name.
+        el, exp = prev, explicit
+        for _ in range(6):
+            if el.ELEMENT_NAME in ("tensor_converter", "tensor_decoder",
+                                   "capsfilter"):
+                break
+            if hasattr(el, key):
+                if key not in exp:
+                    setattr(el, key, caps.fields[key])
+                break
+            up = el.sink_pads[0].peer if el.sink_pads else None
+            if up is None:
+                break
+            el = up.element
+            exp = getattr(el, "_parse_explicit", set())
 
 
 def _reassemble_caps(kind: str, props: Dict[str, Any]) -> str:

@@ -305,3 +305,66 @@ class TestPadRefEdgeCases:
         np.testing.assert_array_equal(
             np.frombuffer(out.read_bytes(), np.uint8),
             np.clip(data, 0, 50))
+
+
+def test_reference_audio_s16le_string(tmp_path):
+    """nnstreamer_converter/runTest.sh 5-1, verbatim: audiotestsrc !
+    audioconvert ! caps ! tee (converter + direct dump branches)."""
+    conv_log = tmp_path / "test.audio8k.s16le.log"
+    direct_log = tmp_path / "test.audio8k.s16le.origin.log"
+    p = parse_pipeline(
+        "audiotestsrc num-buffers=1 samplesperbuffer=8000 ! audioconvert "
+        "! audio/x-raw,format=S16LE,rate=8000 ! tee name=t ! queue ! "
+        "audioconvert ! tensor_converter frames-per-tensor=8000 ! "
+        f'filesink location="{conv_log}" sync=true '
+        f't. ! queue ! filesink location="{direct_log}" sync=true')
+    p.run(timeout=60)
+    # converter output must be byte-identical to the raw dump
+    assert conv_log.read_bytes() == direct_log.read_bytes()
+    assert conv_log.stat().st_size == 8000 * 2  # S16LE mono
+
+
+def test_audioconvert_s16_to_f32(tmp_path):
+    log = tmp_path / "f32.log"
+    p = parse_pipeline(
+        "audiotestsrc num-buffers=1 samplesperbuffer=100 ! "
+        "audioconvert ! audio/x-raw,format=F32LE,rate=16000 ! "
+        "tensor_converter frames-per-tensor=100 ! "
+        f'filesink location="{log}"')
+    p.run(timeout=60)
+    f = np.frombuffer(log.read_bytes(), np.float32)
+    assert f.size == 100 and np.abs(f).max() <= 1.0
+
+
+def test_audio_s16_f32_roundtrip_exact(tmp_path):
+    """S16 -> F32 -> S16 must be bit-exact (rounding, (max+1) scaling)."""
+    import jax
+
+    from nnstreamer_tpu.core.buffer import Buffer, TensorMemory
+    from nnstreamer_tpu.core.types import Caps
+    from nnstreamer_tpu.elements.media import AudioConvert
+
+    data = np.array([1, 2, 100, -1, 32767, -32768], np.int16)
+
+    def convert(samples, in_fmt, out_fmt):
+        el = AudioConvert(format=out_fmt)
+        el._in_fmt = in_fmt
+        got = {}
+        el.push = lambda b: got.setdefault("m", b.memories[0].host())
+        el.chain(None, Buffer([TensorMemory(samples)]))
+        return got["m"]
+
+    f = convert(data, "S16LE", "F32LE")
+    back = convert(f, "F32LE", "S16LE")
+    np.testing.assert_array_equal(back, data)
+
+
+def test_tensor_caps_filter_does_not_clobber_video_format(tmp_path):
+    """An other/tensors caps filter's `format` field must not walk past
+    tensor_converter onto a video element (media-type boundary)."""
+    p = parse_pipeline(
+        "videotestsrc num-buffers=2 width=4 height=4 ! videoconvert ! "
+        "video/x-raw,format=RGB,width=4,height=4 ! tensor_converter ! "
+        "other/tensors,num_tensors=1,dimensions=3:4:4:1,types=uint8,"
+        "format=static ! fakesink")
+    p.run(timeout=60)

@@ -126,3 +126,19 @@ def test_truncated_tflite_rejected(tmp_path):
     bad.write_bytes(b"\x00")
     with pytest.raises(ValueError):
         parse_tflite(str(bad))
+
+
+@needs_ref
+def test_singleshot_serves_tflite():
+    """Reference C-API analog: SingleShot invoke on a .tflite file
+    (tensor_filter_single semantics, no pipeline)."""
+    from nnstreamer_tpu.single import SingleShot
+
+    s = SingleShot(framework="tensorflow-lite",
+                   model=os.path.join(
+                       MODELS, "mobilenet_v2_1.0_224_quant.tflite"))
+    img = np.fromfile(os.path.join(DATA, "orange.raw"),
+                      np.uint8).reshape(1, 224, 224, 3)
+    (out,) = s.invoke(img)
+    labels = open(LABELS).read().splitlines()
+    assert labels[int(np.asarray(out).reshape(-1).argmax())] == "orange"
