@@ -368,3 +368,56 @@ def test_tensor_caps_filter_does_not_clobber_video_format(tmp_path):
         "other/tensors,num_tensors=1,dimensions=3:4:4:1,types=uint8,"
         "format=static ! fakesink")
     p.run(timeout=60)
+
+
+@needs_ref
+def test_reference_decoder_image_labeling_tee_string(tmp_path):
+    """nnstreamer_decoder_image_labeling/runTest.sh shape, verbatim:
+    tflite filter output teed into typecast branches, each decoded to a
+    text label — both branches must say orange."""
+    u8 = tmp_path / "tensordecoder.orange.uint8.log"
+    u16 = tmp_path / "tensordecoder.orange.uint16.log"
+    p = parse_pipeline(
+        f'filesrc location="{os.path.join(DATA, "orange.png")}" ! pngdec '
+        "! videoscale ! imagefreeze ! videoconvert ! "
+        "video/x-raw, format=RGB, framerate=0/1 ! tensor_converter ! "
+        'tensor_filter framework="tensorflow2-lite" '
+        f'model="{os.path.join(MODELS, "mobilenet_v2_1.0_224_quant.tflite")}" ! '
+        "tee name=t ! queue ! tensor_transform mode=typecast option=uint8 "
+        f'! tensor_decoder mode=image_labeling option1="{LABELS}" ! '
+        f'filesink location="{u8}" '
+        "t. ! queue ! tensor_transform mode=typecast option=uint16 ! "
+        f'tensor_decoder mode=image_labeling option1="{LABELS}" ! '
+        f'filesink location="{u16}"')
+    p.run(timeout=300)
+    for log in (u8, u16):
+        assert log.read_bytes().decode().strip("\x00\n") == "orange"
+
+
+def test_reference_merge_string_two_streams(tmp_path):
+    """nnstreamer_merge/runTest.sh case 2 shape: two streams merged
+    mode=linear option=2 (reference dim axis 2 = height for RGB video:
+    frames stack vertically) through explicit merge.sink_N pads."""
+    from PIL import Image
+
+    rng = np.random.default_rng(12)
+    arrs = [rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+            for _ in range(2)]
+    imgs = []
+    for i, a in enumerate(arrs):
+        path = tmp_path / f"m{i}.png"
+        Image.fromarray(a).save(path)
+        imgs.append(path)
+    log = tmp_path / "merge02.log"
+    p = parse_pipeline(
+        "tensor_merge name=merge mode=linear option=2 sync-mode=nosync ! "
+        f"filesink location={log} "
+        f"filesrc location={imgs[0]} ! pngdec ! videoscale ! imagefreeze "
+        "! videoconvert ! video/x-raw,format=RGB,width=8,height=8,"
+        "framerate=0/1 ! tensor_converter ! merge.sink_0 "
+        f"filesrc location={imgs[1]} ! pngdec ! videoscale ! imagefreeze "
+        "! videoconvert ! video/x-raw,format=RGB,width=8,height=8,"
+        "framerate=0/1 ! tensor_converter ! merge.sink_1")
+    p.run(timeout=120)
+    got = np.frombuffer(log.read_bytes(), np.uint8).reshape(16, 8, 3)
+    np.testing.assert_array_equal(got, np.concatenate(arrs, axis=0))
