@@ -93,12 +93,22 @@ def _is_jax(x: Any) -> bool:
 class Python3Filter(FilterFramework):
     """framework=python3 model=/path/to/script.py
 
-    The script defines ``class CustomFilter`` with:
-      * ``getInputDimension() -> (dims_str, types_str)`` (or TensorsInfo)
-      * ``getOutputDimension() -> (dims_str, types_str)``
-      * optional ``setInputDimension(in_info) -> out_info``
-      * ``invoke(*arrays) -> array(s)``
-    An optional module-level ``make_filter(options_dict)`` constructs it.
+    Two script contracts are served:
+
+    * native: ``class CustomFilter`` with
+      ``getInputDimension() -> (dims_str, types_str)`` (or TensorsInfo),
+      ``getOutputDimension()``, optional ``setInputDimension(in_info) ->
+      out_info``, ``invoke(*arrays) -> array(s)``; optional module-level
+      ``make_filter(options_dict)`` constructor;
+    * the REFERENCE's contract (tensor_filter_python3.cc +
+      nnstreamer_python3_helper.cc — its own test scripts passthrough.py
+      / scaler.py run unmodified): ``import nnstreamer_python as nns``
+      (shimmed by filters/nns_python_compat.py),
+      ``getInputDim()/getOutputDim() -> [nns.TensorShape]``,
+      ``setInputDim([TensorShape]) -> [TensorShape]``, and
+      ``invoke(list_of_flat_arrays) -> list_of_flat_arrays``; the
+      ``custom=`` string arrives as a constructor argument. Flavor is
+      detected by the presence of ``getInputDim``/``setInputDim``.
     """
 
     NAME = "python3"
@@ -110,7 +120,10 @@ class Python3Filter(FilterFramework):
         self._obj: Any = None
 
     def open(self, props: FilterProps) -> None:
+        from .nns_python_compat import install_shim
+
         super().open(props)
+        install_shim()  # scripts may `import nnstreamer_python as nns`
         path = props.model_path
         if not path or not os.path.isfile(path):
             raise FileNotFoundError(f"python3 filter script not found: {path}")
@@ -121,25 +134,68 @@ class Python3Filter(FilterFramework):
         if hasattr(mod, "make_filter"):
             self._obj = mod.make_filter(props.custom_dict())
         elif hasattr(mod, "CustomFilter"):
-            self._obj = mod.CustomFilter()
+            # reference semantics: custom= splits on spaces into separate
+            # constructor args (tensor_filter_python3.cc:275 g_strsplit)
+            args = tuple(props.custom.split()) if props.custom else ()
+            try:
+                self._obj = mod.CustomFilter(*args)
+            except TypeError:
+                if not args:
+                    raise
+                # native-contract script with a no-arg constructor
+                # (options arrive via make_filter there): custom= is
+                # ignored rather than failing open
+                self._obj = mod.CustomFilter()
         else:
             raise ValueError(f"{path}: must define CustomFilter or make_filter")
+        self._ref_flavor = hasattr(self._obj, "getInputDim") or \
+            hasattr(self._obj, "setInputDim")
+        self._out_info: Optional[TensorsInfo] = None
 
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
+        from .nns_python_compat import shapes_to_info
+
         ii = oi = None
         if hasattr(self._obj, "getInputDimension"):
             ii = _coerce(self._obj.getInputDimension())
+        elif hasattr(self._obj, "getInputDim"):
+            ii = shapes_to_info(self._obj.getInputDim())
         if hasattr(self._obj, "getOutputDimension"):
             oi = _coerce(self._obj.getOutputDimension())
+        elif hasattr(self._obj, "getOutputDim"):
+            oi = shapes_to_info(self._obj.getOutputDim())
+        self._out_info = oi or self._out_info
         return ii, oi
 
     def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        from .nns_python_compat import info_to_shapes, shapes_to_info
+
         if hasattr(self._obj, "setInputDimension"):
             return _coerce(self._obj.setInputDimension(in_info))
+        if hasattr(self._obj, "setInputDim"):
+            out = shapes_to_info(
+                self._obj.setInputDim(info_to_shapes(in_info)))
+            if out is None:
+                raise ValueError("setInputDim rejected the input dims")
+            self._out_info = out
+            return out
         return super().set_input_info(in_info)
 
     def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
         arrays = [m.host() for m in inputs]
+        if self._ref_flavor:
+            # reference helper semantics: ONE list argument of raveled
+            # arrays in, a list of raveled arrays out — reshaped here to
+            # the declared output dims
+            flat = [np.ravel(a) for a in arrays]
+            outs = self._obj.invoke(flat)
+            mems = []
+            for i, o in enumerate(outs):
+                o = np.asarray(o)
+                if self._out_info is not None and i < len(self._out_info):
+                    o = o.reshape(self._out_info[i].shape)
+                mems.append(TensorMemory(o))
+            return mems
         out = self._obj.invoke(*arrays)
         outs = out if isinstance(out, (tuple, list)) else (out,)
         return [TensorMemory(np.asarray(o)) for o in outs]

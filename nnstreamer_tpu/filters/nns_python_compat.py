@@ -1,0 +1,75 @@
+"""``nnstreamer_python`` compat shim for the reference's custom scripts.
+
+The reference's python3 subplugin injects a helper module
+(``import nnstreamer_python as nns`` — ext/nnstreamer/extra/
+nnstreamer_python3_helper.cc) whose ``TensorShape`` carries dims in the
+reference's innermost-first order plus a numpy dtype. Its script contract
+(tests/test_models/models/passthrough.py / scaler.py):
+
+  * ``getInputDim() / getOutputDim() -> [nns.TensorShape, ...]``
+  * ``setInputDim([TensorShape]) -> [TensorShape]``
+  * ``invoke(input_list) -> output_list`` over FLAT (raveled) arrays —
+    scripts reshape via ``dims[::-1]`` themselves
+  * constructor receives the ``custom=`` string as ``*args``
+
+Installing this shim under ``sys.modules['nnstreamer_python']`` lets the
+reference's OWN scripts serve unmodified; filters/custom.py detects the
+flavor by the presence of ``getInputDim``/``setInputDim``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.types import TensorDType, TensorInfo, TensorsInfo
+
+
+class TensorShape:
+    """dims (innermost-first, MUTABLE list — scaler.py edits it in place)
+    + numpy element type."""
+
+    def __init__(self, dims: Sequence[int], type: Any = np.uint8):  # noqa: A002
+        self._dims = [int(d) for d in dims]
+        self._type = np.dtype(type)
+
+    def getDims(self) -> List[int]:  # noqa: N802 — reference API names
+        return self._dims
+
+    def getType(self) -> np.dtype:  # noqa: N802
+        return self._type
+
+    def setDims(self, dims: Sequence[int]) -> None:  # noqa: N802
+        self._dims = [int(d) for d in dims]
+
+    def __repr__(self) -> str:
+        return f"TensorShape({self._dims}, {self._type})"
+
+
+def install_shim() -> None:
+    """Make ``import nnstreamer_python`` resolve to this module."""
+    sys.modules.setdefault("nnstreamer_python", sys.modules[__name__])
+
+
+def shapes_to_info(shapes: Optional[Sequence[TensorShape]]
+                   ) -> Optional[TensorsInfo]:
+    if not shapes:
+        return None
+    infos = []
+    for s in shapes:
+        dims = [int(d) for d in s.getDims()]
+        while len(dims) > 1 and dims[-1] in (0, 1):
+            dims.pop()  # reference pads rank to 4 with 1s
+        infos.append(TensorInfo(tuple(dims),
+                                TensorDType.parse(np.dtype(s.getType()))))
+    return TensorsInfo(tuple(infos))
+
+
+def info_to_shapes(info: TensorsInfo) -> List[TensorShape]:
+    out = []
+    for t in info:
+        dims = list(t.dims) + [1] * (4 - len(t.dims))
+        out.append(TensorShape(dims, t.dtype.np_dtype))
+    return out

@@ -421,3 +421,77 @@ def test_reference_merge_string_two_streams(tmp_path):
     p.run(timeout=120)
     got = np.frombuffer(log.read_bytes(), np.uint8).reshape(16, 8, 3)
     np.testing.assert_array_equal(got, np.concatenate(arrs, axis=0))
+
+
+@needs_ref
+def test_reference_own_passthrough_py_script(tmp_path):
+    """The reference's OWN passthrough.py (nnstreamer_python contract,
+    `import nnstreamer_python as nns`) serves unmodified — SSAT case 1:
+    tee with filter and direct branches must dump identical bytes."""
+    pt = tmp_path / "testcase1.passthrough.log"
+    di = tmp_path / "testcase1.direct.log"
+    p = parse_pipeline(
+        "videotestsrc num-buffers=1 ! video/x-raw,format=RGB,width=280,"
+        "height=40,framerate=0/1 ! videoconvert ! video/x-raw, format=RGB "
+        "! tensor_converter ! tee name=t ! queue ! tensor_filter "
+        f'framework="python3" '
+        f'model="{os.path.join(MODELS, "passthrough.py")}" '
+        'input="3:280:40:1" inputtype="uint8" output="3:280:40:1" '
+        f'outputtype="uint8" ! filesink location="{pt}" sync=true '
+        f't. ! queue ! filesink location="{di}" sync=true')
+    p.run(timeout=120)
+    assert pt.read_bytes() == di.read_bytes()
+    assert pt.stat().st_size == 3 * 280 * 40
+
+
+@needs_ref
+def test_reference_own_scaler_py_script(tmp_path):
+    """The reference's OWN scaler.py (setInputDim + flat-array invoke +
+    custom= constructor args) serves unmodified; golden: its own
+    nearest-neighbor subsample semantics."""
+    sc = tmp_path / "testcase2.scaled.log"
+    di = tmp_path / "testcase2.direct.log"
+    p = parse_pipeline(
+        "videotestsrc num-buffers=1 ! video/x-raw,format=RGB,width=64,"
+        "height=48,framerate=0/1 ! videoconvert ! video/x-raw, format=RGB "
+        "! tensor_converter ! tee name=t ! queue ! tensor_filter "
+        f'framework="python3" model="{os.path.join(MODELS, "scaler.py")}" '
+        f'custom="32x24" ! filesink location="{sc}" sync=true '
+        f't. ! queue ! filesink location="{di}" sync=true')
+    p.run(timeout=120)
+    src = np.frombuffer(di.read_bytes(), np.uint8).reshape(48, 64, 3)
+    got = np.frombuffer(sc.read_bytes(), np.uint8).reshape(24, 32, 3)
+    iy = (np.arange(24) * 48) // 24
+    ix = (np.arange(32) * 64) // 32
+    np.testing.assert_array_equal(got, src[iy][:, ix])
+
+
+def test_custom_args_split_on_spaces_and_noarg_fallback(tmp_path):
+    """custom= splits into separate constructor args (reference
+    g_strsplit semantics); native no-arg constructors ignore custom=."""
+    multi = tmp_path / "multi.py"
+    multi.write_text(
+        "import numpy as np\n"
+        "import nnstreamer_python as nns\n"
+        "class CustomFilter:\n"
+        "    def __init__(self, *args):\n"
+        "        assert args == ('a', 'b'), args\n"
+        "        self.d = [nns.TensorShape([4, 1], np.float32)]\n"
+        "    def getInputDim(self): return self.d\n"
+        "    def getOutputDim(self): return self.d\n"
+        "    def invoke(self, xs): return [xs[0]]\n")
+    noarg = tmp_path / "noarg.py"
+    noarg.write_text(
+        "class CustomFilter:\n"
+        "    def __init__(self):\n"
+        "        pass\n"
+        "    def getInputDimension(self): return '4:1', 'float32'\n"
+        "    def getOutputDimension(self): return '4:1', 'float32'\n"
+        "    def invoke(self, x): return x\n")
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.custom import Python3Filter
+
+    f1 = Python3Filter()
+    f1.open(FilterProps(model=str(multi), custom="a b"))
+    f2 = Python3Filter()
+    f2.open(FilterProps(model=str(noarg), custom="ignored"))
