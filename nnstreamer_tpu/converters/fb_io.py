@@ -230,7 +230,11 @@ class _SerializeDecoder(Decoder):
     ENCODE = None  # staticmethod set by subclass
 
     def out_caps(self, config: TensorsConfig) -> Caps:
-        return Caps("application/octet-stream")
+        # reference media names (``other/flexbuf`` etc.): tensor_converter
+        # auto-dispatches the matching converter subplugin from these, so
+        # ``tensor_decoder mode=flexbuf ! other/flexbuf !
+        # tensor_converter`` chains run verbatim
+        return Caps(f"other/{self.MODE}")
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         blob = np.frombuffer(type(self).ENCODE(buf, config), np.uint8).copy()

@@ -50,12 +50,13 @@ def proto_to_frame(data: bytes) -> Buffer:
 
 @register_decoder
 class ProtobufDecoder(Decoder):
-    """tensors → application/octet-stream protobuf frames."""
+    """tensors → other/protobuf frames (reference media name — the
+    converter auto-dispatches its protobuf subplugin from the caps)."""
 
     MODE = "protobuf"
 
     def out_caps(self, config: TensorsConfig) -> Caps:
-        return Caps("application/octet-stream")
+        return Caps("other/protobuf")
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         blob = np.frombuffer(frame_to_proto(buf), np.uint8).copy()

@@ -70,15 +70,31 @@ class TensorConverter(Element):
         self._pending.clear()
         fpt = int(self.frames_per_tensor)
         if self.mode and self.mode not in ("auto",):
-            # "custom:<name>" or a registered converter subplugin name
-            # (protobuf/flexbuf/flatbuf/... — reference external converters)
+            # "custom:<name>", "custom-script:<path.py>" (the reference's
+            # python CustomConverter contract), or a registered converter
+            # subplugin name (protobuf/flexbuf/flatbuf/...)
             name = self.mode.split(":", 1)[1] if ":" in self.mode else self.mode
-            self._custom = get_subplugin(SubpluginType.CONVERTER, name)
+            if self.mode.startswith("custom-script"):
+                from ..converters.pyscript import load_script_converter
+
+                self._custom = load_script_converter(name)
+            else:
+                self._custom = get_subplugin(SubpluginType.CONVERTER, name)
             if self._custom is None:
                 raise ValueError(f"tensor_converter: no converter subplugin "
                                  f"{name!r} (mode={self.mode!r})")
             self._out_config = None  # subplugin decides per-buffer
             return
+        if self._media.startswith("other/") and self._media != "other/tensors":
+            # reference auto-dispatch: other/<name> caps route to the
+            # registered converter subplugin of that name (flexbuf/
+            # flatbuf/protobuf boundary media)
+            sub = get_subplugin(SubpluginType.CONVERTER,
+                                self._media.split("/", 1)[1])
+            if sub is not None:
+                self._custom = sub
+                self._out_config = None
+                return
 
         rate = caps.get("framerate", Fraction(0, 1))
         if self._media == "video/x-raw":

@@ -121,6 +121,35 @@ class FakeSink(Element):
 
 
 @register_element
+class MultiFileSink(Element):
+    """gst multifilesink: writes each buffer to ``location`` expanded as a
+    printf pattern (``out_%1d.log``) with a running index — the dump-side
+    pair of multifilesrc in the reference's converter SSAT strings."""
+
+    ELEMENT_NAME = "multifilesink"
+
+    def __init__(self, name: Optional[str] = None, **props: Any):
+        self.location: Optional[str] = None
+        self.index = 0
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self._idx = 0
+
+    def start(self) -> None:
+        if not self.location or "%" not in self.location:
+            raise ValueError(
+                "multifilesink needs a printf-style location pattern")
+        self._idx = int(self.index)
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        with open(self.location % self._idx, "wb") as f:
+            for m in buf.memories:
+                f.write(m.tobytes())
+        self._idx += 1
+        return FlowReturn.OK
+
+
+@register_element
 class FileSink(Element):
     """Appends raw tensor bytes to ``location`` (gst filesink; SSAT golden
     compares read these dumps)."""

@@ -13,7 +13,6 @@ Reference equivalents:
 
 from __future__ import annotations
 
-import importlib.util
 import os
 import threading
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -127,25 +126,33 @@ class Python3Filter(FilterFramework):
         path = props.model_path
         if not path or not os.path.isfile(path):
             raise FileNotFoundError(f"python3 filter script not found: {path}")
-        spec = importlib.util.spec_from_file_location(
-            f"nns_tpu_pyfilter_{abs(hash(path))}", path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        from ..converters.pyscript import load_script_module
+
+        mod = load_script_module(path)
         if hasattr(mod, "make_filter"):
             self._obj = mod.make_filter(props.custom_dict())
         elif hasattr(mod, "CustomFilter"):
             # reference semantics: custom= splits on spaces into separate
-            # constructor args (tensor_filter_python3.cc:275 g_strsplit)
+            # constructor args (tensor_filter_python3.cc:275 g_strsplit).
+            # Whether the constructor TAKES arguments is decided by its
+            # signature, not by catching TypeError (which would mask a
+            # genuine failure inside the constructor body).
+            import inspect
+
             args = tuple(props.custom.split()) if props.custom else ()
-            try:
-                self._obj = mod.CustomFilter(*args)
-            except TypeError:
-                if not args:
-                    raise
-                # native-contract script with a no-arg constructor
-                # (options arrive via make_filter there): custom= is
-                # ignored rather than failing open
-                self._obj = mod.CustomFilter()
+            if args:
+                try:
+                    sig = inspect.signature(mod.CustomFilter.__init__)
+                    takes_args = len(sig.parameters) > 1 or any(
+                        p.kind is inspect.Parameter.VAR_POSITIONAL
+                        for p in sig.parameters.values())
+                except (TypeError, ValueError):
+                    takes_args = True
+                if not takes_args:
+                    # native-contract no-arg constructor: custom= is
+                    # carried by make_filter there, ignore it here
+                    args = ()
+            self._obj = mod.CustomFilter(*args)
         else:
             raise ValueError(f"{path}: must define CustomFilter or make_filter")
         self._ref_flavor = hasattr(self._obj, "getInputDim") or \

@@ -60,8 +60,9 @@ def shapes_to_info(shapes: Optional[Sequence[TensorShape]]
     infos = []
     for s in shapes:
         dims = [int(d) for d in s.getDims()]
-        while len(dims) > 1 and dims[-1] in (0, 1):
+        while len(dims) > 1 and dims[-1] == 1:
             dims.pop()  # reference pads rank to 4 with 1s
+        # a 0 dim (script bug) is NOT stripped: TensorInfo rejects it
         infos.append(TensorInfo(tuple(dims),
                                 TensorDType.parse(np.dtype(s.getType()))))
     return TensorsInfo(tuple(infos))

@@ -52,7 +52,8 @@ class CapsFilter(Element):
 
 
 _MEDIA_TYPES = ("video/x-raw", "audio/x-raw", "text/x-raw",
-                "application/octet-stream", "other/tensor", "other/tensors")
+                "application/octet-stream", "other/tensor", "other/tensors",
+                "other/flexbuf", "other/flatbuf", "other/protobuf")
 
 _INT_FIELDS = {"width", "height", "channels", "rate", "num"}
 
