@@ -2,8 +2,13 @@
 
 Reference: gst/nnstreamer/tensor_filter/tensor_filter_custom.c loading .so
 files that implement the custom-filter ABI (tensor_filter_custom.h:46-143).
-Our ABI is native/nns_custom.h (flat C symbols, ctypes-loaded): see that
-header for the contract and native/examples/ for a sample filter.
+
+TWO binary contracts load here, auto-detected by exported symbol:
+ * the REFERENCE's ``NNStreamer_custom`` vtable (a .so compiled against
+   the reference's own headers runs unmodified — filters/gst_custom_abi.py
+   maps the pure-C structs with ctypes);
+ * our flat ABI, native/nns_custom.h (simple C symbols; see that header
+   for the contract and ``nns-new-filter --kind c`` for a generator).
 """
 
 from __future__ import annotations
@@ -31,6 +36,7 @@ class CCustomFilter(FilterFramework):
     def __init__(self) -> None:
         super().__init__()
         self._lib: Optional[ctypes.CDLL] = None
+        self._gst = None  # reference-ABI loader when detected
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
 
@@ -40,6 +46,16 @@ class CCustomFilter(FilterFramework):
         if not path or not os.path.isfile(path):
             raise FileNotFoundError(f"custom filter .so not found: {path}")
         lib = ctypes.CDLL(os.path.abspath(path))
+        from .gst_custom_abi import GstCustomSo, detect
+
+        if detect(lib):
+            # reference ABI: .so exports NNStreamer_custom (construction
+            # errors — e.g. NULL initfunc — surface as themselves)
+            self._gst = GstCustomSo(lib, os.path.abspath(path),
+                                    props.custom or "")
+            self._lib = lib
+            self._in_info, self._out_info = self._gst.get_model_info()
+            return
         for sym in ("nns_custom_get_input_info", "nns_custom_get_output_info",
                     "nns_custom_invoke"):
             if not hasattr(lib, sym):
@@ -73,15 +89,32 @@ class CCustomFilter(FilterFramework):
         return TensorsInfo.from_strings(dims.value.decode(), types.value.decode())
 
     def close(self) -> None:
-        if self._lib is not None and hasattr(self._lib, "nns_custom_exit"):
+        if getattr(self, "_gst", None) is not None:
+            self._gst.close()
+            self._gst = None
+        elif self._lib is not None and hasattr(self._lib, "nns_custom_exit"):
             self._lib.nns_custom_exit()
         self._lib = None
         super().close()
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        if getattr(self, "_gst", None) is not None:
+            out = self._gst.set_input_info(in_info)
+            if out is not None:
+                self._in_info, self._out_info = in_info, out
+                return out
+        return super().set_input_info(in_info)
 
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
         return self._in_info, self._out_info
 
     def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
+        if getattr(self, "_gst", None) is not None:
+            outs = self._gst.invoke([m.host() for m in inputs],
+                                    self._out_info)
+            if outs is None:
+                return None  # soft drop (reference ret>0 semantics)
+            return [TensorMemory(o) for o in outs]
         n_in = len(inputs)
         in_arrays = [np.ascontiguousarray(m.host()) for m in inputs]
         in_structs = (_NnsTensor * n_in)()

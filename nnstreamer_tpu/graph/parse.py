@@ -111,6 +111,8 @@ def parse_caps_string(s: str) -> Caps:
 def _auto_type(v: str) -> Any:
     if re.fullmatch(r"-?\d+", v):
         return int(v)
+    if re.fullmatch(r"0[xX][0-9a-fA-F]+", v):
+        return int(v, 16)  # gst hex props, e.g. videotestsrc color=0xFF0000
     if re.fullmatch(r"-?\d*\.\d+([eE]-?\d+)?", v):
         return float(v)
     if v.lower() in ("true", "false"):

@@ -1,0 +1,296 @@
+"""Reference custom-filter .so binary ABI (NNStreamer_custom vtable).
+
+The fixture below is OUR OWN C source compiled against the REFERENCE's
+public devel headers (tensor_filter_custom.h — the file its packagers ship
+to NN developers), so the resulting .so is exactly what an existing
+NNStreamer custom-filter plugin is: if it loads and serves here, real
+reference plugins do too.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.graph import Pipeline
+
+REF_INC = "/root/reference/gst/nnstreamer/include"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF_INC) or shutil.which("gcc") is None,
+    reason="reference headers or gcc not available")
+
+# our own plugin source, written fresh against the public ABI: a filter
+# that doubles float32 input, declares 4:1 I/O via getInputDim/getOutputDim
+_PLUGIN_SRC = r"""
+#include <stdlib.h>
+#include <string.h>
+#include "tensor_filter_custom.h"
+
+static void *pv_init (const GstTensorFilterProperties *prop)
+{
+  (void) prop;
+  return malloc (4);  /* non-NULL private data */
+}
+
+static void pv_exit (void *pd, const GstTensorFilterProperties *prop)
+{
+  (void) prop;
+  free (pd);
+}
+
+static void set_41_f32 (GstTensorsInfo *info)
+{
+  unsigned int i;
+  memset (info, 0, sizeof (*info));
+  info->num_tensors = 1;
+  info->info[0].type = _NNS_FLOAT32;
+  info->info[0].dimension[0] = 4;
+  for (i = 1; i < 4; i++)
+    info->info[0].dimension[i] = 1;
+}
+
+static int get_in (void *pd, const GstTensorFilterProperties *prop,
+    GstTensorsInfo *info)
+{
+  (void) pd; (void) prop;
+  set_41_f32 (info);
+  return 0;
+}
+
+static int get_out (void *pd, const GstTensorFilterProperties *prop,
+    GstTensorsInfo *info)
+{
+  (void) pd; (void) prop;
+  set_41_f32 (info);
+  return 0;
+}
+
+static int pv_invoke (void *pd, const GstTensorFilterProperties *prop,
+    const GstTensorMemory *input, GstTensorMemory *output)
+{
+  size_t i, n = input[0].size / sizeof (float);
+  const float *in = (const float *) input[0].data;
+  float *out = (float *) output[0].data;
+  (void) pd; (void) prop;
+  for (i = 0; i < n; i++)
+    out[i] = in[i] * 2.0f;
+  return 0;
+}
+
+static NNStreamer_custom_class cls = {
+  .initfunc = pv_init,
+  .exitfunc = pv_exit,
+  .getInputDim = get_in,
+  .getOutputDim = get_out,
+  .setInputDim = NULL,
+  .invoke = pv_invoke,
+  .allocate_invoke = NULL,
+  .destroy_notify = NULL,
+};
+
+NNStreamer_custom_class *NNStreamer_custom = &cls;
+"""
+
+
+def _build(tmp_path):
+    src = tmp_path / "ref_abi_filter.c"
+    src.write_text(_PLUGIN_SRC)
+    so = tmp_path / "libref_abi_filter.so"
+    subprocess.run(
+        ["gcc", "-O2", "-fPIC", "-shared", "-I", REF_INC,
+         "-o", str(so), str(src)],
+        check=True, capture_output=True)
+    return so
+
+
+def caps_of(dims, types):
+    return Caps.tensors(
+        TensorsConfig(TensorsInfo.from_strings(dims, types), 30))
+
+
+@needs_ref
+def test_reference_abi_so_compiles_and_serves(tmp_path):
+    so = _build(tmp_path)
+    x = np.arange(4, dtype=np.float32).reshape(1, 4)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("4:1", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="custom", model=str(so))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    np.testing.assert_allclose(
+        sink.buffers[0].memories[0].host().reshape(-1),
+        (x * 2.0).reshape(-1))
+
+
+@needs_ref
+def test_reference_abi_model_info(tmp_path):
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.c_custom import CCustomFilter
+
+    so = _build(tmp_path)
+    f = CCustomFilter()
+    f.open(FilterProps(model=str(so)))
+    ii, oi = f.get_model_info()
+    assert ii[0].dim_string == "4:1" or ii[0].dims == (4,)
+    assert str(ii[0].dtype) == "float32"
+    f.close()
+
+
+@needs_ref
+def test_flat_abi_still_loads(tmp_path):
+    """Detection must not break the flat nns_custom.h ABI."""
+    from nnstreamer_tpu.codegen import generate
+
+    generate("flatone", "c", str(tmp_path))
+    subprocess.run(["make", "-C", str(tmp_path)], check=True,
+                   capture_output=True)
+    x = np.arange(4, dtype=np.float32).reshape(1, 4)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("4:1", "float32"), data=[x])
+    filt = p.add_new("tensor_filter", framework="custom",
+                     model=str(tmp_path / "libflatone.so"))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    np.testing.assert_allclose(sink.buffers[0].memories[0].host(), x * 2.0)
+
+
+# two-tensor plugin that also reads prop->custom_properties and soft-drops
+# when it says "drop" — multi-tensor structs + the properties block offsets
+# would all break under any ctypes layout mismatch
+_PLUGIN2_SRC = r"""
+#include <stdlib.h>
+#include <string.h>
+#include "tensor_filter_custom.h"
+
+static void *pv_init (const GstTensorFilterProperties *prop)
+{
+  int *drop = malloc (sizeof (int));
+  *drop = (prop->custom_properties != NULL &&
+           strcmp (prop->custom_properties, "drop") == 0);
+  return drop;
+}
+
+static void pv_exit (void *pd, const GstTensorFilterProperties *prop)
+{
+  (void) prop;
+  free (pd);
+}
+
+static void set_two (GstTensorsInfo *info)
+{
+  unsigned int i;
+  memset (info, 0, sizeof (*info));
+  info->num_tensors = 2;
+  info->info[0].type = _NNS_FLOAT32;
+  info->info[0].dimension[0] = 3;
+  info->info[1].type = _NNS_INT32;
+  info->info[1].dimension[0] = 2;
+  for (i = 1; i < NNS_TENSOR_RANK_LIMIT; i++) {
+    info->info[0].dimension[i] = 1;
+    info->info[1].dimension[i] = 1;
+  }
+}
+
+static int get_in (void *pd, const GstTensorFilterProperties *prop,
+    GstTensorsInfo *info)
+{
+  (void) pd; (void) prop;
+  set_two (info);
+  return 0;
+}
+
+static int get_out (void *pd, const GstTensorFilterProperties *prop,
+    GstTensorsInfo *info)
+{
+  (void) pd; (void) prop;
+  set_two (info);
+  return 0;
+}
+
+static int pv_invoke (void *pd, const GstTensorFilterProperties *prop,
+    const GstTensorMemory *input, GstTensorMemory *output)
+{
+  size_t i;
+  const float *f_in = (const float *) input[0].data;
+  float *f_out = (float *) output[0].data;
+  const int32_t *i_in = (const int32_t *) input[1].data;
+  int32_t *i_out = (int32_t *) output[1].data;
+  (void) prop;
+  if (*(int *) pd)
+    return 1;  /* soft drop */
+  for (i = 0; i < input[0].size / sizeof (float); i++)
+    f_out[i] = f_in[i] + 0.5f;
+  for (i = 0; i < input[1].size / sizeof (int32_t); i++)
+    i_out[i] = i_in[i] - 1;
+  return 0;
+}
+
+static NNStreamer_custom_class cls = {
+  .initfunc = pv_init,
+  .exitfunc = pv_exit,
+  .getInputDim = get_in,
+  .getOutputDim = get_out,
+  .setInputDim = NULL,
+  .invoke = pv_invoke,
+  .allocate_invoke = NULL,
+  .destroy_notify = NULL,
+};
+
+NNStreamer_custom_class *NNStreamer_custom = &cls;
+"""
+
+
+def _build2(tmp_path):
+    src = tmp_path / "ref_abi_two.c"
+    src.write_text(_PLUGIN2_SRC)
+    so = tmp_path / "libref_abi_two.so"
+    subprocess.run(
+        ["gcc", "-O2", "-fPIC", "-shared", "-I", REF_INC,
+         "-o", str(so), str(src)],
+        check=True, capture_output=True)
+    return so
+
+
+@needs_ref
+def test_reference_abi_multi_tensor_and_custom_props(tmp_path):
+    """Two-tensor I/O + custom_properties readback: any struct layout
+    drift between the compiled .so and the ctypes mapping breaks this."""
+    so = _build2(tmp_path)
+    f32 = np.array([1.0, 2.0, 3.0], np.float32).reshape(1, 3)
+    i32 = np.array([10, 20], np.int32).reshape(1, 2)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("3:1,2:1", "float32,int32"),
+                    data=[(f32, i32)])
+    filt = p.add_new("tensor_filter", framework="custom", model=str(so))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    out = sink.buffers[0]
+    np.testing.assert_allclose(out.memories[0].host().reshape(-1),
+                               [1.5, 2.5, 3.5])
+    np.testing.assert_array_equal(out.memories[1].host().reshape(-1),
+                                  [9, 19])
+
+
+@needs_ref
+def test_reference_abi_custom_props_soft_drop(tmp_path):
+    """custom=drop reaches the plugin through prop->custom_properties
+    (offset-sensitive) and its ret>0 soft-drops every frame."""
+    so = _build2(tmp_path)
+    f32 = np.zeros((1, 3), np.float32)
+    i32 = np.zeros((1, 2), np.int32)
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=caps_of("3:1,2:1", "float32,int32"),
+                    data=[(f32, i32)] * 3)
+    filt = p.add_new("tensor_filter", framework="custom", model=str(so),
+                     custom="drop")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, sink)
+    p.run(timeout=60)
+    assert sink.num_buffers == 0  # every frame soft-dropped
