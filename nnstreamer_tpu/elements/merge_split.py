@@ -123,6 +123,7 @@ class TensorSplit(Element):
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
         self._sizes: Optional[List[int]] = None
+        self._ref_segs = None  # reference dim-spec grammar (flat regions)
 
     @property
     def _nns_axis(self) -> int:
@@ -134,7 +135,38 @@ class TensorSplit(Element):
         info = cfg.info[0]
         if not self.tensorseg:
             raise ValueError("tensor_split requires tensorseg")
-        self._sizes = [int(s) for s in str(self.tensorseg).split(",")]
+        segs = str(self.tensorseg).split(",")
+        self._ref_segs = None
+        if ":" in segs[0]:
+            # reference grammar: each segment is a FULL dims spec
+            # ("1:100:100,2:100:100") and the output is a CONTIGUOUS
+            # region of the flat raster — offset/size are element counts
+            # (gst_tensor_split_get_splited, gsttensorsplit.c:414-445:
+            # memcpy from src + sum(prev counts)), NOT a strided slice
+            seg_infos = []
+            total = 0
+            for s in segs:
+                dims = [int(d) for d in s.split(":")]
+                while len(dims) > 1 and dims[-1] == 1:
+                    dims.pop()
+                ti = TensorInfo(tuple(dims), info.dtype)
+                seg_infos.append(ti)
+                total += ti.num_elements
+            if total != info.num_elements:
+                raise ValueError(
+                    f"tensorseg {segs} covers {total} elements, input "
+                    f"has {info.num_elements}")
+            self._ref_segs = seg_infos
+            self._sizes = [t.num_elements for t in seg_infos]
+            if len(self.src_pads) != len(seg_infos):
+                raise ValueError(
+                    f"tensor_split: {len(seg_infos)} segments but "
+                    f"{len(self.src_pads)} pads linked")
+            for i, ti in enumerate(seg_infos):
+                self.send_caps(Caps.tensors(TensorsConfig(
+                    TensorsInfo.of(ti), cfg.rate)), i)
+            return
+        self._sizes = [int(s) for s in segs]
         ax = self._nns_axis
         if sum(self._sizes) != info.dims[ax]:
             raise ValueError(
@@ -153,8 +185,21 @@ class TensorSplit(Element):
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         m = buf.memories[0]
         arr = m.device() if m.is_device else m.host()
-        np_axis = arr.ndim - 1 - self._nns_axis
         ret = FlowReturn.OK
+        if getattr(self, "_ref_segs", None) is not None:
+            # reference semantics: contiguous element ranges of the raster
+            flat = arr.reshape(-1)
+            off = 0
+            for i, ti in enumerate(self._ref_segs):
+                n = ti.num_elements
+                out = flat[off:off + n].reshape(ti.shape)
+                off += n
+                r = self.push(
+                    buf.with_memories([TensorMemory(out, ti)]), i)
+                if r is FlowReturn.ERROR:
+                    ret = r
+            return ret
+        np_axis = arr.ndim - 1 - self._nns_axis
         off = 0
         for i, s in enumerate(self._sizes):
             sl = [slice(None)] * arr.ndim

@@ -296,6 +296,19 @@ def _split_branches(description: str):
     def flush_segment() -> None:
         if not seg_tokens:
             return
+        # gst caps allow spaces around '=' ("format = RGB"): merge the
+        # three-token form (and dangling "k=" / "=v" halves) back into
+        # one k=v token before prop parsing
+        merged: List[str] = []
+        for t in seg_tokens:
+            if merged and (t == "=" or (merged[-1].endswith("=")
+                                        and "=" not in t)
+                           or (t.startswith("=") and "="
+                               not in merged[-1])):
+                merged[-1] += t
+            else:
+                merged.append(t)
+        seg_tokens[:] = merged
         head = seg_tokens[0]
         if len(seg_tokens) == 1 and not any(c in head for c in "=/") and \
                 (head.endswith(".") or _PAD_REF_RE.fullmatch(head)):
@@ -320,8 +333,12 @@ def _split_branches(description: str):
             flush_segment()
             continue
         # a segment token arriving while another segment is open (no "!"
-        # in between) ends the current branch and starts a new one
-        if seg_tokens and "=" not in tok \
+        # in between) ends the current branch and starts a new one —
+        # UNLESS a spaced '=' is pending ("name = queue" is a prop whose
+        # value merges in flush_segment, not a new branch)
+        eq_pending = bool(seg_tokens) and (seg_tokens[-1] == "="
+                                           or seg_tokens[-1].endswith("="))
+        if seg_tokens and "=" not in tok and not eq_pending \
                 and (tok.endswith(".") or _PAD_REF_RE.fullmatch(tok)
                      or _looks_like_element(tok)):
             flush_segment()

@@ -539,3 +539,61 @@ def test_multifilesink_writes_per_buffer(tmp_path):
     p.run(timeout=60)
     for i in range(3):
         assert (tmp_path / f"out_{i}.log").stat().st_size == 4 * 4 * 3
+
+
+def test_reference_split_single_seg_string(tmp_path):
+    """nnstreamer_split/runTest.sh case 1, verbatim (incl. the spaced
+    `format = RGB` caps): one tensorseg = identity split."""
+    from PIL import Image
+
+    rng = np.random.default_rng(13)
+    arr = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    img = tmp_path / "testcase_RGB.png"
+    Image.fromarray(arr).save(img)
+    log = tmp_path / "split00.log"
+    p = parse_pipeline(
+        f"filesrc location={img} ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw, format = RGB, width=16, height=16, "
+        "framerate=0/1 ! tensor_converter ! tensor_split name=split "
+        "tensorseg=3:16:16 "
+        f"split. ! queue ! filesink location={log}")
+    p.run(timeout=120)
+    np.testing.assert_array_equal(
+        np.frombuffer(log.read_bytes(), np.uint8).reshape(16, 16, 3), arr)
+
+
+def test_reference_split_two_segs_string(tmp_path):
+    """nnstreamer_split/runTest.sh case 2 shape. Reference semantics are
+    FLAT contiguous regions of the raster (gsttensorsplit.c:414-445
+    memcpy at summed element offsets), NOT strided channel planes — the
+    golden below is byte-for-byte what the reference's memcpy yields."""
+    from PIL import Image
+
+    rng = np.random.default_rng(14)
+    arr = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    img = tmp_path / "t2.png"
+    Image.fromarray(arr).save(img)
+    l0, l1 = tmp_path / "split01_0.log", tmp_path / "split01_1.log"
+    p = parse_pipeline(
+        f"filesrc location={img} ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw, format = RGB, width=16, height=16, "
+        "framerate=0/1 ! tensor_converter ! tensor_split name=split "
+        "tensorseg=1:16:16,2:16:16 "
+        f"split. ! queue ! filesink location={l0} "
+        f"split. ! queue ! filesink location={l1}")
+    p.run(timeout=120)
+    flat = arr.reshape(-1)
+    np.testing.assert_array_equal(
+        np.frombuffer(l0.read_bytes(), np.uint8), flat[:256])
+    np.testing.assert_array_equal(
+        np.frombuffer(l1.read_bytes(), np.uint8), flat[256:])
+
+
+def test_spaced_equals_prop_does_not_split_branch():
+    """'name = queue' is one prop with value 'queue', not a new branch."""
+    from nnstreamer_tpu.graph.parse import parse_pipeline as pp
+
+    p = pp("videotestsrc num-buffers=1 width=4 height=4 ! "
+           "tee name = t ! queue ! fakesink t. ! queue ! fakesink")
+    assert "t" in p.elements
+    p.run(timeout=30)
