@@ -186,7 +186,7 @@ class TensorSplit(Element):
         m = buf.memories[0]
         arr = m.device() if m.is_device else m.host()
         ret = FlowReturn.OK
-        if getattr(self, "_ref_segs", None) is not None:
+        if self._ref_segs is not None:
             # reference semantics: contiguous element ranges of the raster
             flat = arr.reshape(-1)
             off = 0

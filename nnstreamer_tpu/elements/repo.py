@@ -54,9 +54,34 @@ class TensorRepoSink(Element):
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
 
+    def prepare(self) -> None:
+        # a slot EOS'd (or left full) by a previous run must not swallow
+        # this run's frames: slots are process-global, runs are not.
+        # Runs in the pre-start phase — no source thread exists yet, so
+        # this cannot discard a live frame.
+        slot = _slot(int(self.slot_index))
+        with slot.cv:
+            slot.eos = False
+            slot.buffer = None
+            slot.cv.notify_all()
+
+    def request_stop(self) -> None:
+        super().request_stop()
+        slot = _slot(int(self.slot_index))
+        with slot.cv:
+            slot.cv.notify_all()  # wake a chain blocked on a full slot
+
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         slot = _slot(int(self.slot_index))
         with slot.cv:
+            # rendezvous, not latest-wins: the reference's set_buffer
+            # blocks while the slot is occupied (tensor_repo.c:176-178
+            # waits on cond_pull) so no frame is ever overwritten/lost
+            while slot.buffer is not None and not slot.eos \
+                    and not self._quitting:
+                slot.cv.wait(0.05)
+            if slot.eos or self._quitting:
+                return FlowReturn.OK
             slot.buffer = buf
             slot.cv.notify_all()
         return FlowReturn.OK
@@ -85,9 +110,21 @@ class TensorRepoSrc(SourceElement):
         self._sent_initial = False
         self._count = 0
 
+    def prepare(self) -> None:
+        slot = _slot(int(self.slot_index))
+        with slot.cv:
+            slot.eos = False  # fresh run over a process-global slot
+            slot.buffer = None
+
     def negotiate(self) -> Caps:
         self._sent_initial = False
         self._count = 0
+        if isinstance(self.caps, str):
+            # gst string prop form, e.g. the reference's
+            # caps="other/tensor,dimension=(string)3:16:16:1,..."
+            from ..graph.parse import parse_caps_string
+
+            self.caps = parse_caps_string(self.caps)
         if self.caps is not None:
             return self.caps
         if self.dims and self.types:
@@ -114,6 +151,7 @@ class TensorRepoSrc(SourceElement):
                 return None
             buf = slot.buffer
             slot.buffer = None
+            slot.cv.notify_all()  # wake a producer blocked on a full slot
         self._count += 1
         out = buf.with_memories(buf.memories, config=buf.config)
         out.pts = buf.pts

@@ -118,6 +118,7 @@ class Element:
         self.bus: Optional[Bus] = None  # set by Pipeline.add
         self.pipeline: Optional[Any] = None
         self.started = False
+        self._quitting = False  # set by Pipeline.stop's pre-pass
         self._lock = threading.RLock()
         self._eos_pads: set = set()
         self._unknown_props = {}
@@ -195,11 +196,26 @@ class Element:
         return not self.src_pads
 
     # -- lifecycle ---------------------------------------------------------- #
+    def prepare(self) -> None:
+        """Pre-start phase: Pipeline.start calls this on EVERY element
+        before ANY element's start() runs (so before any source thread
+        exists). Reset process-global state here (e.g. repo slots) —
+        doing it in start()/negotiate() would race already-running
+        producers."""
+
     def start(self) -> None:  # override for resource acquisition
         pass
 
     def stop(self) -> None:  # override for teardown
         pass
+
+    def request_stop(self) -> None:
+        """Pre-stop broadcast: Pipeline.stop calls this on EVERY element
+        BEFORE joining any thread, so chain()s blocked inside another
+        element (rendezvous slots, backpressure waits) can bail out
+        promptly instead of stalling the source joins. Overrides should
+        call super() and wake their condition variables."""
+        self._quitting = True
 
     # -- entry points (locking + dispatch) ----------------------------------- #
     def _chain_entry(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:

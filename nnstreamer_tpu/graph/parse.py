@@ -94,6 +94,8 @@ def parse_caps_string(s: str) -> Caps:
         v = v.strip('"')
         if k in ("dimensions", "dimension"):
             k = "dims"
+        elif k == "type":  # other/tensor singular field names
+            k = "types"
         elif k in ("num_tensors",):
             k = "num"
         if k in _INT_FIELDS:
@@ -298,11 +300,13 @@ def _split_branches(description: str):
             return
         # gst caps allow spaces around '=' ("format = RGB"): merge the
         # three-token form (and dangling "k=" / "=v" halves) back into
-        # one k=v token before prop parsing
+        # one k=v token before prop parsing. A DANGLING key is "k=" with
+        # no earlier '=' — a complete value that merely ENDS in '='
+        # (option=YWJjZA==) must not swallow the next token.
         merged: List[str] = []
         for t in seg_tokens:
-            if merged and (t == "=" or (merged[-1].endswith("=")
-                                        and "=" not in t)
+            if merged and (t == "="
+                           or (_dangling_key(merged[-1]) and "=" not in t)
                            or (t.startswith("=") and "="
                                not in merged[-1])):
                 merged[-1] += t
@@ -337,7 +341,7 @@ def _split_branches(description: str):
         # UNLESS a spaced '=' is pending ("name = queue" is a prop whose
         # value merges in flush_segment, not a new branch)
         eq_pending = bool(seg_tokens) and (seg_tokens[-1] == "="
-                                           or seg_tokens[-1].endswith("="))
+                                           or _dangling_key(seg_tokens[-1]))
         if seg_tokens and "=" not in tok and not eq_pending \
                 and (tok.endswith(".") or _PAD_REF_RE.fullmatch(tok)
                      or _looks_like_element(tok)):
@@ -355,6 +359,12 @@ def _split_branches(description: str):
 #: gst pad reference: ``name.sink_0`` / ``name.src_1`` (the mux/demux
 #: SSAT strings link through explicit pads)
 _PAD_REF_RE = re.compile(r"[A-Za-z_]\w*\.(sink|src)_\d+")
+
+
+def _dangling_key(tok: str) -> bool:
+    """True for a prop KEY awaiting its value ("name=") — exactly one
+    '=' and it is the last character."""
+    return tok.endswith("=") and "=" not in tok[:-1]
 
 
 def _looks_like_element(tok: str) -> bool:

@@ -307,6 +307,8 @@ class Pipeline:
         self.bus.clear()
         for el in self.elements.values():
             self._validate_links(el)
+            el._quitting = False
+            el.prepare()
             el._eos_pads.clear()
             for p in el.sink_pads + el.src_pads:
                 p.eos = False
@@ -347,6 +349,8 @@ class Pipeline:
     def stop(self) -> None:
         if not self.running:
             return
+        for el in self.elements.values():
+            el.request_stop()  # unblock cross-element waits before joins
         for el in self.elements.values():
             if el.is_source:
                 el.stop()

@@ -597,3 +597,78 @@ def test_spaced_equals_prop_does_not_split_branch():
            "tee name = t ! queue ! fakesink t. ! queue ! fakesink")
     assert "t" in p.elements
     p.run(timeout=30)
+
+
+def test_reference_repo_loop_string(tmp_path):
+    """nnstreamer_repo/runTest.sh case 1, verbatim: a reposink/reposrc
+    handoff with the reference's caps-string prop on reposrc; each input
+    frame comes back out through the repo slot."""
+    from PIL import Image
+
+    from nnstreamer_tpu.elements.repo import reset_repo
+
+    reset_repo()
+    rng = np.random.default_rng(15)
+    arrs = [rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+            for _ in range(3)]
+    for i, a in enumerate(arrs):
+        Image.fromarray(a).save(tmp_path / f"testsequence_{i}.png")
+    p = parse_pipeline(
+        f'multifilesrc location={tmp_path}/testsequence_%1d.png index=0 '
+        'caps="image/png,framerate=(fraction)3/1" ! pngdec ! '
+        'tensor_converter ! queue ! tensor_reposink silent=false '
+        'slot-index=0 '
+        'tensor_reposrc silent=false slot-index=0 '
+        'caps="other/tensor,dimension=(string)3:16:16:1,'
+        'type=(string)uint8,framerate=(fraction)3/1" ! '
+        f'multifilesink location={tmp_path}/testsequence01_%1d.log')
+    p.run(timeout=120)
+    # the repo src emits one zero initial frame, then the handed-off ones
+    first = np.frombuffer(
+        (tmp_path / "testsequence01_0.log").read_bytes(), np.uint8)
+    assert first.size == 16 * 16 * 3 and not first.any()
+    for i, a in enumerate(arrs[:2]):
+        got = np.frombuffer(
+            (tmp_path / f"testsequence01_{i + 1}.log").read_bytes(),
+            np.uint8)
+        np.testing.assert_array_equal(got, a.reshape(-1))
+
+
+def test_repo_slot_reusable_across_runs(tmp_path):
+    """A slot EOS'd by one run must serve a fresh run without
+    reset_repo() (slots are process-global, runs are not)."""
+    def run_once(seed):
+        x = np.full((1, 4), float(seed), np.float32)
+        p = parse_pipeline(
+            "appsrc name=a ! tensor_reposink slot-index=55 "
+            "tensor_reposrc slot-index=55 dims=4:1 types=float32 "
+            "no-initial=true ! tensor_sink name=s store=true")
+        p["a"].caps = __import__(
+            "nnstreamer_tpu.core", fromlist=["Caps"]).Caps.tensors(
+            __import__("nnstreamer_tpu.core", fromlist=["x"]).TensorsConfig(
+                __import__("nnstreamer_tpu.core",
+                           fromlist=["x"]).TensorsInfo.from_strings(
+                    "4:1", "float32")))
+        p["a"].data = [x]
+        p.run(timeout=60)
+        return p["s"].buffers[0].memories[0].host()
+
+    np.testing.assert_array_equal(run_once(1), np.full((1, 4), 1.0))
+    np.testing.assert_array_equal(run_once(2), np.full((1, 4), 2.0))
+
+
+def test_base64ish_value_does_not_swallow_branch():
+    """A complete prop value ending in '=' must not merge the following
+    branch token."""
+    p = parse_pipeline(
+        "videotestsrc num-buffers=1 width=4 height=4 ! tensor_converter "
+        "! tee name=t ! queue ! tensor_sink name=x store=true "
+        "t. ! queue ! tensor_sink name=y store=true")
+    # same topology but with a trailing-'=' value in an earlier prop
+    p2 = parse_pipeline(
+        'videotestsrc num-buffers=1 width=4 height=4 name=AB== ! '
+        "tensor_converter ! tee name=t ! queue ! fakesink "
+        "t. ! queue ! fakesink")
+    assert "t" in p2.elements
+    p.run(timeout=30)
+    assert p["x"].num_buffers == 1 and p["y"].num_buffers == 1
