@@ -280,6 +280,17 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "SQUEEZE":
         sq = fb.vec_np(opos, 0, "<i4")
         o["squeeze_dims"] = [] if sq is None else [int(x) for x in sq]
+    elif op == "STRIDED_SLICE":
+        for i, k in enumerate(("begin_mask", "end_mask", "ellipsis_mask",
+                               "new_axis_mask", "shrink_axis_mask")):
+            o[k] = fb.scalar(opos, i, fb.i32, 0)
+    elif op == "TRANSPOSE_CONV":
+        # TransposeConvOptions: 0 padding, 1 stride_w, 2 stride_h
+        # (later schema adds fused_activation at 3; default NONE)
+        o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
     elif op == "LEAKY_RELU":
         o["alpha"] = fb.scalar(opos, 0, fb.f32, 0.0)
     elif op in ("DEPTH_TO_SPACE", "SPACE_TO_DEPTH"):
@@ -736,6 +747,67 @@ class _Lowerer:
             y = x[idx]
         elif name == "PACK":
             y = jnp.stack([env[i] for i in op.inputs], axis=o.get("axis", 0))
+        elif name == "STRIDED_SLICE":
+            x = get(0)
+            begin = np.asarray(get(1)).reshape(-1)
+            end = np.asarray(get(2)).reshape(-1)
+            strides = np.asarray(get(3)).reshape(-1) if get(3) is not None \
+                else np.ones_like(begin)
+            if o.get("ellipsis_mask") or o.get("new_axis_mask"):
+                raise NotImplementedError(
+                    "STRIDED_SLICE ellipsis/new-axis masks")
+            idx = []
+            for d in range(x.ndim):
+                b = int(begin[d]) if d < len(begin) else 0
+                e = int(end[d]) if d < len(end) else x.shape[d]
+                s = int(strides[d]) if d < len(strides) else 1
+                # StartForAxis semantics (strided_slice_logic.h): the
+                # begin_mask and in-range clamping resolve the start
+                # BEFORE shrink turns it into a single index
+                if o.get("begin_mask", 0) & (1 << d):
+                    b = 0 if s > 0 else x.shape[d] - 1
+                elif b < 0:
+                    b += x.shape[d]
+                b = int(np.clip(b, 0, x.shape[d] - 1))
+                if o.get("shrink_axis_mask", 0) & (1 << d):
+                    idx.append(b)
+                    continue
+                if o.get("end_mask", 0) & (1 << d):
+                    e = None
+                idx.append(slice(b, e, s))
+            y = x[tuple(idx)]
+        elif name == "TRANSPOSE_CONV":
+            # inputs: 0 output_shape, 1 weights (OHWI, O=output ch),
+            # 2 activations, 3 optional bias
+            out_shape = np.asarray(get(0)).reshape(-1)
+            w, x = get(1), get(2)
+            b = get(3)
+            # tflite transpose-conv == gradient of a conv: lax transposed
+            # conv via conv_general_dilated with lhs_dilation = stride
+            oh, ow = int(out_shape[1]), int(out_shape[2])
+            sh, sw = o["stride_h"], o["stride_w"]
+            kh, kw = w.shape[1], w.shape[2]
+            # scatter semantics: out[y*s + fy - P] += x[y] * w[fy]. As a
+            # conv: lhs_dilation = stride, kernel flipped spatially,
+            # pad_low = k-1-P, pad_high chosen to land on out_shape
+            # (dilated + pl + ph - k + 1 == out). VALID: P = 0.
+            def pads(in_sz, out_sz, k, s, same):
+                total = max((in_sz - 1) * s + k - out_sz, 0) if same else 0
+                p = total // 2
+                return (k - 1 - p, out_sz - (in_sz - 1) * s - 1 + p)
+
+            same = _PAD_MODES[o["padding"]] == "SAME"
+            pad_h = pads(x.shape[1], oh, kh, sh, same)
+            pad_w = pads(x.shape[2], ow, kw, sw, same)
+            # tflite transpose-conv weights are (out_ch, kh, kw, in_ch):
+            # flip spatially, contract in_ch, emit out_ch → HWIO
+            wt = jnp.transpose(w[:, ::-1, ::-1, :], (1, 2, 3, 0))
+            y = lax.conv_general_dilated(
+                x, wt, (1, 1), (pad_h, pad_w), lhs_dilation=(sh, sw),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if b is not None:
+                y = y + b
+            y = _fused_act(y, o.get("activation", 0))
         else:
             raise NotImplementedError(
                 f"{os.path.basename(self.m.path)}: TFLite op {name!r} is "

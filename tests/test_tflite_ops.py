@@ -555,3 +555,116 @@ def test_unsupported_op_reports_name(tmp_path):
 
         bundle = load_tflite(str(path))
         jax.jit(bundle.fn())(np.zeros((1, 4), np.float32))
+
+
+def transpose_conv_options(stride=2, padding=0):
+    def build(b):
+        b.StartObject(4)            # TransposeConvOptions
+        b.PrependInt8Slot(0, padding, 0)
+        b.PrependInt32Slot(1, stride, 1)
+        b.PrependInt32Slot(2, stride, 1)
+        return b.EndObject()
+
+    return (67, build)              # BuiltinOptions.TransposeConvOptions
+
+
+def np_transpose_conv(x, w, stride, out_h, out_w, same):
+    """Scatter oracle: out[b, y*s+fy-P, x*s+fx-P', oc] += x*w (tflite
+    reference kernel semantics)."""
+    n, ih, iw, ic = x.shape
+    oc, kh, kw, _ = w.shape
+    ph = (max((ih - 1) * stride + kh - out_h, 0) // 2) if same else 0
+    pw = (max((iw - 1) * stride + kw - out_w, 0) // 2) if same else 0
+    out = np.zeros((n, out_h, out_w, oc), np.float32)
+    for b in range(n):
+        for y in range(ih):
+            for xx in range(iw):
+                for fy in range(kh):
+                    for fx in range(kw):
+                        oy, ox = y * stride + fy - ph, xx * stride + fx - pw
+                        if 0 <= oy < out_h and 0 <= ox < out_w:
+                            for o_ in range(oc):
+                                out[b, oy, ox, o_] += np.dot(
+                                    x[b, y, xx], w[o_, fy, fx])
+    return out
+
+
+@pytest.mark.parametrize("padding,out_hw", [(0, (6, 6)), (1, (7, 7))])
+def test_transpose_conv(tmp_path, padding, out_hw):
+    # padding 0 = SAME (out = in*s), 1 = VALID (out = (in-1)*s + k)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 3, 3, 2)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 2)).astype(np.float32)
+    oh, ow = out_hw
+    out_shape = np.array([1, oh, ow, 4], np.int32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(4,), type=INT32, data=out_shape),
+            dict(shape=(4, 3, 3, 2), type=F32, data=w),
+            dict(shape=(1, 3, 3, 2), type=F32),
+            dict(shape=(1, oh, ow, 4), type=F32),
+        ],
+        operators=[dict(code=67, inputs=[0, 1, 2], outputs=[3],
+                        options=transpose_conv_options(
+                            stride=2, padding=padding))],
+        inputs=[2], outputs=[3])
+    (out,) = _run(blob, tmp_path, x)
+    want = np_transpose_conv(x, w, 2, oh, ow, same=(padding == 0))
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_strided_slice(tmp_path):
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    def ss_opts(b):
+        b.StartObject(5)            # StridedSliceOptions
+        b.PrependInt32Slot(0, 0, 0)  # begin_mask
+        b.PrependInt32Slot(1, 0, 0)  # end_mask
+        b.PrependInt32Slot(4, 1, 0)  # shrink_axis_mask: dim 0
+        return b.EndObject()
+
+    begin = np.array([1, 0, 1], np.int32)
+    end = np.array([2, 3, 4], np.int32)
+    strides = np.array([1, 1, 2], np.int32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(2, 3, 4), type=F32),
+            dict(shape=(3,), type=INT32, data=begin),
+            dict(shape=(3,), type=INT32, data=end),
+            dict(shape=(3,), type=INT32, data=strides),
+            dict(shape=(3, 2), type=F32),
+        ],
+        operators=[dict(code=45, inputs=[0, 1, 2, 3], outputs=[4],
+                        options=(26, ss_opts))],
+        inputs=[0], outputs=[4])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_array_equal(out, x[1, 0:3, 1:4:2])
+
+
+def test_strided_slice_shrink_with_begin_mask(tmp_path):
+    """begin_mask resolves the start BEFORE shrink (StartForAxis), and
+    out-of-range begins clamp instead of raising."""
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+    def ss_opts(b):
+        b.StartObject(5)
+        b.PrependInt32Slot(0, 0b01, 0)  # begin_mask on dim 0
+        b.PrependInt32Slot(4, 0b01, 0)  # shrink dim 0
+        return b.EndObject()
+
+    begin = np.array([7, 1], np.int32)   # dim0 masked (7 ignored->0)
+    end = np.array([8, 4], np.int32)
+    strides = np.array([1, 1], np.int32)
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(3, 4), type=F32),
+            dict(shape=(2,), type=INT32, data=begin),
+            dict(shape=(2,), type=INT32, data=end),
+            dict(shape=(2,), type=INT32, data=strides),
+            dict(shape=(3,), type=F32),
+        ],
+        operators=[dict(code=45, inputs=[0, 1, 2, 3], outputs=[4],
+                        options=(26, ss_opts))],
+        inputs=[0], outputs=[4])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_array_equal(out, x[0, 1:4])
