@@ -758,23 +758,41 @@ class _Lowerer:
                     "STRIDED_SLICE ellipsis/new-axis masks")
             idx = []
             for d in range(x.ndim):
+                dim = x.shape[d]
                 b = int(begin[d]) if d < len(begin) else 0
-                e = int(end[d]) if d < len(end) else x.shape[d]
+                e = int(end[d]) if d < len(end) else dim
                 s = int(strides[d]) if d < len(strides) else 1
-                # StartForAxis semantics (strided_slice_logic.h): the
-                # begin_mask and in-range clamping resolve the start
-                # BEFORE shrink turns it into a single index
+                # Start/StopForAxis semantics (strided_slice_logic.h):
+                # masks and clamping resolve BEFORE shrink; the clamp
+                # range is [0, dim] for positive stride and [-1, dim-1]
+                # for negative (dim / -1 = "exhausted" → empty slice,
+                # where -1 must NOT be handed to python slicing)
                 if o.get("begin_mask", 0) & (1 << d):
-                    b = 0 if s > 0 else x.shape[d] - 1
-                elif b < 0:
-                    b += x.shape[d]
-                b = int(np.clip(b, 0, x.shape[d] - 1))
+                    b = 0 if s > 0 else dim - 1
+                else:
+                    if b < 0:
+                        b += dim
+                    if o.get("shrink_axis_mask", 0) & (1 << d):
+                        b = int(np.clip(b, 0, dim - 1))
+                    else:
+                        b = int(np.clip(b, 0, dim)) if s > 0 \
+                            else int(np.clip(b, -1, dim - 1))
                 if o.get("shrink_axis_mask", 0) & (1 << d):
                     idx.append(b)
                     continue
                 if o.get("end_mask", 0) & (1 << d):
                     e = None
-                idx.append(slice(b, e, s))
+                else:
+                    if e < 0:
+                        e += dim
+                    e = int(np.clip(e, 0, dim)) if s > 0 \
+                        else int(np.clip(e, -1, dim - 1))
+                if s < 0 and b == -1:
+                    idx.append(slice(0, 0, 1))      # empty
+                elif s < 0 and e == -1:
+                    idx.append(slice(b, None, s))   # through index 0
+                else:
+                    idx.append(slice(b, e, s))
             y = x[tuple(idx)]
         elif name == "TRANSPOSE_CONV":
             # inputs: 0 output_shape, 1 weights (OHWI, O=output ch),

@@ -565,7 +565,7 @@ def transpose_conv_options(stride=2, padding=0):
         b.PrependInt32Slot(2, stride, 1)
         return b.EndObject()
 
-    return (67, build)              # BuiltinOptions.TransposeConvOptions
+    return (49, build)              # BuiltinOptions.TransposeConvOptions
 
 
 def np_transpose_conv(x, w, stride, out_h, out_w, same):
@@ -635,7 +635,7 @@ def test_strided_slice(tmp_path):
             dict(shape=(3, 2), type=F32),
         ],
         operators=[dict(code=45, inputs=[0, 1, 2, 3], outputs=[4],
-                        options=(26, ss_opts))],
+                        options=(32, ss_opts))],  # StridedSliceOptions
         inputs=[0], outputs=[4])
     (out,) = _run(blob, tmp_path, x)
     np.testing.assert_array_equal(out, x[1, 0:3, 1:4:2])
@@ -664,7 +664,40 @@ def test_strided_slice_shrink_with_begin_mask(tmp_path):
             dict(shape=(3,), type=F32),
         ],
         operators=[dict(code=45, inputs=[0, 1, 2, 3], outputs=[4],
-                        options=(26, ss_opts))],
+                        options=(32, ss_opts))],  # StridedSliceOptions
         inputs=[0], outputs=[4])
     (out,) = _run(blob, tmp_path, x)
     np.testing.assert_array_equal(out, x[0, 1:4])
+
+
+def test_strided_slice_empty_and_negative_stride(tmp_path):
+    """Empty slices (begin==end at dim boundary) and negative strides
+    through index 0 follow the reference's Start/StopForAxis clamps."""
+    x = np.arange(3, dtype=np.float32)
+
+    def ss_opts(b):
+        b.StartObject(5)
+        return b.EndObject()
+
+    def run_case(begin, end, stride, out_len):
+        blob = build_tflite(
+            tensors=[
+                dict(shape=(3,), type=F32),
+                dict(shape=(1,), type=INT32,
+                     data=np.array([begin], np.int32)),
+                dict(shape=(1,), type=INT32,
+                     data=np.array([end], np.int32)),
+                dict(shape=(1,), type=INT32,
+                     data=np.array([stride], np.int32)),
+                dict(shape=(max(out_len, 1),), type=F32),
+            ],
+            operators=[dict(code=45, inputs=[0, 1, 2, 3], outputs=[4],
+                            options=(32, ss_opts))],
+            inputs=[0], outputs=[4])
+        (out,) = _run(blob, tmp_path, x)
+        return out
+
+    # begin=3,end=3,stride=1 on dim 3: EMPTY (not x[2:3])
+    assert run_case(3, 3, 1, 0).size == 0
+    # reverse through index 0: begin=2, end=-5 (clamps to -1 = inclusive 0)
+    np.testing.assert_array_equal(run_case(2, -5, -1, 3), [2.0, 1.0, 0.0])
