@@ -291,6 +291,11 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
         o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
         o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
+    elif op == "UNPACK":
+        # UnpackOptions: 0 num (validated against the output count in the
+        # lowerer), 1 axis
+        o["num"] = fb.scalar(opos, 0, fb.i32, 0)
+        o["axis"] = fb.scalar(opos, 1, fb.i32, 0)
     elif op == "LEAKY_RELU":
         o["alpha"] = fb.scalar(opos, 0, fb.f32, 0.0)
     elif op in ("DEPTH_TO_SPACE", "SPACE_TO_DEPTH"):
@@ -826,6 +831,27 @@ class _Lowerer:
             if b is not None:
                 y = y + b
             y = _fused_act(y, o.get("activation", 0))
+        elif name == "SPLIT":
+            # inputs: 0 axis (scalar tensor), 1 x; N equal outputs
+            # (the output COUNT is authoritative — it's what the graph
+            # wires — and num_splits always equals it in valid models)
+            ax = int(np.asarray(get(0)).reshape(()))
+            x = get(1)
+            parts = jnp.split(x, len(op.outputs), axis=ax)
+            for out_idx, part in zip(op.outputs, parts):
+                env[out_idx] = self._fake_quant(out_idx, part)
+            return
+        elif name == "UNPACK":
+            x = get(0)
+            ax = o.get("axis", 0)
+            if o.get("num") and o["num"] != len(op.outputs):
+                raise ValueError(
+                    f"UNPACK num={o['num']} disagrees with "
+                    f"{len(op.outputs)} wired outputs")
+            for j, out_idx in enumerate(op.outputs):
+                env[out_idx] = self._fake_quant(
+                    out_idx, jnp.take(x, j, axis=ax))
+            return
         else:
             raise NotImplementedError(
                 f"{os.path.basename(self.m.path)}: TFLite op {name!r} is "

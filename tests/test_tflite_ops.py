@@ -701,3 +701,53 @@ def test_strided_slice_empty_and_negative_stride(tmp_path):
     assert run_case(3, 3, 1, 0).size == 0
     # reverse through index 0: begin=2, end=-5 (clamps to -1 = inclusive 0)
     np.testing.assert_array_equal(run_case(2, -5, -1, 3), [2.0, 1.0, 0.0])
+
+
+def test_split_multi_output(tmp_path):
+    """SPLIT: axis scalar + N outputs (the importer's multi-output path)."""
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    ax = np.array(1, np.int32)
+
+    def split_opts(b):
+        b.StartObject(1)            # SplitOptions: 0 num_splits
+        b.PrependInt32Slot(0, 3, 0)
+        return b.EndObject()
+
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(), type=INT32, data=ax),
+            dict(shape=(2, 6), type=F32),
+            dict(shape=(2, 2), type=F32),
+            dict(shape=(2, 2), type=F32),
+            dict(shape=(2, 2), type=F32),
+        ],
+        operators=[dict(code=49, inputs=[0, 1], outputs=[2, 3, 4],
+                        options=(35, split_opts))],   # SplitOptions
+        inputs=[1], outputs=[2, 3, 4])
+    outs = _run(blob, tmp_path, x)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, x[:, 2 * i:2 * i + 2])
+
+
+def test_unpack_multi_output(tmp_path):
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+
+    def unpack_opts(b):
+        b.StartObject(2)            # UnpackOptions: 0 num, 1 axis
+        b.PrependInt32Slot(0, 3, 0)
+        b.PrependInt32Slot(1, 0, 0)
+        return b.EndObject()
+
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(3, 2), type=F32),
+            dict(shape=(2,), type=F32),
+            dict(shape=(2,), type=F32),
+            dict(shape=(2,), type=F32),
+        ],
+        operators=[dict(code=88, inputs=[0], outputs=[1, 2, 3],
+                        options=(64, unpack_opts))],  # UnpackOptions
+        inputs=[0], outputs=[1, 2, 3])
+    outs = _run(blob, tmp_path, x)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, x[i])
