@@ -291,6 +291,10 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
         o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
         o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
+    elif op == "GATHER":
+        # GatherOptions: 0 axis, 1 batch_dims
+        o["axis"] = fb.scalar(opos, 0, fb.i32, 0)
+        o["batch_dims"] = fb.scalar(opos, 1, fb.i32, 0)
     elif op == "UNPACK":
         # UnpackOptions: 0 num (validated against the output count in the
         # lowerer), 1 axis
@@ -750,6 +754,13 @@ class _Lowerer:
                               else int(b) + int(s))
                         for i, (b, s) in enumerate(zip(begin, size)))
             y = x[idx]
+        elif name == "GATHER":
+            x, indices = get(0), get(1)
+            if o.get("batch_dims", 0):
+                raise NotImplementedError(
+                    "GATHER batch_dims != 0 is not lowered")
+            y = jnp.take(x, jnp.asarray(indices).astype(jnp.int32),
+                         axis=o.get("axis", 0))
         elif name == "PACK":
             y = jnp.stack([env[i] for i in op.inputs], axis=o.get("axis", 0))
         elif name == "STRIDED_SLICE":

@@ -751,3 +751,25 @@ def test_unpack_multi_output(tmp_path):
     outs = _run(blob, tmp_path, x)
     for i, o in enumerate(outs):
         np.testing.assert_array_equal(o, x[i])
+
+
+def test_gather(tmp_path):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([2, 0], np.int32)
+
+    def gather_opts(b):
+        b.StartObject(2)            # GatherOptions: 0 axis
+        b.PrependInt32Slot(0, 0, 0)
+        return b.EndObject()
+
+    blob = build_tflite(
+        tensors=[
+            dict(shape=(3, 4), type=F32),
+            dict(shape=(2,), type=INT32, data=idx),
+            dict(shape=(2, 4), type=F32),
+        ],
+        operators=[dict(code=36, inputs=[0, 1], outputs=[2],
+                        options=(23, gather_opts))],  # GatherOptions
+        inputs=[0], outputs=[2])
+    (out,) = _run(blob, tmp_path, x)
+    np.testing.assert_array_equal(out, x[[2, 0]])
