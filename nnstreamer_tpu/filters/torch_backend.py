@@ -31,6 +31,7 @@ class TorchFilter(FilterFramework):
     def __init__(self) -> None:
         super().__init__()
         self._module: Any = None
+        self._out_expect: Optional[list] = None
 
     def open(self, props: FilterProps) -> None:
         super().open(props)
@@ -40,7 +41,19 @@ class TorchFilter(FilterFramework):
         if isinstance(model, str):
             if not os.path.isfile(model):
                 raise FileNotFoundError(model)
-            self._module = torch.jit.load(model, map_location="cpu")
+            from ..models.torch_legacy import is_legacy_torchscript, load_legacy_torchscript
+
+            if is_legacy_torchscript(model):
+                # torch-1.0-era zip (model.json + arena code) that modern
+                # torch.jit.load rejects; served via the restricted executor
+                self._module = load_legacy_torchscript(model)
+            else:
+                try:
+                    self._module = torch.jit.load(model, map_location="cpu")
+                except RuntimeError as e:
+                    raise RuntimeError(
+                        f"torch: failed to load {model!r} as TorchScript "
+                        f"(not a legacy-format zip either): {e}") from e
         elif isinstance(model, torch.nn.Module):
             self._module = model
         else:
@@ -48,6 +61,14 @@ class TorchFilter(FilterFramework):
         self._module.eval()
         self._in_info = props.input_info
         self._out_info = props.output_info
+        self._refresh_out_expect()
+
+    def _refresh_out_expect(self) -> None:
+        if self._out_info is None:
+            self._out_expect = None
+        else:
+            self._out_expect = [
+                (int(np.prod(i.shape)), i.dtype.np_dtype) for i in self._out_info]
 
     def get_model_info(self) -> Tuple[Optional[TensorsInfo], Optional[TensorsInfo]]:
         return self._in_info, self._out_info
@@ -64,9 +85,26 @@ class TorchFilter(FilterFramework):
         outs = out if isinstance(out, (tuple, list)) else (out,)
         from ..core.types import TensorInfo
 
-        self._out_info = TensorsInfo(tuple(
+        actual = TensorsInfo(tuple(
             TensorInfo.from_shape(tuple(o.shape) or (1,), np.dtype(str(o.numpy().dtype)))
             for o in outs))
+        if self._out_info is not None:
+            # declared output props must agree with what the module produces
+            # (reference rejects mismatched output= at negotiation,
+            # tensor_filter_pytorch.cc getOutputDim/validation)
+            for i, (a, d) in enumerate(zip(actual, self._out_info)):
+                if (int(np.prod(a.shape)) != int(np.prod(d.shape))
+                        or a.dtype.np_dtype != d.dtype.np_dtype):
+                    raise RuntimeError(
+                        f"torch: declared output {i} {d.shape} {d.dtype.name} "
+                        f"!= model output {a.shape} {a.dtype.name}")
+            if len(actual) != len(self._out_info):
+                raise RuntimeError(
+                    f"torch: model produces {len(actual)} outputs, "
+                    f"props declare {len(self._out_info)}")
+        else:
+            self._out_info = actual
+        self._refresh_out_expect()
         return self._out_info
 
     def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
@@ -77,7 +115,22 @@ class TorchFilter(FilterFramework):
                        for m in inputs]
             out = self._module(*tensors)
         outs = out if isinstance(out, (tuple, list)) else (out,)
-        return [TensorMemory(o.numpy()) for o in outs]
+        mems = [TensorMemory(o.numpy()) for o in outs]
+        if self._out_expect is not None:
+            # reference pytorch filter rejects an invoke whose produced
+            # tensors disagree with the declared output properties
+            # (tensor_filter_pytorch.cc processIFs/validation path)
+            if len(mems) != len(self._out_expect):
+                raise RuntimeError(
+                    f"torch: model produced {len(mems)} tensors, "
+                    f"props declare {len(self._out_expect)}")
+            for i, (m, (count, dt)) in enumerate(zip(mems, self._out_expect)):
+                host = m.host()
+                if host.size != count or host.dtype != dt:
+                    raise RuntimeError(
+                        f"torch: output {i} is {tuple(host.shape)} {host.dtype}"
+                        f", props declare {count} elements of {dt}")
+        return mems
 
 
 def _torch_dtype(np_dtype: np.dtype):

@@ -1,0 +1,145 @@
+"""framework=torch backend: modern TorchScript + the reference's legacy asset.
+
+Reference: ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc (libtorch
+script-module serving) and tests/nnstreamer_filter_pytorch/runTest.sh —
+its golden is 9.png through pytorch_lenet5.pt with argmax == 9, plus
+negative cases for mismatched input/output properties (runTest.sh:75-78).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from nnstreamer_tpu.graph.parse import parse_pipeline  # noqa: E402
+from nnstreamer_tpu.models.torch_legacy import (  # noqa: E402
+    is_legacy_torchscript,
+    load_legacy_torchscript,
+)
+
+MODELS = "/root/reference/tests/test_models/models"
+DATA = "/root/reference/tests/test_models/data"
+LENET = os.path.join(MODELS, "pytorch_lenet5.pt")
+
+needs_ref = pytest.mark.skipif(
+    not os.path.isfile(LENET), reason="reference test models not mounted")
+
+# verbatim reference string (runTest.sh:72) apart from mounted paths
+PIPELINE = (
+    "filesrc location={img} ! pngdec ! videoscale ! imagefreeze ! "
+    "videoconvert ! video/x-raw,format=GRAY8,framerate=0/1 ! "
+    "tensor_converter ! "
+    "tensor_filter framework=pytorch model={model} "
+    "input=1:28:28:1 inputtype=uint8 output=10:1:1:1 outputtype=uint8 ! "
+    "filesink location={out}"
+)
+
+
+def _scripted_lenet(path):
+    """A freshly scripted small convnet in the modern TorchScript format."""
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            torch.manual_seed(0)
+            self.conv = torch.nn.Conv2d(1, 4, 3, 1)
+            self.fc = torch.nn.Linear(4 * 26 * 26, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.conv(x))
+            return self.fc(x.reshape(x.size(0), -1))
+
+    m = torch.jit.script(Net().eval())
+    m.save(str(path))
+    return m
+
+
+class TestModernTorchScript:
+    def test_scripted_module_served_golden(self, tmp_path):
+        model_path = tmp_path / "net.pt"
+        mod = _scripted_lenet(model_path)
+        x = np.random.default_rng(7).standard_normal((1, 1, 28, 28)).astype(np.float32)
+        with torch.no_grad():
+            want = mod(torch.from_numpy(x)).numpy()
+
+        from nnstreamer_tpu.core.types import TensorsInfo
+        from nnstreamer_tpu.single import SingleShot
+
+        s = SingleShot(framework="pytorch", model=str(model_path),
+                       input_info=TensorsInfo.from_strings("28:28:1:1", "float32"),
+                       output_info=TensorsInfo.from_strings("10:1", "float32"))
+        got = np.asarray(s.invoke(x)[0])
+        np.testing.assert_allclose(got.reshape(want.shape), want, rtol=1e-5, atol=1e-5)
+
+    def test_not_torchscript_clear_error(self, tmp_path):
+        bad = tmp_path / "weights.pt"
+        torch.save({"w": torch.zeros(3)}, str(bad))  # state-dict, not TorchScript
+        from nnstreamer_tpu.core.types import TensorsInfo
+        from nnstreamer_tpu.single import SingleShot
+
+        with pytest.raises(RuntimeError, match="TorchScript"):
+            SingleShot(framework="pytorch", model=str(bad),
+                       input_info=TensorsInfo.from_strings("3", "float32"),
+                       output_info=TensorsInfo.from_strings("3", "float32"))
+
+
+@needs_ref
+class TestLegacyFormat:
+    def test_detects_legacy_zip(self, tmp_path):
+        assert is_legacy_torchscript(LENET)
+        modern = tmp_path / "m.pt"
+        _scripted_lenet(modern)
+        assert not is_legacy_torchscript(str(modern))
+        assert not is_legacy_torchscript(os.path.join(DATA, "9.png"))
+
+    def test_legacy_loader_runs_lenet(self):
+        from PIL import Image
+
+        mod = load_legacy_torchscript(LENET)
+        img = np.array(Image.open(os.path.join(DATA, "9.png")).convert("L"),
+                       dtype=np.uint8)
+        out = mod(torch.from_numpy(img.reshape(1, 28, 28, 1)))
+        assert tuple(out.shape) == (1, 10)
+        assert out.dtype == torch.uint8
+        assert int(out.flatten().argmax()) == 9
+
+    def test_reference_pipeline_string_golden(self, tmp_path):
+        """runTest.sh:72 verbatim — checkLabel.py asserts argmax == digit."""
+        out = tmp_path / "tensorfilter.out.log"
+        p = parse_pipeline(PIPELINE.format(
+            img=os.path.join(DATA, "9.png"), model=LENET, out=out))
+        p.run(timeout=120)
+        scores = np.frombuffer(out.read_bytes(), np.uint8)
+        assert scores.size == 10
+        assert int(scores.argmax()) == 9
+
+    def test_reference_negative_invalid_input(self, tmp_path):
+        """runTest.sh 2F_n: wrong input= dims must fail."""
+        bad = PIPELINE.format(
+            img=os.path.join(DATA, "9.png"), model=LENET,
+            out=tmp_path / "o.log").replace(
+            "input=1:28:28:1 inputtype=uint8 output=10:1:1:1 outputtype=uint8",
+            "input=7:1 inputtype=float32")
+        with pytest.raises(Exception):
+            parse_pipeline(bad).run(timeout=60)
+
+    def test_negative_same_size_dtype_mismatch(self, tmp_path):
+        """Declared int8 vs produced uint8 — same byte count, must still fail."""
+        bad = PIPELINE.format(
+            img=os.path.join(DATA, "9.png"), model=LENET,
+            out=tmp_path / "o.log").replace(
+            "output=10:1:1:1 outputtype=uint8", "output=10:1:1:1 outputtype=int8")
+        with pytest.raises(Exception):
+            parse_pipeline(bad).run(timeout=60)
+
+    def test_reference_negative_invalid_output(self, tmp_path):
+        """runTest.sh 3F_n: wrong output= dims must fail."""
+        bad = PIPELINE.format(
+            img=os.path.join(DATA, "9.png"), model=LENET,
+            out=tmp_path / "o.log").replace(
+            "input=1:28:28:1 inputtype=uint8 output=10:1:1:1 outputtype=uint8",
+            "output=1:7 outputtype=int8")
+        with pytest.raises(Exception):
+            parse_pipeline(bad).run(timeout=60)
