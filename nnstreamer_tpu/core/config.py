@@ -41,6 +41,7 @@ DEFAULT_FRAMEWORK_PRIORITY: Dict[str, List[str]] = {
     ".msgpack": ["xla-tpu"],
     ".ckpt": ["xla-tpu"],
     ".orbax": ["xla-tpu"],
+    ".pb": ["tensorflow"],
     ".py": ["python3"],
     ".pt": ["torch"],
     ".pt2": ["torch"],

@@ -56,8 +56,10 @@ class TensorFilter(Element):
         self.is_updatable = False
         self.input: Optional[str] = None        # dims override, e.g. "3:224:224:1"
         self.inputtype: Optional[str] = None
+        self.inputname: Optional[str] = None    # graph op names (tensorflow)
         self.output: Optional[str] = None
         self.outputtype: Optional[str] = None
+        self.outputname: Optional[str] = None
         # data layouts, comma-separated per tensor: none/any/NHWC/NCHW
         # (tensor_filter_common.c:913-940). NCHW on the XLA backend fuses
         # the channel-first<->channel-last transpose into the XLA program.
@@ -167,8 +169,8 @@ class TensorFilter(Element):
             model=self.model,
             custom=self.custom,
             accelerator=AcceleratorSpec.parse(self.accelerator),
-            input_info=self._override_info(self.input, self.inputtype),
-            output_info=self._override_info(self.output, self.outputtype),
+            input_info=self._override_info(self.input, self.inputtype, self.inputname),
+            output_info=self._override_info(self.output, self.outputtype, self.outputname),
             is_updatable=self.is_updatable,
             input_layout=in_layout,
             output_layout=out_layout,
@@ -190,9 +192,10 @@ class TensorFilter(Element):
         self.resolved_framework = fw_name
 
     @staticmethod
-    def _override_info(dims: Optional[str], types: Optional[str]) -> Optional[TensorsInfo]:
+    def _override_info(dims: Optional[str], types: Optional[str],
+                       names: Optional[str] = None) -> Optional[TensorsInfo]:
         if dims and types:
-            return TensorsInfo.from_strings(dims, types)
+            return TensorsInfo.from_strings(dims, types, names)
         return None
 
     def start(self) -> None:

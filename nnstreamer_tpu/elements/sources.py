@@ -115,9 +115,24 @@ class VideoTestSrc(SourceElement):
         self._n = 0
         self._rng = None
 
+    #: gst videotestsrc numeric pattern ids (gstvideotestsrc.h enum) for the
+    #: ids reference pipelines actually use; unknown ids fall back to smpte
+    _NUMERIC_PATTERNS = {
+        0: "smpte", 1: "random", 2: ("solid", 0x000000), 3: ("solid", 0xFFFFFF),
+        4: ("solid", 0xFF0000), 5: ("solid", 0x00FF00), 6: ("solid", 0x0000FF),
+        13: "smpte75",
+    }
+
     def negotiate(self) -> Caps:
         if self.format not in VIDEO_FORMATS:
             raise ValueError(f"unsupported video format {self.format!r}")
+        pat = self.pattern
+        if isinstance(pat, int) or (isinstance(pat, str) and pat.isdigit()):
+            mapped = self._NUMERIC_PATTERNS.get(int(pat), "smpte")
+            if isinstance(mapped, tuple):
+                self.pattern, self.color = mapped
+            else:
+                self.pattern = mapped
         self._n = 0
         self._rng = np.random.default_rng(self.seed)
         return Caps("video/x-raw", {
@@ -149,10 +164,12 @@ class VideoTestSrc(SourceElement):
                                       np.uint8).reshape(h, w, ch).copy()
             else:
                 frame = self._rng.integers(0, 256, (h, w, ch)).astype(dt)
-        else:  # smpte bars
+        else:  # smpte bars (smpte75 = same bars at 75% amplitude)
             bars = np.array([[255, 255, 255], [255, 255, 0], [0, 255, 255],
                              [0, 255, 0], [255, 0, 255], [255, 0, 0],
                              [0, 0, 255]], np.float32)
+            if self.pattern == "smpte75":
+                bars = bars * 0.75
             idx = (np.arange(w) * len(bars)) // max(w, 1)
             frame = np.zeros((h, w, ch), np.float32)
             frame[..., :min(3, ch)] = bars[idx][None, :, :min(3, ch)]

@@ -25,6 +25,7 @@ def _ensure_builtin_filters() -> None:
         from . import torch_backend  # noqa: F401
     except ImportError:  # torch genuinely absent
         pass
+    from . import tf_backend  # noqa: F401 — tf itself imports at open()
 
 
 _ensure_builtin_filters()

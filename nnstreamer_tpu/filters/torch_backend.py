@@ -45,7 +45,8 @@ class TorchFilter(FilterFramework):
 
             if is_legacy_torchscript(model):
                 # torch-1.0-era zip (model.json + arena code) that modern
-                # torch.jit.load rejects; served via the restricted executor
+                # torch.jit.load rejects; executed as code, same trust
+                # model as torch.jit.load itself
                 self._module = load_legacy_torchscript(model)
             else:
                 try:

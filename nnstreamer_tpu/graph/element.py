@@ -130,7 +130,9 @@ class Element:
     #: filesink sync=true …); they carry no behavior here but must not
     #: fail verbatim pipeline strings. Elements with real semantics for
     #: one (e.g. tensor_rate silent) simply shadow it with an attribute.
-    _GST_NOOP_PROPS = frozenset({"silent", "sync", "async", "qos"})
+    # gst scheduling/buffering knobs with no analog in this runtime
+    # (every sink here is already unbuffered and clock-free)
+    _GST_NOOP_PROPS = frozenset({"silent", "sync", "async", "qos", "buffer_mode"})
 
     def set_properties(self, **props: Any) -> None:
         """GObject-property equivalent: kwargs map to attributes. Unknown

@@ -129,6 +129,11 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
     p = pipeline or Pipeline()
     branches = _split_branches(description)
     named: Dict[str, Element] = {}
+    # gst-launch allows "… ! mux.sink_0" before "tensor_mux name=mux" is
+    # declared; every sink-side named link is deferred and resolved once
+    # all branches are parsed, in encounter order, so request pads are
+    # created in index order regardless of where the declaration sits
+    pending: List[tuple] = []
 
     for branch in branches:
         prev: Optional[Any] = None
@@ -142,36 +147,39 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
             if isinstance(seg, str):  # "name." or pad ref "name.sink_0"
                 if seg.endswith("."):
                     ref = seg.rstrip(".")
+                    if prev is not None:
+                        # "… ! name." links INTO the named element's next
+                        # free sink pad and ends the chain (gst-launch);
+                        # ALWAYS deferred so request-pad creation follows
+                        # global encounter order even when some references
+                        # precede the declaration and some follow it
+                        pending.append((prev, ref, None, seg))
+                        prev = None
+                        closed = True
+                        continue
                     if ref not in named:
                         raise ValueError(
                             f"unknown element reference {seg!r}")
-                    target = named[ref]
-                    if prev is None:
-                        prev = target
-                        # restore the referenced element's own explicit
-                        # props — a following caps filter must respect them
-                        prev_explicit = getattr(
-                            target, "_parse_explicit", set())
-                    else:
-                        # "… ! name." links INTO the named element's next
-                        # free sink pad and ends the chain (gst-launch)
-                        _link(prev, target)
-                        prev = None
-                        closed = True
+                    prev = named[ref]
+                    # restore the referenced element's own explicit
+                    # props — a following caps filter must respect them
+                    prev_explicit = getattr(prev, "_parse_explicit", set())
                     continue
                 ref, pad_name = seg.split(".", 1)
-                if ref not in named:
-                    raise ValueError(f"unknown element reference {seg!r}")
-                target = named[ref]
-                if prev is None:
-                    # branch starts AT this src pad: demux.src_0 ! ...
-                    prev = (target, pad_name)
-                    prev_explicit = set()
-                else:
-                    # chain sinks INTO this pad: ... ! mux.sink_0
-                    _link(prev, (target, pad_name))
+                if prev is not None:
+                    # chain sinks INTO this pad: ... ! mux.sink_0 (deferred,
+                    # see above)
+                    pending.append((prev, ref, pad_name, seg))
                     prev = None
                     closed = True
+                    continue
+                if ref not in named:
+                    # a branch STARTING at an unseen src pad cannot be
+                    # deferred (everything after it would dangle)
+                    raise ValueError(f"unknown element reference {seg!r}")
+                # branch starts AT this src pad: demux.src_0 ! ...
+                prev = (named[ref], pad_name)
+                prev_explicit = set()
                 continue
             kind, props = seg
             if kind in _MEDIA_TYPES or kind.split(",")[0] in _MEDIA_TYPES:
@@ -192,6 +200,11 @@ def parse_pipeline(description: str, pipeline: Optional[Pipeline] = None) -> Pip
                 _link(prev, el)
             prev = el
             prev_explicit = explicit
+
+    for prev, ref, pad_name, seg in pending:
+        if ref not in named:
+            raise ValueError(f"unknown element reference {seg!r}")
+        _link(prev, named[ref] if pad_name is None else (named[ref], pad_name))
     return p
 
 

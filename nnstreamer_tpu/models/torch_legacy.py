@@ -55,7 +55,14 @@ def is_legacy_torchscript(path: str) -> bool:
         if not zipfile.is_zipfile(path):
             return False
         with zipfile.ZipFile(path) as z:
-            return any(n.split("/")[-1] == "model.json" for n in z.namelist())
+            names = z.namelist()
+            # a modern archive can carry _extra_files entries named
+            # model.json (stored under <root>/extra/); data.pkl is the
+            # authoritative modern marker, root-level model.json the legacy one
+            if any(n.split("/")[-1] == "data.pkl" for n in names):
+                return False
+            return any(n.count("/") == 1 and n.endswith("/model.json")
+                       for n in names)
     except (OSError, zipfile.BadZipFile):
         return False
 
@@ -275,8 +282,11 @@ def _guarded_import(name, globals=None, locals=None, fromlist=(), level=0):
     return _builtins.__import__(name, globals, locals, fromlist, level)
 
 
-#: the only builtins era-generated arena code uses; exec'ing untrusted zips
-#: with the full builtin set would hand the file contents os/subprocess etc.
+#: the only builtins era-generated arena code uses. NOTE: this is NOT a
+#: security boundary — arena code is still Python and attribute traversal
+#: can reach anything (same trust model as torch.jit.load/pickle: model
+#: files are code). The guard exists to fail fast on accidental non-arena
+#: content, not to contain a hostile file.
 _ARENA_BUILTINS = {
     n: getattr(_builtins, n)
     for n in ("int", "float", "bool", "str", "len", "min", "max", "abs",

@@ -288,6 +288,35 @@ class TestPadRefEdgeCases:
                 "tensor_mux name=m ! fakesink "
                 "videotestsrc num-buffers=1 ! tensor_converter ! m.sink_1")
 
+    def test_forward_pad_ref_before_declaration(self, tmp_path):
+        """gst-launch resolves 'mux.sink_0' appearing before
+        'tensor_mux name=mux' is declared."""
+        log = tmp_path / "f.log"
+        p = parse_pipeline(
+            "videotestsrc num-buffers=1 width=4 height=4 ! "
+            "tensor_converter ! mux.sink_0 "
+            f"tensor_mux name=mux ! filesink location={log}")
+        p.run(timeout=60)
+        assert log.stat().st_size == 4 * 4 * 3
+
+    def test_pad_refs_straddling_declaration_keep_index_order(self, tmp_path):
+        """sink_0 referenced before the declaration, sink_1 after — request
+        pads must still be created in index order (global encounter order)."""
+        log = tmp_path / "s.log"
+        p = parse_pipeline(
+            "videotestsrc num-buffers=1 width=4 height=4 ! "
+            "tensor_converter ! mux.sink_0 "
+            f"tensor_mux name=mux ! filesink location={log} "
+            "videotestsrc num-buffers=1 width=2 height=2 ! "
+            "tensor_converter ! mux.sink_1")
+        p.run(timeout=60)
+        assert log.stat().st_size == 4 * 4 * 3 + 2 * 2 * 3
+
+    def test_dangling_forward_ref_rejected(self):
+        with pytest.raises(ValueError, match="unknown element reference"):
+            parse_pipeline(
+                "videotestsrc num-buffers=1 ! tensor_converter ! ghost.sink_0")
+
     def test_uint8_clamp_with_negative_bound(self, tmp_path):
         """clamp -50:50 on a uint8 stream: bounds clamp into range
         instead of wrapping (206 > 50 would flatten the tensor)."""

@@ -1,0 +1,168 @@
+"""framework=tensorflow: the reference's frozen GraphDef models served verbatim.
+
+Reference: ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc and
+tests/nnstreamer_filter_tensorflow/runTest.sh — mnist.pb (9.raw → argmax 9)
+and conv_actions_frozen.pb (yes.wav through a DT_STRING input → argmax 2).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from nnstreamer_tpu.graph.parse import parse_pipeline  # noqa: E402
+
+MODELS = "/root/reference/tests/test_models/models"
+DATA = "/root/reference/tests/test_models/data"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.isfile(os.path.join(MODELS, "mnist.pb")),
+    reason="reference test models not mounted")
+
+# runTest.sh:78, verbatim apart from mounted paths
+MNIST = (
+    "filesrc location={data} ! application/octet-stream ! "
+    "tensor_converter input-dim=784:1 input-type=uint8 ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+    "tensor_filter framework=tensorflow model={model} "
+    "input=784:1 inputtype=float32 inputname=input "
+    "output=10:1 outputtype=float32 outputname=softmax ! "
+    "filesink location={out}"
+)
+
+# runTest.sh:98, verbatim apart from mounted paths
+SPEECH = (
+    "filesrc location={data} blocksize=-1 ! application/octet-stream ! "
+    "tensor_converter input-dim=1:16022 input-type=int16 ! "
+    "tensor_filter framework=tensorflow model={model} "
+    "input=1:16022 inputtype=int16 inputname=wav_data "
+    "output=12:1 outputtype=float32 outputname=labels_softmax ! "
+    "filesink location={out}"
+)
+
+
+@needs_ref
+def test_reference_mnist_pb_golden(tmp_path):
+    out = tmp_path / "tensorfilter.out.1.log"
+    p = parse_pipeline(MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"), out=out))
+    p.run(timeout=120)
+    scores = np.frombuffer(out.read_bytes(), np.float32)
+    assert scores.size == 10
+    assert int(scores.argmax()) == 9  # checkLabel.py semantics
+
+
+@needs_ref
+def test_reference_speech_pb_string_input_golden(tmp_path):
+    """conv_actions_frozen.pb has a DT_STRING input (wav_data); the raw
+    int16 buffer is fed as one scalar string — answer index 2 ('yes')."""
+    out = tmp_path / "tensorfilter.out.3.log"
+    p = parse_pipeline(SPEECH.format(
+        data=os.path.join(DATA, "yes.wav"),
+        model=os.path.join(MODELS, "conv_actions_frozen.pb"), out=out))
+    p.run(timeout=120)
+    scores = np.frombuffer(out.read_bytes(), np.float32)
+    assert scores.size == 12
+    assert int(scores.argmax()) == 2
+
+
+@needs_ref
+def test_reference_combination_string(tmp_path):
+    """runTest.sh:83 verbatim — input-combination=1 picks the mnist tensor
+    out of the mux, output-combination=i0,o0 re-emits the video tensor
+    alongside the result; demux splits them back."""
+    golden = tmp_path / "combi.dummy.golden"
+    combi_in = tmp_path / "tensorfilter.combi.in.log"
+    out = tmp_path / "tensorfilter.out.1.log"
+    s = (
+        "videotestsrc pattern=13 num-buffers=1 ! videoconvert ! "
+        "video/x-raw,width=640,height=480,framerate=30/1 ! tensor_converter ! "
+        "tee name=t "
+        f"t. ! queue ! filesink location={golden} buffer-mode=unbuffered sync=false async=false "
+        "t. ! queue ! mux.sink_0 "
+        f"filesrc location={os.path.join(DATA, '9.raw')} ! application/octet-stream ! "
+        "tensor_converter input-dim=784:1 input-type=uint8 ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,div:127.5 ! "
+        "mux.sink_1 tensor_mux name=mux ! "
+        f"tensor_filter framework=tensorflow model={os.path.join(MODELS, 'mnist.pb')} "
+        "input=784:1 inputtype=float32 inputname=input "
+        "output=10:1 outputtype=float32 outputname=softmax "
+        "input-combination=1 output-combination=i0,o0 ! "
+        "tensor_demux name=demux "
+        f"demux.src_0 ! queue ! filesink location={combi_in} buffer-mode=unbuffered sync=false async=false "
+        f"demux.src_1 ! queue ! filesink location={out} buffer-mode=unbuffered sync=false async=false"
+    )
+    parse_pipeline(s).run(timeout=120)
+    # callCompareTest: the video tensor must pass through byte-exact
+    assert golden.read_bytes() == combi_in.read_bytes()
+    assert len(golden.read_bytes()) == 640 * 480 * 3
+    scores = np.frombuffer(out.read_bytes(), np.float32)
+    assert scores.size == 10 and int(scores.argmax()) == 9
+
+
+@needs_ref
+def test_pb_extension_auto_detect(tmp_path):
+    """framework=auto resolves .pb → tensorflow via the priority table."""
+    out = tmp_path / "o.log"
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"),
+        out=out).replace("framework=tensorflow ", "")
+    parse_pipeline(s).run(timeout=120)
+    assert int(np.frombuffer(out.read_bytes(), np.float32).argmax()) == 9
+
+
+@needs_ref
+def test_missing_names_clear_error(tmp_path):
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"),
+        out=tmp_path / "o.log").replace("inputname=input ", "")
+    with pytest.raises(Exception, match="name"):
+        parse_pipeline(s).run(timeout=60)
+
+
+@needs_ref
+def test_wrong_op_name_clear_error(tmp_path):
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"),
+        out=tmp_path / "o.log").replace("inputname=input ", "inputname=nonesuch ")
+    with pytest.raises(Exception, match="nonesuch"):
+        parse_pipeline(s).run(timeout=60)
+
+
+@needs_ref
+def test_wrong_dtype_clear_error(tmp_path):
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"),
+        out=tmp_path / "o.log").replace(
+        "inputtype=float32", "inputtype=int32").replace(
+        "typecast:float32", "typecast:int32")
+    with pytest.raises(Exception, match="int32|float32"):
+        parse_pipeline(s).run(timeout=60)
+
+
+@needs_ref
+def test_wrong_output_dims_clear_error(tmp_path):
+    """runTest 3F_n analog: output=5:1 against a 10-element graph output."""
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"),
+        model=os.path.join(MODELS, "mnist.pb"),
+        out=tmp_path / "o.log").replace("output=10:1 ", "output=5:1 ")
+    with pytest.raises(Exception, match="output"):
+        parse_pipeline(s).run(timeout=60)
+
+
+@needs_ref
+def test_not_a_graphdef_clear_error(tmp_path):
+    bad = tmp_path / "model.pb"
+    bad.write_bytes(b"\xff\xfe not a protobuf")
+    s = MNIST.format(
+        data=os.path.join(DATA, "9.raw"), model=bad, out=tmp_path / "o.log")
+    with pytest.raises(Exception, match="GraphDef"):
+        parse_pipeline(s).run(timeout=60)

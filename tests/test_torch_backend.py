@@ -94,6 +94,20 @@ class TestLegacyFormat:
         assert not is_legacy_torchscript(str(modern))
         assert not is_legacy_torchscript(os.path.join(DATA, "9.png"))
 
+    def test_modern_with_extra_model_json_not_misrouted(self, tmp_path):
+        """_extra_files={'model.json': ...} must not trip legacy detection."""
+        p = tmp_path / "extra.pt"
+
+        class Id(torch.nn.Module):
+            def forward(self, x):
+                return x + 1
+
+        torch.jit.save(torch.jit.script(Id()), str(p),
+                       _extra_files={"model.json": "{}"})
+        assert not is_legacy_torchscript(str(p))
+        m = torch.jit.load(str(p))  # still loads via the modern path
+        assert int(m(torch.zeros(1))[0]) == 1
+
     def test_legacy_loader_runs_lenet(self):
         from PIL import Image
 
