@@ -122,7 +122,7 @@ class TensorFlowFilter(FilterFramework):
     def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
         # names came from props; only dims/types may be renegotiated
         named = TensorsInfo(tuple(
-            TensorInfo(shape=i.shape, dtype=i.dtype, name=d.name)
+            TensorInfo(dims=i.dims, dtype=i.dtype, name=d.name)
             for i, d in zip(in_info, self._in_info)))
         self._in_info = named
         return self._out_info

@@ -226,8 +226,8 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         # Conv2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 activation,
         # 4 dilation_w, 5 dilation_h
         o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
-        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
-        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 0)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 0)
         o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
         o["dilation_w"] = fb.scalar(opos, 4, fb.i32, 1)
         o["dilation_h"] = fb.scalar(opos, 5, fb.i32, 1)
@@ -235,9 +235,9 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         # DepthwiseConv2DOptions: 0 padding, 1 stride_w, 2 stride_h,
         # 3 depth_multiplier, 4 activation, 5 dilation_w, 6 dilation_h
         o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
-        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
-        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
-        o["depth_multiplier"] = fb.scalar(opos, 3, fb.i32, 1)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 0)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 0)
+        o["depth_multiplier"] = fb.scalar(opos, 3, fb.i32, 0)
         o["activation"] = fb.scalar(opos, 4, fb.i8, 0)
         o["dilation_w"] = fb.scalar(opos, 5, fb.i32, 1)
         o["dilation_h"] = fb.scalar(opos, 6, fb.i32, 1)
@@ -245,10 +245,10 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         # Pool2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 filter_width,
         # 4 filter_height, 5 activation
         o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
-        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
-        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
-        o["filter_w"] = fb.scalar(opos, 3, fb.i32, 1)
-        o["filter_h"] = fb.scalar(opos, 4, fb.i32, 1)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 0)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 0)
+        o["filter_w"] = fb.scalar(opos, 3, fb.i32, 0)
+        o["filter_h"] = fb.scalar(opos, 4, fb.i32, 0)
         o["activation"] = fb.scalar(opos, 5, fb.i8, 0)
     elif op == "SOFTMAX":
         o["beta"] = fb.scalar(opos, 0, fb.f32, 1.0)
@@ -288,8 +288,8 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
         # TransposeConvOptions: 0 padding, 1 stride_w, 2 stride_h
         # (later schema adds fused_activation at 3; default NONE)
         o["padding"] = fb.scalar(opos, 0, fb.i8, 0)
-        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 1)
-        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 1)
+        o["stride_w"] = fb.scalar(opos, 1, fb.i32, 0)
+        o["stride_h"] = fb.scalar(opos, 2, fb.i32, 0)
         o["activation"] = fb.scalar(opos, 3, fb.i8, 0)
     elif op == "GATHER":
         # GatherOptions: 0 axis, 1 batch_dims
@@ -314,6 +314,20 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "PACK":
         # PackOptions: 0 values_count, 1 axis
         o["axis"] = fb.scalar(opos, 1, fb.i32, 0)
+    if op in ("CONV_2D", "DEPTHWISE_CONV_2D", "AVERAGE_POOL_2D",
+              "MAX_POOL_2D", "TRANSPOSE_CONV"):
+        # same prepare-time check the TFLite runtime does
+        # (tflite/kernels/conv.cc:378): the schema stride default is 0,
+        # so a writer must set strides explicitly
+        if o.get("stride_w", 0) < 1 or o.get("stride_h", 0) < 1:
+            raise ValueError(
+                f"tflite: {op} stride_w/stride_h must be >= 1 "
+                f"(got {o.get('stride_w')}x{o.get('stride_h')})")
+    if op in ("AVERAGE_POOL_2D", "MAX_POOL_2D"):
+        if o.get("filter_w", 0) < 1 or o.get("filter_h", 0) < 1:
+            raise ValueError(
+                f"tflite: {op} filter_width/filter_height must be >= 1 "
+                f"(got {o.get('filter_w')}x{o.get('filter_h')})")
     return o
 
 

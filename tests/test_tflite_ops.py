@@ -168,8 +168,8 @@ def conv_options(stride=1, padding=0, activation=0, dilation=1):
     def build(b):
         b.StartObject(6)            # Conv2DOptions
         b.PrependInt8Slot(0, padding, 0)
-        b.PrependInt32Slot(1, stride, 1)
-        b.PrependInt32Slot(2, stride, 1)
+        b.PrependInt32Slot(1, stride, 0)
+        b.PrependInt32Slot(2, stride, 0)
         b.PrependInt8Slot(3, activation, 0)
         b.PrependInt32Slot(4, dilation, 1)
         b.PrependInt32Slot(5, dilation, 1)
@@ -182,9 +182,9 @@ def dwconv_options(stride=1, padding=0, mult=1, activation=0):
     def build(b):
         b.StartObject(7)            # DepthwiseConv2DOptions
         b.PrependInt8Slot(0, padding, 0)
-        b.PrependInt32Slot(1, stride, 1)
-        b.PrependInt32Slot(2, stride, 1)
-        b.PrependInt32Slot(3, mult, 1)
+        b.PrependInt32Slot(1, stride, 0)
+        b.PrependInt32Slot(2, stride, 0)
+        b.PrependInt32Slot(3, mult, 0)
         b.PrependInt8Slot(4, activation, 0)
         return b.EndObject()
 
@@ -195,10 +195,10 @@ def pool_options(filt=2, stride=2, padding=0):
     def build(b):
         b.StartObject(6)            # Pool2DOptions
         b.PrependInt8Slot(0, padding, 0)
-        b.PrependInt32Slot(1, stride, 1)
-        b.PrependInt32Slot(2, stride, 1)
-        b.PrependInt32Slot(3, filt, 1)
-        b.PrependInt32Slot(4, filt, 1)
+        b.PrependInt32Slot(1, stride, 0)
+        b.PrependInt32Slot(2, stride, 0)
+        b.PrependInt32Slot(3, filt, 0)
+        b.PrependInt32Slot(4, filt, 0)
         return b.EndObject()
 
     return (5, build)
@@ -561,8 +561,8 @@ def transpose_conv_options(stride=2, padding=0):
     def build(b):
         b.StartObject(4)            # TransposeConvOptions
         b.PrependInt8Slot(0, padding, 0)
-        b.PrependInt32Slot(1, stride, 1)
-        b.PrependInt32Slot(2, stride, 1)
+        b.PrependInt32Slot(1, stride, 0)
+        b.PrependInt32Slot(2, stride, 0)
         return b.EndObject()
 
     return (49, build)              # BuiltinOptions.TransposeConvOptions
