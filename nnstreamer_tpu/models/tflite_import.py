@@ -221,7 +221,10 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     field is redundant with the opcode for the supported subset)."""
     o: Dict[str, Any] = {}
     if opos is None:
-        return o
+        # no builtin_options table at all: every field is schema-default,
+        # which for conv/pool means stride 0 — fall through so the
+        # prepare-time stride/filter guard below reports it clearly
+        return _validate_options(op, o)
     if op == "CONV_2D":
         # Conv2DOptions: 0 padding, 1 stride_w, 2 stride_h, 3 activation,
         # 4 dilation_w, 5 dilation_h
@@ -314,11 +317,15 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "PACK":
         # PackOptions: 0 values_count, 1 axis
         o["axis"] = fb.scalar(opos, 1, fb.i32, 0)
+    return _validate_options(op, o)
+
+
+def _validate_options(op: str, o: Dict[str, Any]) -> Dict[str, Any]:
+    """Prepare-time checks the TFLite runtime also makes
+    (tflite/kernels/conv.cc:378): the schema stride/filter default is 0,
+    so a writer must set them explicitly."""
     if op in ("CONV_2D", "DEPTHWISE_CONV_2D", "AVERAGE_POOL_2D",
               "MAX_POOL_2D", "TRANSPOSE_CONV"):
-        # same prepare-time check the TFLite runtime does
-        # (tflite/kernels/conv.cc:378): the schema stride default is 0,
-        # so a writer must set strides explicitly
         if o.get("stride_w", 0) < 1 or o.get("stride_h", 0) < 1:
             raise ValueError(
                 f"tflite: {op} stride_w/stride_h must be >= 1 "
@@ -404,6 +411,22 @@ def parse_tflite(path: str) -> TFLModel:
         ins = fb.vec_np(opr, 1, "<i4")
         outs = fb.vec_np(opr, 2, "<i4")
         options = _parse_options(fb, name, fb.offset(opr, 4))
+        if name.startswith("CUSTOM:"):
+            # Operator slot 5: custom_options[ubyte] — a flexbuffer map for
+            # the ops we support (the flatbuffers *runtime* decodes it; no
+            # generated code involved)
+            co = fb.vector(opr, 5)
+            if co is not None:
+                nbytes, pos = co
+                if nbytes:
+                    try:
+                        from flatbuffers import flexbuffers
+
+                        decoded = flexbuffers.Loads(bytes(buf[pos:pos + nbytes]))
+                        if isinstance(decoded, dict):
+                            options.update(decoded)
+                    except Exception:
+                        pass  # op lowering reports missing keys clearly
         operators.append(TFLOperator(
             name, [int(x) for x in (ins if ins is not None else [])],
             [int(x) for x in (outs if outs is not None else [])], options))
@@ -876,6 +899,100 @@ class _Lowerer:
             for j, out_idx in enumerate(op.outputs):
                 env[out_idx] = self._fake_quant(
                     out_idx, jnp.take(x, j, axis=ax))
+            return
+        elif name == "CUSTOM:TFLite_Detection_PostProcess":
+            # SSD box-decode + NMS custom op (the graphs the reference's
+            # mobilenet-ssd-postprocess decoder mode consumes,
+            # tensordec-boundingbox.c:121-133). Same center-size decode +
+            # greedy-NMS math as decoders/bounding_box.py, lowered into the
+            # model's own XLA program. Fast-NMS path only.
+            if o.get("use_regular_nms"):
+                raise NotImplementedError(
+                    "TFLite_Detection_PostProcess: use_regular_nms=true "
+                    "(per-class regular NMS) is not supported; re-export "
+                    "with the fast-NMS path")
+            if int(o.get("max_classes_per_detection", 1)) != 1:
+                raise NotImplementedError(
+                    "TFLite_Detection_PostProcess: "
+                    f"max_classes_per_detection="
+                    f"{o.get('max_classes_per_detection')} is not supported "
+                    "(only top-1 class per box)")
+            import jax
+
+            locs = get(0)[0]        # [N, 4] (y, x, h, w) encodings
+            cls_in = get(1)[0]      # [N, C] scores (graph already applied
+            #                         sigmoid/softmax before this op)
+            anchors = get(2)        # [N, 4] (ycenter, xcenter, h, w)
+            num_classes = int(o["num_classes"])
+            max_d = int(o["max_detections"])
+            label_offset = cls_in.shape[-1] - num_classes  # background cols
+            cls_scores = cls_in[:, label_offset:]
+            ya, xa, ha, wa = (anchors[:, 0], anchors[:, 1],
+                              anchors[:, 2], anchors[:, 3])
+            yc = locs[:, 0] / np.float32(o["y_scale"]) * ha + ya
+            xc = locs[:, 1] / np.float32(o["x_scale"]) * wa + xa
+            hh = jnp.exp(locs[:, 2] / np.float32(o["h_scale"])) * ha
+            ww = jnp.exp(locs[:, 3] / np.float32(o["w_scale"])) * wa
+            ymin, xmin = yc - hh / 2, xc - ww / 2
+            ymax, xmax = yc + hh / 2, xc + ww / 2
+            best_score = jnp.max(cls_scores, axis=1)
+            best_cls = jnp.argmax(cls_scores, axis=1)
+            thr = np.float32(o.get("nms_score_threshold", 0.0))
+            iou_thr = np.float32(o.get("nms_iou_threshold", 0.6))
+            n = int(best_score.shape[0])
+            # static pre-NMS candidate cap: the interpreter considers every
+            # above-threshold anchor; 2048 covers the common SSD exports
+            # (mobilenet-ssd = 1917 anchors). Beyond it, heavily-suppressed
+            # scenes may backfill differently from rank >k — warn once.
+            k = min(n, 2048)
+            if n > k:
+                from ..core.log import logger
+
+                logger("tflite").warning(
+                    "TFLite_Detection_PostProcess: %d anchors exceed the "
+                    "%d pre-NMS candidate cap; detections may diverge from "
+                    "the TFLite runtime when >%d candidates pass the score "
+                    "threshold", n, k, k)
+            neg_inf = np.float32(-np.inf)  # sentinel safe for logit-scale
+            #                                thresholds (thr can be ≤ -1)
+            masked = jnp.where(best_score >= thr, best_score, neg_inf)
+            top_score, idx = jax.lax.top_k(masked, k)
+            by0, bx0 = ymin[idx], xmin[idx]
+            by1, bx1 = ymax[idx], xmax[idx]
+            area = (bx1 - bx0) * (by1 - by0)
+            ix = (jnp.minimum(bx1[:, None], bx1[None, :])
+                  - jnp.maximum(bx0[:, None], bx0[None, :]))
+            iy = (jnp.minimum(by1[:, None], by1[None, :])
+                  - jnp.maximum(by0[:, None], by0[None, :]))
+            inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
+            union = area[:, None] + area[None, :] - inter
+            iou = jnp.where(union > 0, inter / union, 0.0)
+            later = jnp.arange(k)[None, :] > jnp.arange(k)[:, None]
+            suppresses = (iou > iou_thr) & later
+
+            def body(i, alive):
+                return alive & ~(alive[i] & suppresses[i])
+
+            alive = jax.lax.fori_loop(0, k, body, top_score >= thr)
+            kept = jnp.where(alive, top_score, neg_inf)
+            final_score, fsel = jax.lax.top_k(kept, min(max_d, k))
+            pad = max_d - int(final_score.shape[0])
+            valid = final_score >= thr
+            sel = idx[fsel]
+            out_boxes = jnp.where(
+                valid[:, None],
+                jnp.stack([ymin[sel], xmin[sel], ymax[sel], xmax[sel]], 1),
+                0.0)
+            out_cls = jnp.where(valid, best_cls[sel].astype(jnp.float32), 0.0)
+            out_scr = jnp.where(valid, final_score, 0.0)
+            if pad:
+                out_boxes = jnp.pad(out_boxes, ((0, pad), (0, 0)))
+                out_cls = jnp.pad(out_cls, (0, pad))
+                out_scr = jnp.pad(out_scr, (0, pad))
+            num = jnp.sum(valid.astype(jnp.float32))[None]
+            for out_idx, val in zip(op.outputs, (
+                    out_boxes[None], out_cls[None], out_scr[None], num)):
+                env[out_idx] = val
             return
         else:
             raise NotImplementedError(

@@ -101,13 +101,17 @@ def build_tflite(tensors, operators, inputs, outputs):
         tensor_offsets.append(b.EndObject())
 
     opcode_offsets = []
-    codes = []
+    codes = []  # (builtin_code, custom_name or None)
     for op in operators:
-        if op["code"] not in codes:
-            codes.append(op["code"])
-    for code in codes:
+        key = (op["code"], op.get("custom_code"))
+        if key not in codes:
+            codes.append(key)
+    for code, custom in codes:
+        custom_off = b.CreateString(custom) if custom else None
         b.StartObject(4)            # OperatorCode
         b.PrependInt8Slot(0, min(code, 127), 0)
+        if custom_off is not None:
+            b.PrependUOffsetTRelativeSlot(1, custom_off, 0)
         b.PrependInt32Slot(3, code, 0)
         opcode_offsets.append(b.EndObject())
 
@@ -117,13 +121,19 @@ def build_tflite(tensors, operators, inputs, outputs):
         outs_off = _vec_i32(b, op["outputs"])
         opt = op.get("options")
         opt_off = opt[1](b) if opt else None
+        custom_opts = op.get("custom_options")
+        custom_opts_off = (b.CreateByteVector(bytes(custom_opts))
+                           if custom_opts else None)
         b.StartObject(9)            # Operator
-        b.PrependUint32Slot(0, codes.index(op["code"]), 0)
+        b.PrependUint32Slot(
+            0, codes.index((op["code"], op.get("custom_code"))), 0)
         b.PrependUOffsetTRelativeSlot(1, ins_off, 0)
         b.PrependUOffsetTRelativeSlot(2, outs_off, 0)
         if opt is not None:
             b.PrependUint8Slot(3, opt[0], 0)       # builtin_options_type
             b.PrependUOffsetTRelativeSlot(4, opt_off, 0)
+        if custom_opts_off is not None:
+            b.PrependUOffsetTRelativeSlot(5, custom_opts_off, 0)
         operator_offsets.append(b.EndObject())
 
     tensors_off = _vec_offsets(b, tensor_offsets)

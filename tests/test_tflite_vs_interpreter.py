@@ -198,7 +198,6 @@ def _fixture_maxpool(rng):
 
 def _fixture_resize_half_pixel(rng):
     x = rng.standard_normal((1, 3, 3, 1), dtype=np.float32)
-    import flatbuffers
 
     def size_const():
         return np.array([6, 6], np.int32)
@@ -325,6 +324,155 @@ def test_op_matches_interpreter(name, tmp_path):
     for o, r in zip(ours, ref):
         assert o.shape == r.shape and o.dtype == r.dtype
         np.testing.assert_allclose(o, r, rtol=1e-5, atol=atol)
+
+
+def _build_detection_postprocess(rng, n_anchors=32, num_classes=3,
+                                 max_detections=8, with_background=True):
+    """A TFLite_Detection_PostProcess graph (the SSD family the reference's
+    mobilenet-ssd-postprocess decoder mode exists for)."""
+    from flatbuffers import flexbuffers
+
+    fbb = flexbuffers.Builder()
+    with fbb.Map():
+        fbb.Int("max_detections", max_detections)
+        fbb.Int("max_classes_per_detection", 1)
+        fbb.Int("detections_per_class", 100)
+        fbb.Bool("use_regular_nms", False)
+        fbb.Float("nms_score_threshold", 0.3)
+        fbb.Float("nms_iou_threshold", 0.5)
+        fbb.Int("num_classes", num_classes)
+        fbb.Float("y_scale", 10.0)
+        fbb.Float("x_scale", 10.0)
+        fbb.Float("h_scale", 5.0)
+        fbb.Float("w_scale", 5.0)
+    opts = fbb.Finish()
+
+    # anchors: a grid of centers with fixed size (ycenter, xcenter, h, w)
+    g = int(np.ceil(np.sqrt(n_anchors)))
+    yy, xx = np.meshgrid(np.linspace(0.1, 0.9, g), np.linspace(0.1, 0.9, g))
+    anchors = np.stack([yy.ravel()[:n_anchors], xx.ravel()[:n_anchors],
+                        np.full(n_anchors, 0.2), np.full(n_anchors, 0.2)],
+                       axis=1).astype(np.float32)
+    locs = (rng.standard_normal((1, n_anchors, 4)) * 0.5).astype(np.float32)
+    ncols = num_classes + (1 if with_background else 0)
+    scores = rng.uniform(0, 1, (1, n_anchors, ncols)).astype(np.float32)
+
+    blob = build_tflite(
+        tensors=[
+            {"shape": (1, n_anchors, 4), "type": F32, "data": None},
+            {"shape": (1, n_anchors, ncols), "type": F32, "data": None},
+            {"shape": (n_anchors, 4), "type": F32, "data": anchors},
+            {"shape": (1, max_detections, 4), "type": F32, "data": None},
+            {"shape": (1, max_detections), "type": F32, "data": None},
+            {"shape": (1, max_detections), "type": F32, "data": None},
+            {"shape": (1,), "type": F32, "data": None},
+        ],
+        operators=[{"code": 32, "custom_code": "TFLite_Detection_PostProcess",
+                    "custom_options": opts,
+                    "inputs": [0, 1, 2], "outputs": [3, 4, 5, 6]}],
+        inputs=[0, 1], outputs=[3, 4, 5, 6])
+    return blob, (locs, scores)
+
+
+def test_detection_postprocess_vs_interpreter(tmp_path):
+    """CUSTOM:TFLite_Detection_PostProcess lowering matches the real
+    runtime's registered kernel on boxes/classes/scores/count."""
+    blob, inputs = _build_detection_postprocess(np.random.default_rng(5))
+    ref = _interp_run(blob, *inputs)
+    ours = _ours_run(blob, tmp_path, *inputs)
+    r_boxes, r_cls, r_scr, r_num = ref
+    o_boxes, o_cls, o_scr, o_num = ours
+    assert int(o_num[0]) == int(r_num[0]) > 0
+    n = int(r_num[0])
+    np.testing.assert_allclose(o_scr[0, :n], r_scr[0, :n], atol=1e-5)
+    np.testing.assert_array_equal(o_cls[0, :n], r_cls[0, :n])
+    np.testing.assert_allclose(o_boxes[0, :n], r_boxes[0, :n], atol=1e-5)
+
+
+def test_detection_postprocess_no_background_column(tmp_path):
+    """num_classes == score columns (no implicit background): label offset 0."""
+    blob, inputs = _build_detection_postprocess(
+        np.random.default_rng(9), with_background=False)
+    ref = _interp_run(blob, *inputs)
+    ours = _ours_run(blob, tmp_path, *inputs)
+    n = int(ref[3][0])
+    assert int(ours[3][0]) == n > 0
+    np.testing.assert_array_equal(ours[1][0, :n], ref[1][0, :n])
+    np.testing.assert_allclose(ours[0][0, :n], ref[0][0, :n], atol=1e-5)
+
+
+def test_detection_postprocess_regular_nms_clear_error(tmp_path):
+    from flatbuffers import flexbuffers
+
+    fbb = flexbuffers.Builder()
+    with fbb.Map():
+        fbb.Int("max_detections", 8)
+        fbb.Int("max_classes_per_detection", 1)
+        fbb.Int("detections_per_class", 100)
+        fbb.Bool("use_regular_nms", True)
+        fbb.Float("nms_score_threshold", 0.3)
+        fbb.Float("nms_iou_threshold", 0.5)
+        fbb.Int("num_classes", 3)
+        fbb.Float("y_scale", 10.0)
+        fbb.Float("x_scale", 10.0)
+        fbb.Float("h_scale", 5.0)
+        fbb.Float("w_scale", 5.0)
+    anchors = np.zeros((32, 4), np.float32)
+    blob2 = build_tflite(
+        tensors=[
+            {"shape": (1, 32, 4), "type": F32, "data": None},
+            {"shape": (1, 32, 4), "type": F32, "data": None},
+            {"shape": (32, 4), "type": F32, "data": anchors},
+            {"shape": (1, 8, 4), "type": F32, "data": None},
+            {"shape": (1, 8), "type": F32, "data": None},
+            {"shape": (1, 8), "type": F32, "data": None},
+            {"shape": (1,), "type": F32, "data": None},
+        ],
+        operators=[{"code": 32, "custom_code": "TFLite_Detection_PostProcess",
+                    "custom_options": fbb.Finish(),
+                    "inputs": [0, 1, 2], "outputs": [3, 4, 5, 6]}],
+        inputs=[0, 1], outputs=[3, 4, 5, 6])
+    with pytest.raises(NotImplementedError, match="regular_nms"):
+        _ours_run(blob2, tmp_path, np.zeros((1, 32, 4), np.float32),
+                  np.zeros((1, 32, 4), np.float32))
+
+
+def test_detection_postprocess_feeds_ssd_decoder(tmp_path):
+    """E2e: the imported postprocess model serves through a pipeline and its
+    4 outputs feed tensor_decoder mode=bounding_boxes
+    option1=mobilenet-ssd-postprocess (the reference decoder pairing,
+    tensordec-boundingbox.c:121-133)."""
+    from nnstreamer_tpu.graph import Pipeline
+
+    blob, (locs, scores) = _build_detection_postprocess(
+        np.random.default_rng(5))
+    model = tmp_path / "ssd_pp.tflite"
+    model.write_bytes(blob)
+    (ref_boxes, ref_cls, ref_scr, ref_num) = _interp_run(blob, locs, scores)
+
+    from nnstreamer_tpu.core.types import Caps, TensorsConfig, TensorsInfo
+
+    labels = tmp_path / "labels.txt"
+    labels.write_text("a\nb\nc\n")
+    info = TensorsInfo.from_strings("4:32:1,4:32:1", "float32")
+    p = Pipeline()
+    src = p.add_new("appsrc", caps=Caps.tensors(TensorsConfig(info, 0)),
+                    data=[(locs, scores)])
+    filt = p.add_new("tensor_filter", framework="tensorflow2-lite",
+                     model=str(model))
+    dec = p.add_new("tensor_decoder", mode="bounding_box",
+                    option1="mobilenet-ssd-postprocess",
+                    option2=str(labels), option4="160:120", option5="320:320")
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, filt, dec, sink)
+    p.run(timeout=120)
+    b = sink.buffers[0]
+    assert b.memories[0].host().shape == (120, 160, 4)
+    dets = b.meta["detections"]
+    assert len(dets) == int(ref_num[0])
+    got_scores = sorted(round(d["score"], 5) for d in dets)
+    want_scores = sorted(round(float(s), 5) for s in ref_scr[0, :int(ref_num[0])])
+    assert got_scores == want_scores
 
 
 def test_quant_conv_within_quant_steps(tmp_path):
