@@ -91,7 +91,13 @@ class TensorMetaInfo:
         if code is None:
             raise ValueError(
                 f"dtype {self.info.dtype} has no tensor_type wire code")
-        dims = list(self.info.dims)[:_MAX_META_DIMS]
+        if len(self.info.dims) > _MAX_META_DIMS:
+            # truncating would emit a header describing a smaller tensor
+            # than the payload — the peer's size check then fails opaquely
+            raise ValueError(
+                f"tensor rank {len(self.info.dims)} exceeds the wire "
+                f"header's {_MAX_META_DIMS}-dim limit")
+        dims = list(self.info.dims)
         dims += [0] * (_MAX_META_DIMS - len(dims))  # 0-terminated rank
         raw = _HEADER_STRUCT.pack(
             META_VERSION, code, *dims,

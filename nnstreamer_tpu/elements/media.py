@@ -154,44 +154,54 @@ class ImageDec(Element):
         self._caps_sent = False
         self._acc = bytearray()
         self._decode_err: Optional[Exception] = None
+        self._marker_seen = False
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
         self._caps_sent = False  # actual size known at first frame
         self._acc = bytearray()
         self._decode_err = None
+        self._marker_seen = False
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         # upstream may deliver the encoded file in blocksize chunks
         # (filesrc ! pngdec): accumulate until a complete image decodes —
         # gst's pngdec buffers exactly the same way
+        prev_len = len(self._acc)
         for m in buf.memories:
             self._acc += m.tobytes()
         # skip futile decode attempts while a PNG/JPEG is visibly
-        # truncated (no IEND/EOI near the tail) — otherwise a 4096-byte
-        # blocksize means O(chunks) full parses of a growing buffer
-        head, tail = bytes(self._acc[:4]), bytes(self._acc[-64:])
-        complete = True
-        if head.startswith(b"\x89PNG"):
-            complete = b"IEND" in tail
-        elif head.startswith(b"\xff\xd8"):
-            complete = b"\xff\xd9" in tail
-        if not complete:
+        # truncated (no IEND/EOI seen yet) — otherwise a 4096-byte
+        # blocksize means O(chunks) full parses of a growing buffer.
+        # The marker is searched incrementally over each new chunk (with
+        # an 8-byte overlap for markers split across chunks), ANYWHERE in
+        # the stream, so encoders that append trailing padding after the
+        # end marker still decode.
+        head = bytes(self._acc[:4])
+        if not self._marker_seen:
+            window = bytes(self._acc[max(0, prev_len - 8):])
+            if head.startswith(b"\x89PNG"):
+                self._marker_seen = b"IEND" in window
+            elif head.startswith(b"\xff\xd8"):
+                self._marker_seen = b"\xff\xd9" in window
+            else:
+                self._marker_seen = True  # unknown codec: just try
+        if not self._marker_seen:
             return FlowReturn.OK
         try:
             frame = _decode_image(bytes(self._acc), self.format)
         except Exception as e:  # noqa: BLE001
-            if head.startswith((b"\x89PNG", b"\xff\xd8")):
-                # end marker present yet undecodable: the image is
-                # CORRUPT, not truncated — fail at the bad frame (gst
-                # pngdec errors here too) instead of silently poisoning
-                # every later frame appended behind the garbage
-                raise ValueError(
-                    f"{self.name}: corrupt image data ({e})") from e
-            self._decode_err = e  # unknown format: keep accumulating
+            # a marker hit does NOT prove completeness: JPEGs with embedded
+            # EXIF thumbnails carry an early EOI, and 'IEND' can occur by
+            # chance inside IDAT data. Keep accumulating and re-arm the
+            # scan so the NEXT marker (the real end) retries the decode; a
+            # genuinely corrupt stream surfaces at EOS with this error
+            self._decode_err = e
+            self._marker_seen = False
             return FlowReturn.OK
         self._acc = bytearray()
         self._decode_err = None
+        self._marker_seen = False
         if not self._caps_sent:
             self._caps_sent = True
             h, w = frame.shape[:2]

@@ -171,6 +171,15 @@ class GstCustomSo:
             # (tensor_filter_custom.c:114 "requires a valid 'initfunc'")
             raise RuntimeError(
                 f"{path}: NNStreamer_custom.initfunc is NULL")
+        if bool(self._cls.invoke) == bool(self._cls.allocate_invoke):
+            # exactly one of invoke/allocate_invoke must be set
+            # (tensor_filter_custom.c custom_open); neither would call a
+            # NULL pointer at the first frame, both is ambiguous
+            raise RuntimeError(
+                f"{path}: NNStreamer_custom must define exactly one of "
+                "invoke/allocate_invoke "
+                f"(invoke={bool(self._cls.invoke)}, "
+                f"allocate_invoke={bool(self._cls.allocate_invoke)})")
         # keep byte buffers alive for the struct's borrowed pointers
         self._path_b = path.encode()
         self._custom_b = custom.encode() if custom else None

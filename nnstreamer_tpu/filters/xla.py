@@ -412,9 +412,38 @@ class XLAFilter(FilterFramework):
     def invoke(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
         if self._bucket > 0:
             return self._invoke_bucketed(inputs)
-        arrays = [m.device(self._device) for m in inputs]
+        # mesh-sharded bundles require the batch divisible by the mesh's
+        # data axis (parallel.sharded_bundle sets batch_multiple=dp); an
+        # uneven final batch is zero-padded to the next multiple and the
+        # outputs trimmed back — each distinct padded size compiles once
+        # (shape-keyed jit cache), and padded sizes are bounded by dp
+        mult = int(self._bundle.metadata.get("batch_multiple", 0) or 0) \
+            if self._bundle is not None and hasattr(self._bundle, "metadata") \
+            else 0
+        orig_batch = None
+        if mult > 1 and inputs:
+            shape0 = inputs[0].shape  # no D2H: metadata only
+            if shape0 and shape0[0] % mult:
+                import jax
+
+                orig_batch = int(shape0[0])
+                pad = mult - orig_batch % mult
+                arrays = []
+                for m in inputs:
+                    h = m.host()
+                    padded = np.concatenate(
+                        [h, np.zeros((pad,) + h.shape[1:], h.dtype)])
+                    arrays.append(jax.device_put(padded, self._device))
+        if orig_batch is None:
+            arrays = [m.device(self._device) for m in inputs]
         with self._lock:
             outs = self._jitted(*arrays)
+        if orig_batch is not None:
+            outs = tuple(
+                o[:orig_batch]
+                if getattr(o, "ndim", 0) and o.shape[0] == orig_batch + pad
+                else o
+                for o in outs)
         if self._sync:
             for o in outs:
                 o.block_until_ready()

@@ -274,7 +274,18 @@ def _configure_upstream_from_caps(prev: Optional[Element], caps: Caps,
                 break
             if hasattr(el, key):
                 if key not in exp:
+                    old = getattr(el, key)
                     setattr(el, key, caps.fields[key])
+                    if old not in (None, caps.fields[key]):
+                        # visible trail when a caps filter reconfigures an
+                        # upstream element — a same-named attribute with
+                        # different semantics would otherwise diverge from
+                        # gst negotiation silently
+                        from ..core.log import logger
+
+                        logger("parse").info(
+                            "caps filter reconfigures %s.%s: %r -> %r",
+                            el.name, key, old, caps.fields[key])
                 break
             up = el.sink_pads[0].peer if el.sink_pads else None
             if up is None:

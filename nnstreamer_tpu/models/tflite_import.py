@@ -444,6 +444,17 @@ def parse_tflite(path: str) -> TFLModel:
 # --------------------------------------------------------------------------- #
 
 
+def _require_per_tensor_io(m: "TFLModel", t: TFLTensor, role: str) -> None:
+    """Graph I/O (de/re)quantization supports per-tensor quant only —
+    per-channel scales on an I/O tensor would need a layout contract the
+    uint8 wire caps cannot express."""
+    if t.quant is not None and t.quant.per_channel:
+        raise NotImplementedError(
+            f"{os.path.basename(m.path)}: graph {role} tensor {t.name!r} is "
+            "per-channel quantized; only per-tensor-quantized model I/O is "
+            "supported")
+
+
 def _dequant_const(t: TFLTensor) -> np.ndarray:
     """Constant tensor → float32 (weights/bias of quantized models are
     dequantized once at load; float constants pass through)."""
@@ -571,6 +582,7 @@ class _Lowerer:
                     x = x.reshape(t.shape)
                 if t.quant is not None and not np.issubdtype(
                         np.dtype(t.np_dtype), np.floating):
+                    _require_per_tensor_io(m, t, "input")
                     x = (x.astype(jnp.float32)
                          - np.float32(t.quant.zero_point)) \
                         * np.float32(t.quant.scale)
@@ -583,6 +595,7 @@ class _Lowerer:
                 y = env[idx]
                 if t.quant is not None and not np.issubdtype(
                         np.dtype(t.np_dtype), np.floating):
+                    _require_per_tensor_io(m, t, "output")
                     q = jnp.round(y / np.float32(t.quant.scale)
                                   + np.float32(t.quant.zero_point))
                     info = np.iinfo(t.np_dtype)

@@ -65,3 +65,89 @@ def composite_sharded_query_check(bundle: Any, served: Any, batch: int,
                 f"composite sharded pipeline frame {i} diverged"
     finally:
         sp.stop()
+
+
+def composite_query_retry_check(bundle: Any, served: Any, batch: int,
+                                size: int, n_frames: int = 6,
+                                seed: int = 11, rtol: float = 2e-4,
+                                atol: float = 2e-5) -> None:
+    """Straggler/failover on the query edge at mesh scale: the serving
+    pod dies mid-stream and a replacement binds the same port; the client's
+    synchronous retry path (tensor_query_client max-request-retry,
+    reference tensor_query_client.c retry/reconnect :769-776) must resend
+    and complete the stream with every result exact."""
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+
+    from ..core.types import Caps, TensorsConfig, TensorsInfo
+    from ..graph import Pipeline
+    from ..query.server import wait_bound_port
+
+    dims = f"3:{size}:{size}:{batch}"
+
+    def make_server(port: int):
+        sp = Pipeline(f"mesh-server-{port}")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=0, dims=dims, types="uint8")
+        sfilt = sp.add_new("tensor_filter", framework="xla-tpu", model=served)
+        ssink = sp.add_new("tensor_query_serversink", id=0)
+        Pipeline.link(ssrc, sfilt, ssink)
+        return sp, ssrc
+
+    sp1, ssrc1 = make_server(0)
+    sp1.start()
+    sp2 = None
+    try:
+        port = wait_bound_port(ssrc1)
+        rng = np.random.default_rng(seed)
+        frames = [rng.integers(0, 255, (batch, size, size, 3))
+                  .astype(np.uint8) for _ in range(n_frames)]
+        cp = Pipeline("mesh-client-retry")
+        caps = Caps.tensors(
+            TensorsConfig(TensorsInfo.from_strings(dims, "uint8")))
+        csrc = cp.add_new("appsrc", caps=caps, data=list(frames))
+        qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
+                        timeout_s=60.0, max_request_retry=20)
+        csink = cp.add_new("tensor_sink", store=True)
+        Pipeline.link(csrc, qc, csink)
+
+        client_err = []
+
+        def run_client():
+            try:
+                cp.run(timeout=300)
+            except Exception as e:  # surfaced after join
+                client_err.append(e)
+
+        th = threading.Thread(target=run_client, daemon=True)
+        th.start()
+        # wait until the stream is mid-flight, then kill the pod
+        deadline = time.monotonic() + 60
+        while csink.num_buffers < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert csink.num_buffers >= 2, "stream never reached mid-flight"
+        sp1.stop()
+        # replacement pod on the SAME port — the client retry loop rides
+        # out the gap and resends the in-flight frame
+        sp2, _ = make_server(port)
+        sp2.start()
+        th.join(timeout=300)
+        assert not th.is_alive(), "client did not finish after failover"
+        if client_err:
+            raise AssertionError(
+                f"client failed across failover: {client_err[0]}")
+        assert csink.num_buffers == n_frames, \
+            f"failover: {csink.num_buffers}/{n_frames} frames returned"
+        oracle = jax.jit(bundle.fn())
+        for i, fx in enumerate(frames):
+            got = csink.buffers[i].memories[0].host()
+            ref = np.asarray(oracle(fx))
+            assert np.allclose(got, ref, rtol=rtol, atol=atol), \
+                f"failover frame {i} diverged"
+    finally:
+        sp1.stop()
+        if sp2 is not None:
+            sp2.stop()

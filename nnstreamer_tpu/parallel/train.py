@@ -105,4 +105,7 @@ def sharded_bundle(base: Any, mesh: Mesh) -> Any:
         lambda x: infer(params, x),
         in_info=base.in_info, out_info=base.out_info,
         metadata={**public_meta, "input_sharding": batch_sharding(mesh),
+                  # the serving filter zero-pads uneven final batches up to
+                  # a multiple of the data axis and trims the outputs
+                  "batch_multiple": int(mesh.shape.get("data", 1)),
                   "jit": False})

@@ -294,3 +294,26 @@ def test_reference_abi_custom_props_soft_drop(tmp_path):
     Pipeline.link(src, filt, sink)
     p.run(timeout=60)
     assert sink.num_buffers == 0  # every frame soft-dropped
+
+
+@needs_ref
+def test_no_invoke_callback_rejected_at_open(tmp_path):
+    """A .so defining neither invoke nor allocate_invoke must fail at open
+    (the reference's custom_open XOR check), not NULL-call at frame 1."""
+    src_text = _PLUGIN_SRC.replace(
+        ".invoke = pv_invoke,", ".invoke = NULL,")
+    src = tmp_path / "no_invoke.c"
+    src.write_text(src_text)
+    so = tmp_path / "libno_invoke.so"
+    subprocess.run(
+        ["gcc", "-O2", "-fPIC", "-shared", "-I", REF_INC,
+         "-o", str(so), str(src)],
+        check=True, capture_output=True)
+    p = Pipeline()
+    src_el = p.add_new("appsrc", caps=caps_of("4:1", "float32"),
+                       data=[np.zeros((1, 4), np.float32)])
+    filt = p.add_new("tensor_filter", framework="custom", model=str(so))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src_el, filt, sink)
+    with pytest.raises(Exception, match="invoke"):
+        p.run(timeout=60)

@@ -43,6 +43,60 @@ class TestImagePath:
         p.run(timeout=30)
         np.testing.assert_array_equal(sink.buffers[0].memories[0].host()[0], arr)
 
+    def test_imagedec_early_embedded_eoi_chunked(self, tmp_path):
+        """A JPEG with an embedded-thumbnail-style EOI early in the stream
+        (APP1 segment containing \\xff\\xd9) delivered in small chunks:
+        the premature marker hit must not kill the pipeline — decode
+        retries at the real EOI."""
+        from PIL import Image
+        import io
+
+        arr = np.full((24, 32, 3), 128, np.uint8)
+        bio = io.BytesIO()
+        Image.fromarray(arr).save(bio, format="JPEG", quality=95)
+        data = bio.getvalue()
+        assert data[:2] == b"\xff\xd8"
+        # APP1 segment whose payload contains an EOI marker (like an EXIF
+        # thumbnail's own terminator)
+        payload = b"Exif\x00\x00" + b"\x00" * 10 + b"\xff\xd9" + b"\x00" * 10
+        app1 = b"\xff\xe1" + (len(payload) + 2).to_bytes(2, "big") + payload
+        path = tmp_path / "thumb.jpg"
+        path.write_bytes(data[:2] + app1 + data[2:])
+        p = Pipeline()
+        src = p.add_new("filesrc", location=str(path), blocksize=16)
+        dec = p.add_new("jpegdec")
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, conv, sink)
+        p.run(timeout=60)
+        assert sink.num_buffers == 1
+        got = sink.buffers[0].memories[0].host()[0]
+        assert got.shape == (24, 32, 3)
+        assert abs(int(got.mean()) - 128) < 3  # lossy but close
+
+    def test_imagedec_trailing_padding_after_end_marker(self, tmp_path):
+        """Some encoders/cameras append padding after IEND/EOI; the
+        completeness heuristic must still decode (marker searched anywhere
+        in the stream, not just the tail)."""
+        from PIL import Image
+        import io
+
+        arr = np.full((6, 8, 3), 50, np.uint8)
+        bio = io.BytesIO()
+        Image.fromarray(arr).save(bio, format="PNG")
+        data = bio.getvalue() + b"\x00" * 300  # padding pushes IEND off the tail
+        path = tmp_path / "padded.png"
+        path.write_bytes(data)
+        p = Pipeline()
+        src = p.add_new("filesrc", location=str(path), blocksize=1 << 20)
+        dec = p.add_new("imagedec")
+        conv = p.add_new("tensor_converter")
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, dec, conv, sink)
+        p.run(timeout=30)
+        assert sink.num_buffers >= 1
+        np.testing.assert_array_equal(sink.buffers[0].memories[0].host()[0], arr)
+
     def test_videoscale_and_convert(self):
         p = Pipeline()
         src = p.add_new("videotestsrc", width=20, height=10, num_buffers=1)

@@ -394,3 +394,80 @@ def test_composite_sharded_pipeline_with_query_offload():
                        f"&num_classes=8&batch={batch}&dtype=float32")
     served = sharded_bundle(bundle, mesh)
     composite_sharded_query_check(bundle, served, batch, size)
+
+
+def test_sharded_uneven_final_batch():
+    """batch % dp != 0 zero-pads to the next data-axis multiple inside the
+    serving filter and trims outputs (the last batch of a stream is rarely
+    full on real hardware)."""
+    import jax
+
+    from nnstreamer_tpu.core.buffer import TensorMemory
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import sharded_bundle
+
+    mesh = auto_mesh_2d(8)  # data=4
+    batch, size = 8, 16
+    bundle = get_model(f"zoo://mobilenet_v2?width=0.25&size={size}"
+                       f"&num_classes=8&batch={batch}&dtype=float32")
+    filt = XLAFilter()
+    filt.open(FilterProps(model=sharded_bundle(bundle, mesh)))
+    rng = np.random.default_rng(0)
+    oracle = jax.jit(bundle.fn())
+    for uneven in (batch + 1, batch - 3, 1):
+        x = rng.normal(size=(uneven, size, size, 3)).astype(np.float32)
+        got = filt.invoke([TensorMemory(x)])[0].host()
+        ref = np.asarray(oracle(x))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_reload_reshards():
+    """Hot model reload swaps the sharded program for one with fresh
+    params (mesh reshard under traffic); results follow the new oracle."""
+    import jax
+
+    from nnstreamer_tpu.core.buffer import TensorMemory
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import sharded_bundle
+
+    mesh = auto_mesh_2d(8)
+    batch, size = 8, 16
+    spec = (f"zoo://mobilenet_v2?width=0.25&size={size}"
+            f"&num_classes=8&batch={batch}&dtype=float32")
+    b1 = get_model(spec)
+    b2 = get_model(spec + "&seed=7")
+    filt = XLAFilter()
+    filt.open(FilterProps(model=sharded_bundle(b1, mesh)))
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(batch, size, size, 3)).astype(np.float32)
+    got1 = filt.invoke([TensorMemory(x)])[0].host()
+    np.testing.assert_allclose(
+        got1, np.asarray(jax.jit(b1.fn())(x)), rtol=2e-4, atol=2e-5)
+    filt.reload_model(sharded_bundle(b2, mesh))
+    got2 = filt.invoke([TensorMemory(x)])[0].host()
+    np.testing.assert_allclose(
+        got2, np.asarray(jax.jit(b2.fn())(x)), rtol=2e-4, atol=2e-5)
+    assert not np.allclose(got1, got2)  # genuinely different params
+
+
+def test_composite_query_failover_retry():
+    """Server pod dies mid-stream, replacement binds the same port, the
+    client's retry path completes the stream exactly (shared helper, same
+    code the driver's dryrun_multichip runs)."""
+    from nnstreamer_tpu.models.zoo import get_model
+    from nnstreamer_tpu.parallel import sharded_bundle
+    from nnstreamer_tpu.parallel.composite import (
+        composite_query_retry_check,
+    )
+
+    mesh = auto_mesh_2d(8)
+    batch, size = 8, 16
+    bundle = get_model(f"zoo://mobilenet_v2?width=0.25&size={size}"
+                       f"&num_classes=8&batch={batch}&dtype=float32")
+    served = sharded_bundle(bundle, mesh)
+    composite_query_retry_check(bundle, served, batch, size)

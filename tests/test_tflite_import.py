@@ -142,3 +142,32 @@ def test_singleshot_serves_tflite():
     (out,) = s.invoke(img)
     labels = open(LABELS).read().splitlines()
     assert labels[int(np.asarray(out).reshape(-1).argmax())] == "orange"
+
+
+def test_per_channel_quantized_io_clear_error(tmp_path):
+    """Graph I/O (de/re)quantization is per-tensor only; a per-channel
+    I/O tensor must fail with a descriptive error, not a trace-time crash."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(__file__))
+    from test_tflite_ops import UINT8, build_tflite
+
+    blob = build_tflite(
+        tensors=[
+            {"shape": (1, 2, 2, 2), "type": UINT8, "data": None,
+             "quant": (np.array([0.1, 0.2], np.float32),
+                       np.array([0, 0], np.int64), 3)},
+            {"shape": (1, 2, 2, 2), "type": UINT8, "data": None,
+             "quant": (0.1, 0)},
+        ],
+        operators=[{"code": 0, "inputs": [0, 1], "outputs": [1],
+                    "options": None}],
+        inputs=[0], outputs=[1])
+    # an ADD with itself is irrelevant; the I/O quant check fires first
+    path = tmp_path / "pc_io.tflite"
+    path.write_bytes(blob)
+    import jax
+
+    bundle = load_tflite(str(path))
+    with pytest.raises(NotImplementedError, match="per-channel"):
+        jax.jit(bundle.fn())(np.zeros((1, 2, 2, 2), np.uint8))
