@@ -155,6 +155,8 @@ class ImageDec(Element):
         self._acc = bytearray()
         self._decode_err: Optional[Exception] = None
         self._marker_seen = False
+        self._fail_attempts = 0
+        self._decoded_any = False
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
@@ -162,6 +164,8 @@ class ImageDec(Element):
         self._acc = bytearray()
         self._decode_err = None
         self._marker_seen = False
+        self._fail_attempts = 0
+        self._decoded_any = False
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         # upstream may deliver the encoded file in blocksize chunks
@@ -194,14 +198,24 @@ class ImageDec(Element):
             # a marker hit does NOT prove completeness: JPEGs with embedded
             # EXIF thumbnails carry an early EOI, and 'IEND' can occur by
             # chance inside IDAT data. Keep accumulating and re-arm the
-            # scan so the NEXT marker (the real end) retries the decode; a
-            # genuinely corrupt stream surfaces at EOS with this error
+            # scan so the NEXT marker (the real end) retries the decode —
+            # but BOUNDED: a corrupt frame in a live (never-EOS) stream
+            # must not silently swallow every frame behind it, so after
+            # several marker-hit decode failures the stream errors here
             self._decode_err = e
+            self._fail_attempts = getattr(self, "_fail_attempts", 0) + 1
+            if self._fail_attempts >= 8:
+                raise ValueError(
+                    f"{self.name}: {self._fail_attempts} decode attempts "
+                    f"failed on accumulated data — corrupt stream ({e})"
+                ) from e
             self._marker_seen = False
             return FlowReturn.OK
         self._acc = bytearray()
         self._decode_err = None
         self._marker_seen = False
+        self._fail_attempts = 0
+        self._decoded_any = True
         if not self._caps_sent:
             self._caps_sent = True
             h, w = frame.shape[:2]
@@ -213,6 +227,20 @@ class ImageDec(Element):
 
     def on_eos(self) -> None:
         if self._acc:
+            head = bytes(self._acc[:4])
+            known = head.startswith((b"\x89PNG", b"\xff\xd8"))
+            if getattr(self, "_decoded_any", False) and not known:
+                # trailing non-image bytes AFTER a successfully decoded
+                # frame (encoder padding delivered in its own chunk):
+                # tolerable — drop with a trail, don't fail the stream
+                from ..core.log import logger
+
+                logger("media").warning(
+                    "%s: dropping %d trailing non-image bytes at EOS",
+                    self.name, len(self._acc))
+                self._acc = bytearray()
+                super().on_eos()
+                return
             err = getattr(self, "_decode_err", None)
             raise ValueError(
                 f"{self.name}: stream ended with {len(self._acc)} bytes of "

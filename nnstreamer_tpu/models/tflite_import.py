@@ -1059,6 +1059,13 @@ def load_tflite(path: str) -> ModelBundle:
     same ``other/tensor`` caps the reference's tflite subplugin reports
     via ``getModelInfo`` (tensor_filter_tensorflow_lite.cc)."""
     m = parse_tflite(path)
+    # every guarded corner must surface HERE: load_tflite(path) is the
+    # documented one-line compatibility test (migrating-from-nnstreamer.md)
+    for role, idxs in (("input", m.inputs), ("output", m.outputs)):
+        for i in idxs:
+            t = m.tensors[i]
+            if not np.issubdtype(np.dtype(t.np_dtype), np.floating):
+                _require_per_tensor_io(m, t, role)
     ops_used = sorted({op.op for op in m.operators})
     low = _Lowerer(m)
     apply = low.build_apply()

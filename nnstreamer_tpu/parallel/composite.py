@@ -105,10 +105,29 @@ def composite_query_retry_check(bundle: Any, served: Any, batch: int,
         rng = np.random.default_rng(seed)
         frames = [rng.integers(0, 255, (batch, size, size, 3))
                   .astype(np.uint8) for _ in range(n_frames)]
+        # the failover must be DETERMINISTICALLY mid-stream (a fast local
+        # loop could finish all frames before a timing-based kill lands):
+        # the source generator parks before frame 2 until the pod has been
+        # killed, so frame 2 is always sent into a dead port and must ride
+        # the client's retry loop
+        reached_gate = threading.Event()
+        gate_release = threading.Event()
+
+        def paced_frames():
+            for i, f in enumerate(frames):
+                if i == 2:
+                    reached_gate.set()
+                    # released AFTER the pod is killed but BEFORE the
+                    # replacement exists: this frame always meets a dead
+                    # port and must ride the retry loop
+                    if not gate_release.wait(120):
+                        raise RuntimeError("failover gate never released")
+                yield f
+
         cp = Pipeline("mesh-client-retry")
         caps = Caps.tensors(
             TensorsConfig(TensorsInfo.from_strings(dims, "uint8")))
-        csrc = cp.add_new("appsrc", caps=caps, data=list(frames))
+        csrc = cp.add_new("appsrc", caps=caps, data=paced_frames())
         qc = cp.add_new("tensor_query_client", host="127.0.0.1", port=port,
                         timeout_s=60.0, max_request_retry=20)
         csink = cp.add_new("tensor_sink", store=True)
@@ -124,14 +143,18 @@ def composite_query_retry_check(bundle: Any, served: Any, batch: int,
 
         th = threading.Thread(target=run_client, daemon=True)
         th.start()
-        # wait until the stream is mid-flight, then kill the pod
+        assert reached_gate.wait(120), "stream never reached the gate"
+        # both delivered frames drained, pod killed while the stream is
+        # provably unfinished (frames 2..n still unsent)
         deadline = time.monotonic() + 60
         while csink.num_buffers < 2 and time.monotonic() < deadline:
             time.sleep(0.01)
-        assert csink.num_buffers >= 2, "stream never reached mid-flight"
+        assert csink.num_buffers >= 2, "first frames never returned"
         sp1.stop()
-        # replacement pod on the SAME port — the client retry loop rides
-        # out the gap and resends the in-flight frame
+        gate_release.set()  # frame 2 now fires at the DEAD port
+        time.sleep(0.4)     # let at least one connect attempt fail
+        # replacement pod on the SAME port — the client retry loop
+        # (0.2s-backoff reconnects) rides out the gap and resends
         sp2, _ = make_server(port)
         sp2.start()
         th.join(timeout=300)

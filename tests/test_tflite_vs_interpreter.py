@@ -482,3 +482,41 @@ def test_quant_conv_within_quant_steps(tmp_path):
     assert ours.dtype == ref.dtype == np.uint8
     diff = np.abs(ours.astype(np.int32) - ref.astype(np.int32))
     assert int(diff.max()) <= 2, f"quant drift {int(diff.max())} steps"
+
+
+def test_full_integer_int8_model_from_real_converter(tmp_path):
+    """A full-integer (int8 I/O) model produced by the REAL
+    tf.lite.TFLiteConverter — the modern quantization path (the uint8
+    reference models are the legacy one) — imports and matches the
+    interpreter exactly."""
+    tf.random.set_seed(0)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((16, 16, 3)),
+        tf.keras.layers.Conv2D(8, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.Conv2D(16, 3, strides=2, padding="same",
+                               activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10),
+        tf.keras.layers.Softmax(),
+    ])
+    conv = tf.lite.TFLiteConverter.from_keras_model(m)
+    conv.optimizations = [tf.lite.Optimize.DEFAULT]
+    rng = np.random.default_rng(0)
+
+    def rep():
+        for _ in range(16):
+            yield [rng.uniform(0, 1, (1, 16, 16, 3)).astype(np.float32)]
+
+    conv.representative_dataset = rep
+    conv.target_spec.supported_ops = [tf.lite.OpsSet.TFLITE_BUILTINS_INT8]
+    conv.inference_input_type = tf.int8
+    conv.inference_output_type = tf.int8
+    blob = conv.convert()
+
+    x = rng.integers(-128, 127, (1, 16, 16, 3), dtype=np.int8)
+    (ref,) = _interp_run(blob, x)
+    (ours,) = _ours_run(blob, tmp_path, x)
+    assert ours.dtype == ref.dtype == np.int8
+    diff = np.abs(ours.astype(np.int32) - ref.astype(np.int32))
+    assert int(diff.max()) <= 1, f"int8 drift {int(diff.max())} steps"
