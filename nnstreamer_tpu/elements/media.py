@@ -203,12 +203,16 @@ class ImageDec(Element):
             # must not silently swallow every frame behind it, so after
             # several marker-hit decode failures the stream errors here
             self._decode_err = e
-            self._fail_attempts = getattr(self, "_fail_attempts", 0) + 1
-            if self._fail_attempts >= 8:
-                raise ValueError(
-                    f"{self.name}: {self._fail_attempts} decode attempts "
-                    f"failed on accumulated data — corrupt stream ({e})"
-                ) from e
+            if head.startswith((b"\x89PNG", b"\xff\xd8")):
+                # only marker-confirmed attempts count toward the bound:
+                # unknown codecs attempt on EVERY chunk by design, and a
+                # large valid file must not be declared corrupt mid-stream
+                self._fail_attempts = getattr(self, "_fail_attempts", 0) + 1
+                if self._fail_attempts >= 8:
+                    raise ValueError(
+                        f"{self.name}: {self._fail_attempts} decode "
+                        f"attempts failed on accumulated data — corrupt "
+                        f"stream ({e})") from e
             self._marker_seen = False
             return FlowReturn.OK
         self._acc = bytearray()
@@ -229,10 +233,13 @@ class ImageDec(Element):
         if self._acc:
             head = bytes(self._acc[:4])
             known = head.startswith((b"\x89PNG", b"\xff\xd8"))
-            if getattr(self, "_decoded_any", False) and not known:
-                # trailing non-image bytes AFTER a successfully decoded
-                # frame (encoder padding delivered in its own chunk):
-                # tolerable — drop with a trail, don't fail the stream
+            looks_like_padding = set(self._acc) <= {0x00, 0xFF}
+            if getattr(self, "_decoded_any", False) and not known \
+                    and looks_like_padding:
+                # constant-byte filler AFTER a successfully decoded frame
+                # (encoder padding delivered in its own chunk): tolerable —
+                # drop with a trail. Anything structured (a truncated
+                # frame of ANY codec) still raises below
                 from ..core.log import logger
 
                 logger("media").warning(

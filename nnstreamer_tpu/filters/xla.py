@@ -439,12 +439,15 @@ class XLAFilter(FilterFramework):
         with self._lock:
             outs = self._jitted(*arrays)
         if orig_batch is not None:
-            # sharded_bundle's out_shardings put EVERY output's leading axis
-            # over the data mesh axis (make_sharded_infer_step), so all
-            # outputs are batch-led by construction — trim unconditionally
-            # rather than by shape coincidence
+            # sharded_bundle's out_shardings put every output's leading
+            # axis over the data mesh axis, so outputs are batch-led by
+            # contract — but an auxiliary output whose fixed dim0 happens
+            # to divide the mesh would shard without error, so the trim is
+            # still gated on the leading dim matching the padded batch
             outs = tuple(
-                o[:orig_batch] if getattr(o, "ndim", 0) else o
+                o[:orig_batch]
+                if getattr(o, "ndim", 0) and o.shape[0] == orig_batch + pad
+                else o
                 for o in outs)
         if self._sync:
             for o in outs:

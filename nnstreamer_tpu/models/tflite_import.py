@@ -918,12 +918,9 @@ class _Lowerer:
             # mobilenet-ssd-postprocess decoder mode consumes,
             # tensordec-boundingbox.c:121-133). Same center-size decode +
             # greedy-NMS math as decoders/bounding_box.py, lowered into the
-            # model's own XLA program. Fast-NMS path only.
-            if o.get("use_regular_nms"):
-                raise NotImplementedError(
-                    "TFLite_Detection_PostProcess: use_regular_nms=true "
-                    "(per-class regular NMS) is not supported; re-export "
-                    "with the fast-NMS path")
+            # model's own XLA program. Both kernel paths: fast
+            # (class-agnostic NMS over per-anchor best class) and regular
+            # (per-class NMS, vmapped over classes).
             if int(o.get("max_classes_per_detection", 1)) != 1:
                 raise NotImplementedError(
                     "TFLite_Detection_PostProcess: "
@@ -948,11 +945,9 @@ class _Lowerer:
             ww = jnp.exp(locs[:, 3] / np.float32(o["w_scale"])) * wa
             ymin, xmin = yc - hh / 2, xc - ww / 2
             ymax, xmax = yc + hh / 2, xc + ww / 2
-            best_score = jnp.max(cls_scores, axis=1)
-            best_cls = jnp.argmax(cls_scores, axis=1)
             thr = np.float32(o.get("nms_score_threshold", 0.0))
             iou_thr = np.float32(o.get("nms_iou_threshold", 0.6))
-            n = int(best_score.shape[0])
+            n = int(cls_scores.shape[0])
             # static pre-NMS candidate cap: the interpreter considers every
             # above-threshold anchor; 2048 covers the common SSD exports
             # (mobilenet-ssd = 1917 anchors). Beyond it, heavily-suppressed
@@ -968,35 +963,80 @@ class _Lowerer:
                     "threshold", n, k, k)
             neg_inf = np.float32(-np.inf)  # sentinel safe for logit-scale
             #                                thresholds (thr can be ≤ -1)
-            masked = jnp.where(best_score >= thr, best_score, neg_inf)
-            top_score, idx = jax.lax.top_k(masked, k)
-            by0, bx0 = ymin[idx], xmin[idx]
-            by1, bx1 = ymax[idx], xmax[idx]
-            area = (bx1 - bx0) * (by1 - by0)
-            ix = (jnp.minimum(bx1[:, None], bx1[None, :])
-                  - jnp.maximum(bx0[:, None], bx0[None, :]))
-            iy = (jnp.minimum(by1[:, None], by1[None, :])
-                  - jnp.maximum(by0[:, None], by0[None, :]))
-            inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
-            union = area[:, None] + area[None, :] - inter
-            iou = jnp.where(union > 0, inter / union, 0.0)
-            later = jnp.arange(k)[None, :] > jnp.arange(k)[:, None]
-            suppresses = (iou > iou_thr) & later
 
-            def body(i, alive):
-                return alive & ~(alive[i] & suppresses[i])
+            def greedy_nms(scores_1d, cap):
+                """Threshold → top-``cap`` → greedy same-order NMS.
+                Returns (kept_scores[cap] with -inf for dead slots,
+                anchor_idx[cap])."""
+                masked = jnp.where(scores_1d >= thr, scores_1d, neg_inf)
+                top_score, idx = jax.lax.top_k(masked, cap)
+                by0, bx0 = ymin[idx], xmin[idx]
+                by1, bx1 = ymax[idx], xmax[idx]
+                area = (bx1 - bx0) * (by1 - by0)
+                ix = (jnp.minimum(bx1[:, None], bx1[None, :])
+                      - jnp.maximum(bx0[:, None], bx0[None, :]))
+                iy = (jnp.minimum(by1[:, None], by1[None, :])
+                      - jnp.maximum(by0[:, None], by0[None, :]))
+                inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
+                union = area[:, None] + area[None, :] - inter
+                iou = jnp.where(union > 0, inter / union, 0.0)
+                later = jnp.arange(cap)[None, :] > jnp.arange(cap)[:, None]
+                suppresses = (iou > iou_thr) & later
 
-            alive = jax.lax.fori_loop(0, k, body, top_score >= thr)
-            kept = jnp.where(alive, top_score, neg_inf)
-            final_score, fsel = jax.lax.top_k(kept, min(max_d, k))
+                def body(i, alive):
+                    return alive & ~(alive[i] & suppresses[i])
+
+                alive = jax.lax.fori_loop(0, cap, body, top_score >= thr)
+                return jnp.where(alive, top_score, neg_inf), idx
+
+            if o.get("use_regular_nms"):
+                # regular path: NMS runs per class (vmapped — the IoU
+                # matrix is shared math, scores differ per class), each
+                # class keeps top detections_per_class, then a global
+                # top-max_detections ranks across classes
+                dpc = int(o.get("detections_per_class", 100) or 100)
+                # per-class candidate pool: the interpreter NMS-es every
+                # above-threshold candidate; 2*dpc headroom lets suppressed
+                # clusters backfill from lower ranks. Bounded so the
+                # C×kc×kc IoU tensor stays small; warn when it binds.
+                kc = min(k, max(2 * dpc, max_d, 128))
+                if n > kc:
+                    from ..core.log import logger
+
+                    logger("tflite").warning(
+                        "TFLite_Detection_PostProcess(regular): per-class "
+                        "candidate pool capped at %d of %d anchors; heavy "
+                        "same-class suppression may backfill differently "
+                        "from the TFLite runtime", kc, n)
+                kept_c, idx_c = jax.vmap(
+                    lambda s: greedy_nms(s, kc))(cls_scores.T)  # [C, kc]
+                if dpc < kc:
+                    # zero out ranks beyond detections_per_class per class
+                    rank = jnp.argsort(jnp.argsort(-kept_c, axis=1), axis=1)
+                    kept_c = jnp.where(rank < dpc, kept_c, neg_inf)
+                flat_scores = kept_c.reshape(-1)          # [C*kc]
+                flat_anchor = idx_c.reshape(-1)
+                flat_cls = jnp.repeat(
+                    jnp.arange(num_classes, dtype=jnp.float32), kc)
+                final_score, fsel = jax.lax.top_k(
+                    flat_scores, min(max_d, int(flat_scores.shape[0])))
+                sel = flat_anchor[fsel]
+                sel_cls = flat_cls[fsel]
+            else:
+                # fast path: class-agnostic NMS over per-anchor best class
+                best_score = jnp.max(cls_scores, axis=1)
+                best_cls = jnp.argmax(cls_scores, axis=1)
+                kept, idx = greedy_nms(best_score, k)
+                final_score, fsel = jax.lax.top_k(kept, min(max_d, k))
+                sel = idx[fsel]
+                sel_cls = best_cls[sel].astype(jnp.float32)
             pad = max_d - int(final_score.shape[0])
             valid = final_score >= thr
-            sel = idx[fsel]
             out_boxes = jnp.where(
                 valid[:, None],
                 jnp.stack([ymin[sel], xmin[sel], ymax[sel], xmax[sel]], 1),
                 0.0)
-            out_cls = jnp.where(valid, best_cls[sel].astype(jnp.float32), 0.0)
+            out_cls = jnp.where(valid, sel_cls, 0.0)
             out_scr = jnp.where(valid, final_score, 0.0)
             if pad:
                 out_boxes = jnp.pad(out_boxes, ((0, pad), (0, 0)))

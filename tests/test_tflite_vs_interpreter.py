@@ -401,40 +401,67 @@ def test_detection_postprocess_no_background_column(tmp_path):
     np.testing.assert_allclose(ours[0][0, :n], ref[0][0, :n], atol=1e-5)
 
 
-def test_detection_postprocess_regular_nms_clear_error(tmp_path):
+def _build_detection_postprocess_regular(rng, n_anchors=32, num_classes=3,
+                                         max_detections=8,
+                                         detections_per_class=100):
+    """Same graph with use_regular_nms=true (per-class NMS kernel path)."""
     from flatbuffers import flexbuffers
 
     fbb = flexbuffers.Builder()
     with fbb.Map():
-        fbb.Int("max_detections", 8)
+        fbb.Int("max_detections", max_detections)
         fbb.Int("max_classes_per_detection", 1)
-        fbb.Int("detections_per_class", 100)
+        fbb.Int("detections_per_class", detections_per_class)
         fbb.Bool("use_regular_nms", True)
         fbb.Float("nms_score_threshold", 0.3)
         fbb.Float("nms_iou_threshold", 0.5)
-        fbb.Int("num_classes", 3)
+        fbb.Int("num_classes", num_classes)
         fbb.Float("y_scale", 10.0)
         fbb.Float("x_scale", 10.0)
         fbb.Float("h_scale", 5.0)
         fbb.Float("w_scale", 5.0)
-    anchors = np.zeros((32, 4), np.float32)
-    blob2 = build_tflite(
+    opts = fbb.Finish()
+    g = int(np.ceil(np.sqrt(n_anchors)))
+    yy, xx = np.meshgrid(np.linspace(0.1, 0.9, g), np.linspace(0.1, 0.9, g))
+    anchors = np.stack([yy.ravel()[:n_anchors], xx.ravel()[:n_anchors],
+                        np.full(n_anchors, 0.2), np.full(n_anchors, 0.2)],
+                       axis=1).astype(np.float32)
+    locs = (rng.standard_normal((1, n_anchors, 4)) * 0.5).astype(np.float32)
+    scores = rng.uniform(0, 1, (1, n_anchors, num_classes + 1)) \
+        .astype(np.float32)
+    blob = build_tflite(
         tensors=[
-            {"shape": (1, 32, 4), "type": F32, "data": None},
-            {"shape": (1, 32, 4), "type": F32, "data": None},
-            {"shape": (32, 4), "type": F32, "data": anchors},
-            {"shape": (1, 8, 4), "type": F32, "data": None},
-            {"shape": (1, 8), "type": F32, "data": None},
-            {"shape": (1, 8), "type": F32, "data": None},
+            {"shape": (1, n_anchors, 4), "type": F32, "data": None},
+            {"shape": (1, n_anchors, num_classes + 1), "type": F32,
+             "data": None},
+            {"shape": (n_anchors, 4), "type": F32, "data": anchors},
+            {"shape": (1, max_detections, 4), "type": F32, "data": None},
+            {"shape": (1, max_detections), "type": F32, "data": None},
+            {"shape": (1, max_detections), "type": F32, "data": None},
             {"shape": (1,), "type": F32, "data": None},
         ],
         operators=[{"code": 32, "custom_code": "TFLite_Detection_PostProcess",
-                    "custom_options": fbb.Finish(),
+                    "custom_options": opts,
                     "inputs": [0, 1, 2], "outputs": [3, 4, 5, 6]}],
         inputs=[0, 1], outputs=[3, 4, 5, 6])
-    with pytest.raises(NotImplementedError, match="regular_nms"):
-        _ours_run(blob2, tmp_path, np.zeros((1, 32, 4), np.float32),
-                  np.zeros((1, 32, 4), np.float32))
+    return blob, (locs, scores)
+
+
+@pytest.mark.parametrize("dpc", [100, 2])
+def test_detection_postprocess_regular_nms_vs_interpreter(tmp_path, dpc):
+    """use_regular_nms=true (per-class NMS, incl. a binding
+    detections_per_class cap) matches the interpreter's kernel."""
+    blob, inputs = _build_detection_postprocess_regular(
+        np.random.default_rng(21), detections_per_class=dpc)
+    ref = _interp_run(blob, *inputs)
+    ours = _ours_run(blob, tmp_path, *inputs)
+    r_boxes, r_cls, r_scr, r_num = ref
+    o_boxes, o_cls, o_scr, o_num = ours
+    assert int(o_num[0]) == int(r_num[0]) > 0
+    nn = int(r_num[0])
+    np.testing.assert_allclose(o_scr[0, :nn], r_scr[0, :nn], atol=1e-5)
+    np.testing.assert_array_equal(o_cls[0, :nn], r_cls[0, :nn])
+    np.testing.assert_allclose(o_boxes[0, :nn], r_boxes[0, :nn], atol=1e-5)
 
 
 def test_detection_postprocess_feeds_ssd_decoder(tmp_path):
