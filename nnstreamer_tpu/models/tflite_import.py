@@ -146,13 +146,21 @@ _BUILTIN_OPS = {
     34: "PAD", 36: "GATHER", 39: "TRANSPOSE", 40: "MEAN", 41: "SUB",
     42: "DIV", 43: "SQUEEZE", 45: "STRIDED_SLICE", 47: "EXP",
     49: "SPLIT", 53: "CAST", 54: "PRELU", 55: "MAXIMUM", 56: "ARG_MAX",
-    57: "MINIMUM", 60: "PAD_V2", 65: "SLICE", 67: "TRANSPOSE_CONV",
-    70: "EXPAND_DIMS", 74: "SUM", 75: "SQRT", 76: "RSQRT", 77: "SHAPE",
-    78: "POW", 79: "ARG_MIN", 83: "PACK", 88: "UNPACK", 97: "RESIZE_NEAREST",
+    57: "MINIMUM", 58: "LESS", 60: "PAD_V2", 61: "GREATER",
+    62: "GREATER_EQUAL", 63: "LESS_EQUAL", 65: "SLICE",
+    67: "TRANSPOSE_CONV", 70: "EXPAND_DIMS", 71: "EQUAL", 72: "NOT_EQUAL",
+    73: "LOG", 74: "SUM", 75: "SQRT", 76: "RSQRT", 77: "SHAPE",
+    78: "POW", 79: "ARG_MIN", 82: "REDUCE_MAX", 83: "PACK",
+    84: "LOGICAL_OR", 86: "LOGICAL_AND", 87: "LOGICAL_NOT",
+    88: "UNPACK", 89: "REDUCE_MIN", 97: "RESIZE_NEAREST",
     98: "LEAKY_RELU", 101: "ABS", 114: "QUANTIZE", 117: "HARD_SWISH",
+    118: "IF", 119: "WHILE",
 }
 
 _ACT_NONE, _ACT_RELU, _ACT_RELU_N1, _ACT_RELU6, _ACT_TANH = 0, 1, 2, 3, 4
+
+#: CUSTOM ops the lowerer handles (others fail at load)
+_SUPPORTED_CUSTOM = frozenset({"CUSTOM:TFLite_Detection_PostProcess"})
 
 
 @dataclass
@@ -189,14 +197,27 @@ class TFLOperator:
 
 
 @dataclass
-class TFLModel:
-    path: str
-    version: int
-    description: str
+class TFLSubgraph:
     tensors: List[TFLTensor]
     operators: List[TFLOperator]
     inputs: List[int]
     outputs: List[int]
+    name: str = ""
+
+
+@dataclass
+class TFLModel:
+    path: str
+    version: int
+    description: str
+    #: main subgraph contents, aliased for the common single-graph case
+    tensors: List[TFLTensor]
+    operators: List[TFLOperator]
+    inputs: List[int]
+    outputs: List[int]
+    #: ALL subgraphs (index 0 is the main one above); >1 for control-flow
+    #: models (IF/WHILE bodies live in their own subgraphs)
+    subgraphs: List[TFLSubgraph] = field(default_factory=list)
 
 
 def _parse_quant(fb: _FB, qpos: Optional[int]) -> Optional[QuantParams]:
@@ -276,7 +297,7 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "FULLY_CONNECTED":
         o["activation"] = fb.scalar(opos, 0, fb.i8, 0)
         o["keep_num_dims"] = bool(fb.scalar(opos, 2, fb.u8, 0))
-    elif op in ("MEAN", "SUM"):
+    elif op in ("MEAN", "SUM", "REDUCE_MAX", "REDUCE_MIN"):
         o["keep_dims"] = bool(fb.scalar(opos, 0, fb.u8, 0))
     elif op in ("ARG_MAX", "ARG_MIN"):
         o["output_type"] = fb.scalar(opos, 0, fb.i8, 2)  # TensorType enum
@@ -317,6 +338,14 @@ def _parse_options(fb: _FB, op: str, opos: Optional[int]) -> Dict[str, Any]:
     elif op == "PACK":
         # PackOptions: 0 values_count, 1 axis
         o["axis"] = fb.scalar(opos, 1, fb.i32, 0)
+    elif op == "IF":
+        # IfOptions: 0 then_subgraph_index, 1 else_subgraph_index
+        o["then_subgraph"] = fb.scalar(opos, 0, fb.i32, 0)
+        o["else_subgraph"] = fb.scalar(opos, 1, fb.i32, 0)
+    elif op == "WHILE":
+        # WhileOptions: 0 cond_subgraph_index, 1 body_subgraph_index
+        o["cond_subgraph"] = fb.scalar(opos, 0, fb.i32, 0)
+        o["body_subgraph"] = fb.scalar(opos, 1, fb.i32, 0)
     return _validate_options(op, o)
 
 
@@ -335,6 +364,18 @@ def _validate_options(op: str, o: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError(
                 f"tflite: {op} filter_width/filter_height must be >= 1 "
                 f"(got {o.get('filter_w')}x{o.get('filter_h')})")
+    if op == "IF" and (o.get("then_subgraph", 0) < 1
+                       or o.get("else_subgraph", 0) < 1):
+        # a missing/defaulted options table would point the branch at
+        # subgraph 0 — the MAIN graph, i.e. unbounded self-recursion —
+        # reject malformed control flow at parse
+        raise ValueError(
+            "tflite: IF operator missing/invalid then/else subgraph indices")
+    if op == "WHILE" and (o.get("cond_subgraph", 0) < 1
+                          or o.get("body_subgraph", 0) < 1):
+        raise ValueError(
+            "tflite: WHILE operator missing/invalid cond/body subgraph "
+            "indices")
     return o
 
 
@@ -371,72 +412,81 @@ def parse_tflite(path: str) -> TFLModel:
     for b in fb.vec_tables(model, 4):
         buffers.append(fb.vector(b, 0))  # (nbytes, pos) or None
 
-    subgraphs = fb.vec_tables(model, 2)
-    if len(subgraphs) != 1:
-        raise ValueError(f"{path}: {len(subgraphs)} subgraphs; only "
-                         "single-subgraph models are supported")
-    sg = subgraphs[0]
-    # SubGraph: 0 tensors, 1 inputs, 2 outputs, 3 operators, 4 name
-    tensors: List[TFLTensor] = []
-    for i, t in enumerate(fb.vec_tables(sg, 0)):
-        # Tensor: 0 shape[i32], 1 type(i8), 2 buffer(u32), 3 name,
-        # 4 quantization, 5 is_variable, 6 sparsity, 7 shape_signature
-        shape_v = fb.vec_np(t, 0, "<i4")
-        shape = tuple(int(d) for d in shape_v) if shape_v is not None else ()
-        ttype = fb.scalar(t, 1, fb.i8, 0)
-        np_dtype = _TENSORTYPE_NP.get(ttype)
-        if np_dtype is None:
-            raise ValueError(f"{path}: tensor {i} has unsupported "
-                             f"TensorType {ttype}")
-        bufidx = fb.scalar(t, 2, fb.u32, 0)
-        quant = _parse_quant(fb, fb.offset(t, 4))
-        data = None
-        if 0 < bufidx < len(buffers) and buffers[bufidx] is not None:
-            nbytes, pos = buffers[bufidx]
-            if nbytes:
-                flat = np.frombuffer(
-                    buf, dtype=np.dtype(np_dtype),
-                    count=nbytes // np.dtype(np_dtype).itemsize, offset=pos)
-                data = flat.reshape(shape if shape else (-1,)).copy()
-        tensors.append(TFLTensor(i, fb.string(t, 3) or f"t{i}", shape,
-                                 np_dtype, bufidx, quant, data))
-
-    operators: List[TFLOperator] = []
-    for opr in fb.vec_tables(sg, 3):
-        # Operator: 0 opcode_index, 1 inputs[i32], 2 outputs[i32],
-        # 3 builtin_options_type(u8), 4 builtin_options(table),
-        # 5 custom_options[ubyte]
-        idx = fb.scalar(opr, 0, fb.u32, 0)
-        name = op_names[idx] if idx < len(op_names) else f"BADCODE_{idx}"
-        ins = fb.vec_np(opr, 1, "<i4")
-        outs = fb.vec_np(opr, 2, "<i4")
-        options = _parse_options(fb, name, fb.offset(opr, 4))
-        if name.startswith("CUSTOM:"):
-            # Operator slot 5: custom_options[ubyte] — a flexbuffer map for
-            # the ops we support (the flatbuffers *runtime* decodes it; no
-            # generated code involved)
-            co = fb.vector(opr, 5)
-            if co is not None:
-                nbytes, pos = co
+    def parse_subgraph(sg) -> TFLSubgraph:
+        # SubGraph: 0 tensors, 1 inputs, 2 outputs, 3 operators, 4 name
+        tensors: List[TFLTensor] = []
+        for i, t in enumerate(fb.vec_tables(sg, 0)):
+            # Tensor: 0 shape[i32], 1 type(i8), 2 buffer(u32), 3 name,
+            # 4 quantization, 5 is_variable, 6 sparsity, 7 shape_signature
+            shape_v = fb.vec_np(t, 0, "<i4")
+            shape = tuple(int(d) for d in shape_v) \
+                if shape_v is not None else ()
+            ttype = fb.scalar(t, 1, fb.i8, 0)
+            np_dtype = _TENSORTYPE_NP.get(ttype)
+            if np_dtype is None:
+                raise ValueError(f"{path}: tensor {i} has unsupported "
+                                 f"TensorType {ttype}")
+            bufidx = fb.scalar(t, 2, fb.u32, 0)
+            quant = _parse_quant(fb, fb.offset(t, 4))
+            data = None
+            if 0 < bufidx < len(buffers) and buffers[bufidx] is not None:
+                nbytes, pos = buffers[bufidx]
                 if nbytes:
-                    try:
-                        from flatbuffers import flexbuffers
+                    flat = np.frombuffer(
+                        buf, dtype=np.dtype(np_dtype),
+                        count=nbytes // np.dtype(np_dtype).itemsize,
+                        offset=pos)
+                    data = flat.reshape(shape if shape else (-1,)).copy()
+            tensors.append(TFLTensor(i, fb.string(t, 3) or f"t{i}", shape,
+                                     np_dtype, bufidx, quant, data))
 
-                        decoded = flexbuffers.Loads(bytes(buf[pos:pos + nbytes]))
-                        if isinstance(decoded, dict):
-                            options.update(decoded)
-                    except Exception:
-                        pass  # op lowering reports missing keys clearly
-        operators.append(TFLOperator(
-            name, [int(x) for x in (ins if ins is not None else [])],
-            [int(x) for x in (outs if outs is not None else [])], options))
+        operators: List[TFLOperator] = []
+        for opr in fb.vec_tables(sg, 3):
+            # Operator: 0 opcode_index, 1 inputs[i32], 2 outputs[i32],
+            # 3 builtin_options_type(u8), 4 builtin_options(table),
+            # 5 custom_options[ubyte]
+            idx = fb.scalar(opr, 0, fb.u32, 0)
+            name = op_names[idx] if idx < len(op_names) else f"BADCODE_{idx}"
+            ins = fb.vec_np(opr, 1, "<i4")
+            outs = fb.vec_np(opr, 2, "<i4")
+            options = _parse_options(fb, name, fb.offset(opr, 4))
+            if name.startswith("CUSTOM:"):
+                # Operator slot 5: custom_options[ubyte] — a flexbuffer map
+                # for the ops we support (the flatbuffers *runtime* decodes
+                # it; no generated code involved)
+                co = fb.vector(opr, 5)
+                if co is not None:
+                    nbytes, pos = co
+                    if nbytes:
+                        try:
+                            from flatbuffers import flexbuffers
 
-    inputs_v = fb.vec_np(sg, 1, "<i4")
-    outputs_v = fb.vec_np(sg, 2, "<i4")
-    return TFLModel(
-        path, version, desc, tensors, operators,
-        [int(x) for x in (inputs_v if inputs_v is not None else [])],
-        [int(x) for x in (outputs_v if outputs_v is not None else [])])
+                            decoded = flexbuffers.Loads(
+                                bytes(buf[pos:pos + nbytes]))
+                            if isinstance(decoded, dict):
+                                options.update(decoded)
+                        except Exception:
+                            pass  # op lowering reports missing keys clearly
+            operators.append(TFLOperator(
+                name, [int(x) for x in (ins if ins is not None else [])],
+                [int(x) for x in (outs if outs is not None else [])],
+                options))
+
+        inputs_v = fb.vec_np(sg, 1, "<i4")
+        outputs_v = fb.vec_np(sg, 2, "<i4")
+        return TFLSubgraph(
+            tensors, operators,
+            [int(x) for x in (inputs_v if inputs_v is not None else [])],
+            [int(x) for x in (outputs_v if outputs_v is not None else [])],
+            fb.string(sg, 4) or "")
+
+    sg_tables = fb.vec_tables(model, 2)
+    if not sg_tables:
+        raise ValueError(f"{path}: model has no subgraphs")
+    parsed = [parse_subgraph(sg) for sg in sg_tables]
+    main = parsed[0]
+    return TFLModel(path, version, desc, main.tensors, main.operators,
+                    main.inputs, main.outputs, parsed)
 
 
 # --------------------------------------------------------------------------- #
@@ -546,54 +596,88 @@ def _avg_pool_same_countvalid(x, fh, fw, sh, sw):
 
 
 class _Lowerer:
-    """Per-model lowering state: maps tensor index → traced value."""
+    """Per-subgraph lowering state: maps tensor index → traced value.
 
-    def __init__(self, m: TFLModel):
+    The root lowerer (subgraph 0) owns the shared params dict and eagerly
+    creates child lowerers for every other subgraph, so ALL constants are
+    registered before the first jit trace flattens the params pytree
+    (IF/WHILE bodies live in their own subgraphs and run via
+    lax.cond/lax.while_loop)."""
+
+    def __init__(self, m: TFLModel, sg_index: int = 0,
+                 root: Optional["_Lowerer"] = None):
         self.m = m
-        self.params: Dict[str, np.ndarray] = {}
+        self.sg = m.subgraphs[sg_index] if m.subgraphs else m
+        self.sg_index = sg_index
+        self._prefix = "" if sg_index == 0 else f"sg{sg_index}/"
+        self.root = root or self
+        self.params: Dict[str, np.ndarray] = \
+            {} if root is None else root.params
         self.const_idx: set = set()
-        for t in m.tensors:
+        for t in self.sg.tensors:
             if t.data is not None:
-                self.params[f"t{t.index}"] = _dequant_const(t)
+                self.params[f"{self._prefix}t{t.index}"] = _dequant_const(t)
                 self.const_idx.add(t.index)
                 t.data = None  # raw payload no longer needed; the params
                 # copy is the only one that must outlive the load
+        if root is None:
+            self._children: Dict[int, "_Lowerer"] = {0: self}
+            for si in range(1, len(m.subgraphs or [])):
+                self._children[si] = _Lowerer(m, si, root=self)
+
+    def _subgraph_apply(self, si: int) -> Callable:
+        try:
+            child = self.root._children[si]
+        except KeyError:
+            raise ValueError(
+                f"{os.path.basename(self.m.path)}: control-flow op "
+                f"references unknown subgraph {si}") from None
+        return child.build_apply()
 
     # -- graph evaluation --------------------------------------------------- #
     def build_apply(self) -> Callable:
         m = self.m
+        sg = self.sg
         const_idx = self.const_idx
+        prefix = self._prefix
+        is_root = self.root is self
 
         def apply(params, *inputs):
             import jax.numpy as jnp
 
-            env: Dict[int, Any] = {}
+            env: Dict[Any, Any] = {}
+            # live params ride in the env so IF/WHILE evals can pass them
+            # to child subgraph applies explicitly (no mutable lowerer
+            # state — a stashed pytree would retain dead tracers)
+            env["__params__"] = params
             for idx in const_idx:
-                env[idx] = params[f"t{idx}"]
-            if len(inputs) != len(m.inputs):
+                env[idx] = params[f"{prefix}t{idx}"]
+            if len(inputs) != len(sg.inputs):
                 raise ValueError(
                     f"{os.path.basename(m.path)}: expected "
-                    f"{len(m.inputs)} inputs, got {len(inputs)}")
-            for idx, x in zip(m.inputs, inputs):
-                t = m.tensors[idx]
+                    f"{len(sg.inputs)} inputs, got {len(inputs)}")
+            for idx, x in zip(sg.inputs, inputs):
+                t = sg.tensors[idx]
                 x = jnp.asarray(x)
                 if x.shape != t.shape and int(np.prod(x.shape)) == int(
                         np.prod(t.shape)):
                     x = x.reshape(t.shape)
-                if t.quant is not None and not np.issubdtype(
+                if is_root and t.quant is not None and not np.issubdtype(
                         np.dtype(t.np_dtype), np.floating):
+                    # model-BOUNDARY dequantization only: inner subgraphs
+                    # (IF/WHILE bodies) receive already-dequantized floats
                     _require_per_tensor_io(m, t, "input")
                     x = (x.astype(jnp.float32)
                          - np.float32(t.quant.zero_point)) \
                         * np.float32(t.quant.scale)
                 env[idx] = x
-            for op in m.operators:
+            for op in sg.operators:
                 self._eval_op(op, env)
             outs = []
-            for idx in m.outputs:
-                t = m.tensors[idx]
+            for idx in sg.outputs:
+                t = sg.tensors[idx]
                 y = env[idx]
-                if t.quant is not None and not np.issubdtype(
+                if is_root and t.quant is not None and not np.issubdtype(
                         np.dtype(t.np_dtype), np.floating):
                     _require_per_tensor_io(m, t, "output")
                     q = jnp.round(y / np.float32(t.quant.scale)
@@ -678,7 +762,7 @@ class _Lowerer:
                 new_shape = [int(v) for v in np.asarray(shape_t)]
             else:
                 new_shape = o.get("new_shape") or list(
-                    self.m.tensors[op.outputs[0]].shape)
+                    self.sg.tensors[op.outputs[0]].shape)
             y = x.reshape(new_shape)
         elif name == "SQUEEZE":
             x = get(0)
@@ -736,10 +820,11 @@ class _Lowerer:
             iy = jnp.clip(iy.astype(jnp.int32), 0, h - 1)
             ix = jnp.clip(ix.astype(jnp.int32), 0, w - 1)
             y = x[:, iy][:, :, ix]
-        elif name in ("MEAN", "SUM"):
+        elif name in ("MEAN", "SUM", "REDUCE_MAX", "REDUCE_MIN"):
             x = get(0)
             axes = tuple(int(a) for a in np.asarray(get(1)).reshape(-1))
-            red = jnp.mean if name == "MEAN" else jnp.sum
+            red = {"MEAN": jnp.mean, "SUM": jnp.sum,
+                   "REDUCE_MAX": jnp.max, "REDUCE_MIN": jnp.min}[name]
             y = red(x, axis=axes, keepdims=o.get("keep_dims", False))
         elif name in ("ARG_MAX", "ARG_MIN"):
             x = get(0)
@@ -768,7 +853,7 @@ class _Lowerer:
         elif name == "CAST":
             x = get(0)
             out_t = o.get("out_type")
-            y = x.astype(self.m.tensors[op.outputs[0]].np_dtype
+            y = x.astype(self.sg.tensors[op.outputs[0]].np_dtype
                          if out_t is None
                          else _TENSORTYPE_NP.get(out_t, np.float32))
         elif name in ("DEQUANTIZE", "QUANTIZE"):
@@ -791,6 +876,62 @@ class _Lowerer:
                  .reshape(n, h * bs, w * bs, c // (bs * bs))
         elif name == "SHAPE":
             y = jnp.asarray(env[op.inputs[0]].shape, np.int32)
+        elif name in ("LESS", "LESS_EQUAL", "GREATER", "GREATER_EQUAL",
+                      "EQUAL", "NOT_EQUAL"):
+            a, b = get(0), get(1)
+            y = {"LESS": jnp.less, "LESS_EQUAL": jnp.less_equal,
+                 "GREATER": jnp.greater, "GREATER_EQUAL": jnp.greater_equal,
+                 "EQUAL": jnp.equal, "NOT_EQUAL": jnp.not_equal}[name](a, b)
+        elif name in ("LOGICAL_AND", "LOGICAL_OR"):
+            y = (jnp.logical_and if name == "LOGICAL_AND"
+                 else jnp.logical_or)(get(0), get(1))
+        elif name == "IF":
+            # cond tensor + then/else subgraphs → lax.cond: both branches
+            # trace (XLA requirement), matching output shapes enforced by
+            # the schema (both subgraphs share the signature)
+            import jax
+
+            pred = jnp.reshape(get(0), ()).astype(bool)
+            then_fn = self.root._subgraph_apply(o["then_subgraph"])
+            else_fn = self.root._subgraph_apply(o["else_subgraph"])
+            operands = tuple(env[i] for i in op.inputs[1:])
+            live_params = env["__params__"]
+            res = jax.lax.cond(
+                pred,
+                lambda args: tuple(then_fn(live_params, *args)),
+                lambda args: tuple(else_fn(live_params, *args)),
+                operands)
+            for out_idx, val in zip(op.outputs, res):
+                env[out_idx] = val
+            return
+        elif name == "WHILE":
+            # cond/body subgraphs over a carried tuple → lax.while_loop
+            # (shape/dtype-invariant carry — the compiler-friendly loop;
+            # a shape-changing TFLite WHILE cannot map to XLA and errors)
+            import jax
+
+            cond_fn = self.root._subgraph_apply(o["cond_subgraph"])
+            body_fn = self.root._subgraph_apply(o["body_subgraph"])
+            carry0 = tuple(env[i] for i in op.inputs)
+            live_params = env["__params__"]
+            try:
+                res = jax.lax.while_loop(
+                    lambda c: jnp.reshape(
+                        cond_fn(live_params, *c)[0], ()).astype(bool),
+                    lambda c: tuple(body_fn(live_params, *c)),
+                    carry0)
+            except TypeError as e:
+                raise NotImplementedError(
+                    f"{os.path.basename(self.m.path)}: WHILE body changes "
+                    f"carry shapes/dtypes — not expressible as an XLA "
+                    f"while_loop ({e})") from e
+            for out_idx, val in zip(op.outputs, res):
+                env[out_idx] = val
+            return
+        elif name == "LOGICAL_NOT":
+            y = jnp.logical_not(get(0))
+        elif name == "LOG":
+            y = jnp.log(get(0))
         elif name in ("SQRT", "RSQRT", "EXP", "ABS", "POW"):
             x = get(0)
             y = {"SQRT": jnp.sqrt, "RSQRT": lambda v: 1.0 / jnp.sqrt(v),
@@ -1068,7 +1209,7 @@ class _Lowerer:
         producing op."""
         import jax.numpy as jnp
 
-        t = self.m.tensors[tensor_idx]
+        t = self.sg.tensors[tensor_idx]
         if t.quant is None or np.issubdtype(np.dtype(t.np_dtype),
                                             np.floating):
             return y
@@ -1106,7 +1247,18 @@ def load_tflite(path: str) -> ModelBundle:
             t = m.tensors[i]
             if not np.issubdtype(np.dtype(t.np_dtype), np.floating):
                 _require_per_tensor_io(m, t, role)
-    ops_used = sorted({op.op for op in m.operators})
+    # op inventory spans EVERY subgraph (IF/WHILE bodies included), and
+    # unknown opcodes fail at load, not at first inference
+    all_ops: set = set()
+    for sgi in (m.subgraphs or [m]):
+        all_ops.update(op.op for op in sgi.operators)
+    bad = sorted(n for n in all_ops
+                 if n.startswith(("UNKNOWN_", "BADCODE_"))
+                 or (n.startswith("CUSTOM:") and n not in _SUPPORTED_CUSTOM))
+    if bad:
+        raise NotImplementedError(
+            f"{os.path.basename(path)}: unsupported op(s) {', '.join(bad)}")
+    ops_used = sorted(all_ops)
     low = _Lowerer(m)
     apply = low.build_apply()
     in_info = TensorsInfo(tuple(_tensor_info(m.tensors[i]) for i in m.inputs))
