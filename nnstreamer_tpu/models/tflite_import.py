@@ -947,11 +947,24 @@ class _Lowerer:
             y = x[idx]
         elif name == "GATHER":
             x, indices = get(0), get(1)
-            if o.get("batch_dims", 0):
-                raise NotImplementedError(
-                    "GATHER batch_dims != 0 is not lowered")
-            y = jnp.take(x, jnp.asarray(indices).astype(jnp.int32),
-                         axis=o.get("axis", 0))
+            indices = jnp.asarray(indices).astype(jnp.int32)
+            axis = o.get("axis", 0)
+            bd = int(o.get("batch_dims", 0) or 0)
+            if bd:
+                # batched gather: vmap the plain take over the leading
+                # batch dims shared by data and indices
+                import jax
+
+                if bd < 0:
+                    bd += indices.ndim
+                ax = axis if axis >= 0 else axis + x.ndim
+                inner_ax = ax - bd
+                fn = lambda a, i: jnp.take(a, i, axis=inner_ax)  # noqa: E731
+                for _ in range(bd):
+                    fn = jax.vmap(fn)
+                y = fn(x, indices)
+            else:
+                y = jnp.take(x, indices, axis=axis)
         elif name == "PACK":
             y = jnp.stack([env[i] for i in op.inputs], axis=o.get("axis", 0))
         elif name == "STRIDED_SLICE":
@@ -960,34 +973,50 @@ class _Lowerer:
             end = np.asarray(get(2)).reshape(-1)
             strides = np.asarray(get(3)).reshape(-1) if get(3) is not None \
                 else np.ones_like(begin)
-            if o.get("ellipsis_mask") or o.get("new_axis_mask"):
-                raise NotImplementedError(
-                    "STRIDED_SLICE ellipsis/new-axis masks")
+            nspec = len(begin)
+            new_mask = o.get("new_axis_mask", 0)
+            ell_mask = o.get("ellipsis_mask", 0)
+            if bin(ell_mask).count("1") > 1:
+                raise ValueError("STRIDED_SLICE: multiple ellipsis bits")
+            n_new = bin(new_mask & ((1 << nspec) - 1)).count("1")
+            dims_covered = nspec - n_new - (1 if ell_mask else 0)
+            ell_fill = x.ndim - dims_covered  # full slices the … expands to
             idx = []
-            for d in range(x.ndim):
+            d = 0  # input dimension cursor (spec position i may diverge
+            #        from it through new-axis and ellipsis entries)
+            for i in range(nspec):
+                if ell_mask & (1 << i):
+                    for _ in range(max(ell_fill, 0)):
+                        idx.append(slice(None))
+                        d += 1
+                    continue
+                if new_mask & (1 << i):
+                    idx.append(None)  # np.newaxis
+                    continue
                 dim = x.shape[d]
-                b = int(begin[d]) if d < len(begin) else 0
-                e = int(end[d]) if d < len(end) else dim
-                s = int(strides[d]) if d < len(strides) else 1
+                b = int(begin[i])
+                e = int(end[i])
+                s = int(strides[i]) if i < len(strides) else 1
                 # Start/StopForAxis semantics (strided_slice_logic.h):
                 # masks and clamping resolve BEFORE shrink; the clamp
                 # range is [0, dim] for positive stride and [-1, dim-1]
                 # for negative (dim / -1 = "exhausted" → empty slice,
                 # where -1 must NOT be handed to python slicing)
-                if o.get("begin_mask", 0) & (1 << d):
+                if o.get("begin_mask", 0) & (1 << i):
                     b = 0 if s > 0 else dim - 1
                 else:
                     if b < 0:
                         b += dim
-                    if o.get("shrink_axis_mask", 0) & (1 << d):
+                    if o.get("shrink_axis_mask", 0) & (1 << i):
                         b = int(np.clip(b, 0, dim - 1))
                     else:
                         b = int(np.clip(b, 0, dim)) if s > 0 \
                             else int(np.clip(b, -1, dim - 1))
-                if o.get("shrink_axis_mask", 0) & (1 << d):
+                if o.get("shrink_axis_mask", 0) & (1 << i):
                     idx.append(b)
+                    d += 1
                     continue
-                if o.get("end_mask", 0) & (1 << d):
+                if o.get("end_mask", 0) & (1 << i):
                     e = None
                 else:
                     if e < 0:
@@ -1000,6 +1029,10 @@ class _Lowerer:
                     idx.append(slice(b, None, s))   # through index 0
                 else:
                     idx.append(slice(b, e, s))
+                d += 1
+            while d < x.ndim:  # dims beyond the spec: full slices
+                idx.append(slice(None))
+                d += 1
             y = x[tuple(idx)]
         elif name == "TRANSPOSE_CONV":
             # inputs: 0 output_shape, 1 weights (OHWI, O=output ch),

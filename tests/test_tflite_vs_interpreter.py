@@ -620,3 +620,34 @@ def test_while_data_dependent_trip_count(tmp_path):
         (ref,) = _interp_run(blob, x)
         (ours,) = _ours_run(blob, tmp_path, x)
         np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_gather_batch_dims(tmp_path):
+    def f(params, idx):
+        return tf.gather(params, idx, axis=2, batch_dims=1)
+
+    blob = _convert_fn(f, [tf.TensorSpec([2, 3, 5], tf.float32),
+                           tf.TensorSpec([2, 4], tf.int32)])
+    rng = np.random.default_rng(3)
+    params = rng.standard_normal((2, 3, 5)).astype(np.float32)
+    idx = rng.integers(0, 5, (2, 4)).astype(np.int32)
+    (ref,) = _interp_run(blob, params, idx)
+    (ours,) = _ours_run(blob, tmp_path, params, idx)
+    assert ours.shape == ref.shape
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_strided_slice_newaxis_and_ellipsis(tmp_path):
+    def f(x):
+        a = x[:, tf.newaxis, 1:, 0]      # new_axis + shrink
+        b = x[..., ::2]                  # ellipsis + stride
+        return a, b
+
+    blob = _convert_fn(f, [tf.TensorSpec([2, 3, 4], tf.float32)])
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    ref = _interp_run(blob, x)
+    ours = _ours_run(blob, tmp_path, x)
+    assert len(ours) == len(ref)
+    for o, r in zip(ours, ref):
+        assert o.shape == r.shape, (o.shape, r.shape)
+        np.testing.assert_allclose(o, r)
