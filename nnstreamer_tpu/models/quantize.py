@@ -43,13 +43,23 @@ def _quantize_leaf(w: Any) -> Any:
     scale = (absmax / 127.0).astype(np.float32)
     safe = np.where(scale == 0.0, 1.0, scale)
     q = np.clip(np.round(arr / safe), -127, 127).astype(np.int8)
-    return {_QTAG: q, "scale": scale}
+    # original dtype recorded so dequant can restore it: a graph whose
+    # activations are f32 (e.g. a tflite import) must get f32 weights
+    # back or conv dtypes mismatch at trace. Carried as a ZERO-SIZE array
+    # (a string leaf would break jit pytree flattening)
+    return {_QTAG: q, "scale": scale,
+            "orig": np.zeros((0,), arr.dtype)}
 
 
 def _dequantize_leaf(leaf: Any, dtype) -> Any:
     if isinstance(leaf, dict) and _QTAG in leaf:
-        return (leaf[_QTAG].astype(dtype) *
-                leaf["scale"].astype(dtype))
+        if dtype is None:
+            orig = leaf.get("orig")
+            dt = orig.dtype if orig is not None else jnp.bfloat16
+        else:
+            dt = dtype
+        return (leaf[_QTAG].astype(dt) *
+                leaf["scale"].astype(dt))
     return leaf
 
 
@@ -63,6 +73,7 @@ def quantize_params(params: Any) -> Any:
 
 
 def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
+    """dtype=None restores each leaf's recorded original dtype."""
     return jax.tree_util.tree_map(
         lambda leaf: _dequantize_leaf(leaf, dtype), params,
         is_leaf=_is_quant)
@@ -76,9 +87,14 @@ def params_nbytes(params: Any) -> int:
 
 
 def quantize_bundle(bundle: ModelBundle,
-                    compute_dtype=jnp.bfloat16) -> ModelBundle:
+                    compute_dtype=None) -> ModelBundle:
     """Serving bundle with int8-quantized weights; the dequant runs inside
-    the jitted program (fused into the consuming ops by XLA)."""
+    the jitted program (fused into the consuming ops by XLA).
+
+    ``compute_dtype=None`` (default) dequantizes each weight back to its
+    ORIGINAL dtype, so any graph serves unchanged (bf16 zoo bundles stay
+    bf16, f32 tflite imports stay f32); pass an explicit dtype to force
+    one."""
     if bundle.params is None:
         raise ValueError("quantize_bundle: bundle has no params "
                          "(in-process callable models cannot be quantized)")

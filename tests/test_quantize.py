@@ -124,3 +124,36 @@ def test_shared_spec_quantizes_once():
     f2.open(FilterProps(model=SPEC, custom="quant=w8"))
     assert f1._bundle is f2._bundle, \
         "filters over one memoized spec must share one quantized bundle"
+
+
+MODELS = "/root/reference/tests/test_models/models"
+DATA = "/root/reference/tests/test_models/data"
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.isdir(MODELS),
+    reason="reference test models not mounted")
+def test_w8_on_tflite_imported_bundle():
+    """quant=w8 composes with a tflite-imported (f32-activation) graph:
+    dequant restores the ORIGINAL weight dtype so conv dtypes agree."""
+    import os
+
+    from PIL import Image
+
+    from nnstreamer_tpu.core.buffer import TensorMemory
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    path = os.path.join(MODELS, "mobilenet_v2_1.0_224_quant.tflite")
+    img = np.array(
+        Image.open(os.path.join(DATA, "orange.png"))
+        .convert("RGB").resize((224, 224)), np.uint8)[None]
+    f1 = XLAFilter()
+    f1.open(FilterProps(model=path))
+    base = f1.invoke([TensorMemory(img)])[0].host()
+    f2 = XLAFilter()
+    f2.open(FilterProps(model=path, custom="quant=w8"))
+    w8 = f2.invoke([TensorMemory(img)])[0].host()
+    assert int(base.argmax()) == int(w8.argmax())  # same top-1
+    # double quantization (tflite grid + w8) stays within a few steps
+    assert int(np.abs(base.astype(int) - w8.astype(int)).max()) <= 12
