@@ -1,0 +1,81 @@
+"""Serve the reference's own model files — every family, verbatim strings.
+
+The point of this example: a user of the reference (NNStreamer) can point
+their existing pipeline descriptions at this framework and their model
+files load unmodified. Each block below is the reference's own SSAT
+pipeline string (paths aside) for one backend family:
+
+* ``.tflite``  — from-scratch flatbuffer importer lowered to XLA
+  (tests/nnstreamer_filter_tensorflow2_lite/runTest.sh:74)
+* ``.pb``      — frozen TensorFlow GraphDefs via framework=tensorflow
+  (tests/nnstreamer_filter_tensorflow/runTest.sh:78)
+* ``.pt``      — TorchScript via framework=pytorch, including the
+  torch-1.0-era legacy zip format modern torch rejects
+  (tests/nnstreamer_filter_pytorch/runTest.sh:72)
+
+Run:  python examples/serve_reference_models.py
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+MODELS = "/root/reference/tests/test_models/models"
+DATA = "/root/reference/tests/test_models/data"
+LABELS = "/root/reference/tests/test_models/labels/labels.txt"
+
+
+def main() -> int:
+    from nnstreamer_tpu.graph.parse import parse_pipeline
+
+    if not os.path.isdir(MODELS):
+        print("reference test models not mounted; nothing to demo")
+        return 0
+
+    workdir = tempfile.mkdtemp(prefix="nns_demo_")
+
+    # 1. tflite: mobilenet quant classifies orange.png
+    out = os.path.join(workdir, "tflite.out")
+    parse_pipeline(
+        f"filesrc location={DATA}/orange.png ! pngdec ! videoscale ! "
+        "imagefreeze ! videoconvert ! video/x-raw,format=RGB,framerate=0/1 ! "
+        "tensor_converter ! "
+        f"tensor_filter framework=tensorflow2-lite "
+        f"model={MODELS}/mobilenet_v2_1.0_224_quant.tflite ! "
+        f"filesink location={out}").run(timeout=300)
+    scores = np.frombuffer(open(out, "rb").read(), np.uint8)
+    labels = open(LABELS).read().splitlines()
+    print(f"tflite   mobilenet_v2_quant: {labels[int(scores.argmax())]!r}")
+
+    # 2. tensorflow: frozen GraphDef, named feeds/fetches
+    out = os.path.join(workdir, "tf.out")
+    parse_pipeline(
+        f"filesrc location={DATA}/9.raw ! application/octet-stream ! "
+        "tensor_converter input-dim=784:1 input-type=uint8 ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        f"tensor_filter framework=tensorflow model={MODELS}/mnist.pb "
+        "input=784:1 inputtype=float32 inputname=input "
+        "output=10:1 outputtype=float32 outputname=softmax ! "
+        f"filesink location={out}").run(timeout=300)
+    digit = int(np.frombuffer(open(out, "rb").read(), np.float32).argmax())
+    print(f"tensorflow mnist.pb: digit {digit}")
+
+    # 3. pytorch: the legacy torch-1.0 TorchScript zip
+    out = os.path.join(workdir, "torch.out")
+    parse_pipeline(
+        f"filesrc location={DATA}/9.png ! pngdec ! videoscale ! imagefreeze ! "
+        "videoconvert ! video/x-raw,format=GRAY8,framerate=0/1 ! "
+        "tensor_converter ! "
+        f"tensor_filter framework=pytorch model={MODELS}/pytorch_lenet5.pt "
+        "input=1:28:28:1 inputtype=uint8 output=10:1:1:1 outputtype=uint8 ! "
+        f"filesink location={out}").run(timeout=300)
+    digit = int(np.frombuffer(open(out, "rb").read(), np.uint8).argmax())
+    print(f"pytorch  pytorch_lenet5.pt (legacy format): digit {digit}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
