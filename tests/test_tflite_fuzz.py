@@ -1,0 +1,193 @@
+"""Seeded fuzz: random multi-op TFLite graphs, importer vs tf.lite.Interpreter.
+
+Each case builds a schema-valid chain of 2-6 random ops (conv / dwconv /
+pool / elementwise / activation / resize / reduce / softmax) with random
+shapes, runs BOTH the real interpreter and the XLA lowering on the same
+random input, and requires agreement to 1e-4. Deterministic seeds — a
+failure reproduces with its case id.
+
+This catches cross-op composition bugs the single-op fixtures cannot
+(shape threading, option defaults in context, dtype promotion).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+jax = pytest.importorskip("jax")
+
+from nnstreamer_tpu.models.tflite_import import load_tflite  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_tflite_ops import (  # noqa: E402
+    F32,
+    build_tflite,
+    conv_options,
+    dwconv_options,
+    pool_options,
+    reducer_options,
+    resize_bilinear_options,
+)
+from test_tflite_vs_interpreter import (  # noqa: E402 — canonical harness
+    _interp_run,
+    _softmax_opts,
+)
+
+CONV2D, DWCONV, AVGPOOL, MAXPOOL = 3, 4, 1, 17
+RESIZE_BILINEAR, MEAN, SOFTMAX = 23, 40, 25
+ADD, MUL, RELU, LOGISTIC, TANH, ABS_ = 0, 18, 19, 14, 28, 101
+
+
+def _add_mul_opts():
+    def build(b):
+        b.StartObject(1)            # AddOptions/MulOptions: activation
+        b.PrependInt8Slot(0, 0, 0)
+        return b.EndObject()
+
+    return build
+
+
+class _GraphBuilder:
+    """Accumulates tensors/operators while tracking the current tensor's
+    shape; each step appends one op reading the previous output."""
+
+    def __init__(self, rng, in_shape):
+        self.rng = rng
+        self.tensors = [{"shape": in_shape, "type": F32, "data": None}]
+        self.operators = []
+        self.shape = in_shape
+
+    def _out(self, shape):
+        self.tensors.append({"shape": shape, "type": F32, "data": None})
+        self.shape = shape
+        return len(self.tensors) - 1
+
+    def _const(self, arr):
+        self.tensors.append({"shape": arr.shape, "type": F32, "data": arr})
+        return len(self.tensors) - 1
+
+    def _const_i32(self, arr):
+        self.tensors.append({"shape": arr.shape, "type": 2, "data": arr})
+        return len(self.tensors) - 1
+
+    @property
+    def cur(self):
+        return len(self.tensors) - 1
+
+    def add_random_op(self):
+        n, h, w, c = self.shape
+        ops = ["elemwise", "act", "softmax"]
+        if h >= 4 and w >= 4:
+            ops += ["conv", "dwconv", "pool"]
+        if h <= 16 and w <= 16:
+            ops.append("resize")
+        if h > 1 or w > 1:
+            ops.append("reduce")
+        kind = ops[int(self.rng.integers(len(ops)))]
+        src = self.cur
+        if kind == "conv":
+            cout = int(self.rng.integers(1, 5))
+            k = int(self.rng.integers(1, 4))
+            stride = int(self.rng.integers(1, 3))
+            padding = int(self.rng.integers(0, 2))  # 0 SAME, 1 VALID
+            wgt = self.rng.standard_normal(
+                (cout, k, k, c)).astype(np.float32) * 0.5
+            bias = self.rng.standard_normal(cout).astype(np.float32) * 0.1
+            if padding == 0:
+                oh, ow = -(-h // stride), -(-w // stride)
+            else:
+                oh, ow = (h - k) // stride + 1, (w - k) // stride + 1
+            if oh < 1 or ow < 1:
+                return  # degenerate; skip this step
+            wi, bi = self._const(wgt), self._const(bias)
+            dst = self._out((n, oh, ow, cout))
+            self.operators.append(
+                {"code": CONV2D, "inputs": [src, wi, bi], "outputs": [dst],
+                 "options": conv_options(stride=stride, padding=padding,
+                                         activation=int(self.rng.integers(0, 2)))})
+        elif kind == "dwconv":
+            k = int(self.rng.integers(1, 4))
+            wgt = self.rng.standard_normal((1, k, k, c)).astype(np.float32) * 0.5
+            bias = np.zeros(c, np.float32)
+            oh, ow = h - k + 1, w - k + 1
+            if oh < 1 or ow < 1:
+                return
+            wi, bi = self._const(wgt), self._const(bias)
+            dst = self._out((n, oh, ow, c))
+            self.operators.append(
+                {"code": DWCONV, "inputs": [src, wi, bi], "outputs": [dst],
+                 "options": dwconv_options(stride=1, padding=1)})
+        elif kind == "pool":
+            code = AVGPOOL if self.rng.integers(2) else MAXPOOL
+            oh, ow = h // 2, w // 2
+            if oh < 1 or ow < 1:
+                return
+            dst = self._out((n, oh, ow, c))
+            self.operators.append(
+                {"code": code, "inputs": [src], "outputs": [dst],
+                 "options": pool_options(filt=2, stride=2, padding=1)})
+        elif kind == "resize":
+            oh, ow = h * 2, w * 2
+            si = self._const_i32(np.array([oh, ow], np.int32))
+            dst = self._out((n, oh, ow, c))
+            self.operators.append(
+                {"code": RESIZE_BILINEAR, "inputs": [src, si],
+                 "outputs": [dst],
+                 "options": resize_bilinear_options(
+                     half_pixel=bool(self.rng.integers(2)))})
+        elif kind == "elemwise":
+            code = ADD if self.rng.integers(2) else MUL
+            other = self._const(
+                self.rng.standard_normal((1, 1, 1, c)).astype(np.float32))
+            dst = self._out(self.shape)
+            self.operators.append(
+                {"code": code, "inputs": [src, other], "outputs": [dst],
+                 "options": (11 if code == ADD else 21, _add_mul_opts())})
+        elif kind == "softmax":
+            dst = self._out(self.shape)
+            self.operators.append(
+                {"code": SOFTMAX, "inputs": [src], "outputs": [dst],
+                 "options": _softmax_opts()})
+        elif kind == "reduce":
+            ax = self._const_i32(np.array([1, 2], np.int32))
+            dst = self._out((n, 1, 1, c))
+            self.operators.append(
+                {"code": MEAN, "inputs": [src, ax], "outputs": [dst],
+                 "options": reducer_options(keep_dims=True)})
+        elif kind == "act":
+            code = [RELU, LOGISTIC, TANH, ABS_][int(self.rng.integers(4))]
+            dst = self._out(self.shape)
+            self.operators.append(
+                {"code": code, "inputs": [src], "outputs": [dst],
+                 "options": None})
+
+    def finish(self):
+        return build_tflite(self.tensors, self.operators,
+                            inputs=[0], outputs=[self.cur])
+
+
+@pytest.mark.parametrize("case", range(24))
+def test_fuzz_chain_matches_interpreter(case, tmp_path):
+    rng = np.random.default_rng(1000 + case)
+    h = int(rng.integers(4, 12))
+    w = int(rng.integers(4, 12))
+    c = int(rng.integers(1, 4))
+    gb = _GraphBuilder(rng, (1, h, w, c))
+    for _ in range(int(rng.integers(2, 7))):
+        gb.add_random_op()
+    if not gb.operators:  # every step degenerate (rare)
+        pytest.skip("degenerate case")
+    blob = gb.finish()
+    x = rng.standard_normal((1, h, w, c)).astype(np.float32)
+    (ref,) = _interp_run(blob, x)
+    path = tmp_path / "fuzz.tflite"
+    path.write_bytes(blob)
+    ours = np.asarray(jax.jit(load_tflite(str(path)).fn())(x)[0])
+    assert ours.shape == ref.shape, \
+        f"case {case}: shape {ours.shape} vs {ref.shape}"
+    np.testing.assert_allclose(
+        ours, ref, rtol=1e-4, atol=1e-4,
+        err_msg=f"case {case}: ops={[o['code'] for o in gb.operators]}")
