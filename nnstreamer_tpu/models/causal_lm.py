@@ -27,6 +27,7 @@ logical 5-D layout internally.
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -127,7 +128,7 @@ def _lm_forward(params, tokens, n_heads):
 
 def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
                n_heads: int, max_len: int, mesh=None,
-               sp_axis: str = "sp"
+               sp_axis: str = "sp", flash: bool = None
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Process a whole prompt in ONE forward and emit the populated cache.
 
@@ -142,12 +143,19 @@ def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
     prompt length scales with the axis size (T must divide by it) while
     the emitted cache and subsequent decode are unchanged — long-context
     prefill across chips, streaming decode after.
+
+    ``flash=True`` (single-device) swaps the dense attention for the
+    blockwise pallas kernel — no (T, T) score matrix in HBM. Defaults to
+    the ``NNS_LM_FLASH=1`` env var; either way the choice resolves at
+    TRACE time and is baked into a jitted prefill's cached executable.
     """
     with jax.default_matmul_precision(_PRECISION):
-        return _lm_prefill(params, tokens, n_heads, max_len, mesh, sp_axis)
+        return _lm_prefill(params, tokens, n_heads, max_len, mesh, sp_axis,
+                           flash)
 
 
-def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp"):
+def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
+                flash=None):
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(
@@ -170,6 +178,16 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp"):
                 f"lm_prefill: prompt length {t} not divisible by the "
                 f"{sp_axis!r} axis size {mesh.shape[sp_axis]}")
         attn = sp_attention_fn("ring", mesh, sp_axis, causal=True)
+    elif flash if flash is not None \
+            else os.environ.get("NNS_LM_FLASH", "") == "1":
+        # single-device flash path: blockwise pallas kernel, no (t, t)
+        # score matrix in HBM (ops/pallas/flash_attention.py). NOTE: both
+        # the explicit flag and the env var resolve at TRACE time — a
+        # jitted prefill bakes the choice into the cached executable
+        from ..ops.pallas.flash_attention import flash_attention
+
+        attn = lambda qh, kh, vh: flash_attention(  # noqa: E731
+            qh, kh, vh, causal=True)
     else:
         # only the dense path needs the O(t²) mask; the sp path exists
         # precisely to avoid materializing it on one device

@@ -1,0 +1,149 @@
+"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+
+The transformer prefill/decode hot op. The dense path materialises the
+(L, L) score matrix in HBM; this kernel streams K/V blocks through VMEM
+with the online-softmax recurrence, so memory is O(Bq·Bk) per core and
+the matmuls stay on the MXU (jnp.dot with preferred_element_type=f32).
+
+Grid layout: ``(batch·heads, q_blocks, k_blocks)`` — the k dimension is
+an ACCUMULATION axis: scratch (o, m, l) lives in VMEM across the k steps
+(TPU grids execute sequentially over the last axis), initialised at
+``ki == 0`` and finalised into the output block at the last step.
+Causal masking is two-level: whole k-blocks strictly above the diagonal
+are skipped via ``pl.when``, the diagonal block applies the per-element
+mask.
+
+Off-TPU (tests, CPU mesh) the same kernel runs in interpret mode.
+
+Reference equivalent: the reference has no attention kernels (its models
+are CNNs served by vendor runtimes); this is TPU-first scope from
+SURVEY §7 (long-context machinery) — the single-device complement of
+parallel/ring.py's cross-chip ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .preprocess import _on_tpu
+
+_NEG_INF = -1e30  # mask value; finite so (m - m) stays NaN-free
+
+try:  # pallas is part of jax, but keep the module importable without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_q: int, block_k: int, n_kblocks: int, causal: bool,
+                  true_len: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # whole block strictly above the causal diagonal: contributes nothing
+    run = jnp.logical_or(not causal,
+                         ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                    # (block_q, d)
+        k = k_ref[0]                    # (block_k, d)
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = s / np.sqrt(q.shape[-1]).astype(np.float32)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < true_len  # padded keys must never win the softmax
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_ref[:]               # (block_q, 1)
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == n_kblocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Causal (or full) attention over ``(B, H, L, D)`` tensors.
+
+    Sequence length is padded up to a block multiple internally (padded
+    keys are masked out via the causal structure / an explicit length
+    mask); the head dim runs as-is — keep D a multiple of 128 on real
+    TPUs for MXU-aligned blocks (the zoo transformer uses 64·h lanes;
+    pad externally if a model needs it).
+    """
+    if pl is None:  # pragma: no cover
+        raise RuntimeError("pallas unavailable in this jax build")
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, h, L, d = q.shape
+    bq = min(block_q, L)
+    bk = min(block_k, L)
+    # pad to a COMMON multiple of both block sizes: rounding to only
+    # max(bq, bk) with floor-divided grid counts would silently drop
+    # trailing keys (or leave output rows unwritten) when bq != bk
+    cm = int(np.lcm(bq, bk))
+    Lp = -(-L // cm) * cm
+    if Lp != L:
+        pad = Lp - L
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_q = Lp // bq
+    n_k = Lp // bk
+    assert n_q * bq == Lp and n_k * bk == Lp
+    bh = b * h
+    qf = q.reshape(bh, Lp, d)
+    kf = k.reshape(bh, Lp, d)
+    vf = v.reshape(bh, Lp, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=bq, block_k=bk, n_kblocks=n_k, causal=causal,
+        true_len=L)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda s, i, j: (s, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda s, i, j: (s, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda s, i, j: (s, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda s, i, j: (s, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, Lp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, Lp, d)[:, :, :L]

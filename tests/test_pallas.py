@@ -39,3 +39,56 @@ class TestQuantize:
         ref = pp.quantize_affine_reference(jnp.asarray(x), 1 / 127.5, 128)
         np.testing.assert_array_equal(np.asarray(q), np.asarray(ref))
         assert np.asarray(q).dtype == np.uint8
+
+
+class TestFlashAttention:
+    """Blockwise causal attention kernel (ops/pallas/flash_attention.py)
+    vs the dense reference, interpret mode on the CPU mesh."""
+
+    @pytest.mark.parametrize("shape,causal", [
+        ((1, 2, 64, 32), True),
+        ((2, 1, 100, 16), True),     # non-block-multiple length
+        ((1, 2, 64, 32), False),
+        ((1, 1, 7, 8), True),        # shorter than one block
+        ((1, 2, 100, 16), False),    # padded + full attention
+    ])
+    def test_matches_dense_reference(self, shape, causal):
+        self._check(shape, causal, 32, 32)
+
+    @pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16), (16, 4), (4, 16)])
+    def test_unequal_blocks(self, bq, bk):
+        """block_q != block_k: padding must cover a COMMON multiple or
+        trailing keys drop / output rows go unwritten."""
+        self._check((1, 1, 40, 16), True, bq, bk)
+        self._check((1, 1, 40, 16), False, bq, bk)
+
+    def _check(self, shape, causal, bq, bk):
+        from nnstreamer_tpu.ops.pallas.flash_attention import flash_attention
+        from nnstreamer_tpu.parallel.ring import reference_attention
+
+        rng = np.random.default_rng(5)
+        q, k, v = [rng.standard_normal(shape).astype(np.float32)
+                   for _ in range(3)]
+        out = np.asarray(flash_attention(q, k, v, causal=causal,
+                                         block_q=bq, block_k=bk))
+        ref = np.asarray(reference_attention(q, k, v, causal=causal))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_lm_prefill_flash_equals_dense(self, monkeypatch):
+        """NNS_LM_FLASH=1 swaps the prefill attention for the pallas
+        kernel; logits and the emitted KV cache must match the dense
+        path."""
+        import jax
+
+        from nnstreamer_tpu.models.causal_lm import init_causal_lm, lm_prefill
+
+        params = init_causal_lm(jax.random.PRNGKey(0), vocab=64, d_model=32,
+                                n_heads=4, n_layers=2, max_len=64)
+        toks = np.asarray(
+            np.random.default_rng(2).integers(0, 64, (2, 48)), np.int32)
+        dense = lm_prefill(params, toks, n_heads=4, max_len=64)
+        monkeypatch.setenv("NNS_LM_FLASH", "1")
+        flash = lm_prefill(params, toks, n_heads=4, max_len=64)
+        for a, b in zip(dense, flash):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
