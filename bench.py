@@ -507,55 +507,81 @@ def _transformer_bench() -> dict:
         params = jax.tree_util.tree_map(
             lambda a: a.astype(jnp.bfloat16), params)
 
+        # both lanes share _lm_prefill (bf16 default precision, last-token
+        # unembed) so the ONLY difference between them is the attention
+        # path — dense masked softmax vs the blockwise pallas kernel
         def score(p, tokens):
-            # full prefill forward, last-token logits (f32 for the host)
-            out = causal_lm._lm_forward(p, tokens.astype(jnp.int32), H)
-            return out[:, -1].astype(jnp.float32)
+            logits, _, _, _ = causal_lm._lm_prefill(
+                p, tokens.astype(jnp.int32), H, T, flash=False)
+            return logits.astype(jnp.float32)
 
-        bundle = ModelBundle(
-            "lm_prefill_bench", score, params=params,
-            in_info=TensorsInfo.from_strings(f"{T}:{B}", "int32"),
-            out_info=TensorsInfo.from_strings(f"{V}:{B}", "float32"))
+        def score_flash(p, tokens):
+            logits, _, _, _ = causal_lm._lm_prefill(
+                p, tokens.astype(jnp.int32), H, T, flash=True)
+            return logits.astype(jnp.float32)
+
         n, warm = 24, 4
         rng = np.random.default_rng(0)
         toks = [rng.integers(0, V, (B, T)).astype(np.int32)
                 for _ in range(4)]
-        p = Pipeline("bench-lm")
-        caps = Caps.tensors(TensorsConfig(
-            TensorsInfo.from_strings(f"{T}:{B}", "int32")))
-        src = p.add_new("appsrc", caps=caps,
-                        data=(toks[i % 4] for i in range(n + warm)))
-        filt = p.add_new("tensor_filter", framework="xla-tpu",
-                         model=bundle)
-        sink = p.add_new("tensor_sink")
-        arrivals: list = []
-
-        def on_data(buf):
-            buf.memories[0].host()  # materialize: honest wall-clock
-            arrivals.append(time.monotonic())
-
-        sink.new_data = on_data
-        Pipeline.link(src, filt, sink)
-        p.run(timeout=600)
-        if len(arrivals) < warm + 8:
-            return {}
-        peak, med = _windowed_fps(arrivals, warm, 0, window=8)
-        if not np.isfinite(med):
-            return {}
         device = jax.devices()[0]
-        flops = probes.model_flops(bundle.fn(), toks[0])
-        row = {
-            "transformer_prefill_tokens_per_s": round(peak * B * T, 1),
-            "transformer_prefill_tokens_per_s_median":
-                round(med * B * T, 1),
-            "transformer_prefill_config":
-                f"d{D} L{L} h{H} V{V} batch{B} seq{T} bf16",
-        }
-        if flops:
-            row["transformer_gflops_per_prefill"] = round(flops / 1e9, 1)
-            row["transformer_prefill_mfu"] = round(
-                probes.mfu(flops, med, device) or 0.0, 6)
+
+        def run_lane(fn, tag, flops_override=None):
+            bundle = ModelBundle(
+                f"lm_prefill_bench{tag}", fn, params=params,
+                in_info=TensorsInfo.from_strings(f"{T}:{B}", "int32"),
+                out_info=TensorsInfo.from_strings(f"{V}:{B}", "float32"))
+            p = Pipeline(f"bench-lm{tag}")
+            caps = Caps.tensors(TensorsConfig(
+                TensorsInfo.from_strings(f"{T}:{B}", "int32")))
+            src = p.add_new("appsrc", caps=caps,
+                            data=(toks[i % 4] for i in range(n + warm)))
+            filt = p.add_new("tensor_filter", framework="xla-tpu",
+                             model=bundle)
+            sink = p.add_new("tensor_sink")
+            arrivals: list = []
+
+            def on_data(buf):
+                buf.memories[0].host()  # materialize: honest wall-clock
+                arrivals.append(time.monotonic())
+
+            sink.new_data = on_data
+            Pipeline.link(src, filt, sink)
+            p.run(timeout=600)
+            if len(arrivals) < warm + 8:
+                return {}
+            peak, med = _windowed_fps(arrivals, warm, 0, window=8)
+            if not np.isfinite(med):
+                return {}
+            # a pallas custom call reports 0 flops to cost_analysis: the
+            # flash lane reuses the dense lane's count (identical math)
+            flops = flops_override or probes.model_flops(
+                bundle.fn(), toks[0])
+            row = {
+                f"transformer_prefill{tag}_tokens_per_s":
+                    round(peak * B * T, 1),
+                f"transformer_prefill{tag}_tokens_per_s_median":
+                    round(med * B * T, 1),
+            }
+            if flops:
+                row[f"transformer_prefill{tag}_mfu"] = round(
+                    probes.mfu(flops, med, device) or 0.0, 6)
+                if not tag:
+                    row["transformer_gflops_per_prefill"] = \
+                        round(flops / 1e9, 1)
+            return row
+
+        row = run_lane(score, "")
+        row["transformer_prefill_config"] = \
+            f"d{D} L{L} h{H} V{V} batch{B} seq{T} bf16"
         _partial.update(row)
+        if os.environ.get("BENCH_LM_FLASH", "1") != "0":
+            _mark("transformer flash-prefill lane starting")
+            dense_flops = row.get("transformer_gflops_per_prefill")
+            row.update(run_lane(
+                score_flash, "_flash",
+                flops_override=dense_flops * 1e9 if dense_flops else None))
+            _partial.update(row)
         return row
     except Exception:
         traceback.print_exc(file=sys.stderr)

@@ -128,7 +128,7 @@ def _lm_forward(params, tokens, n_heads):
 
 def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
                n_heads: int, max_len: int, mesh=None,
-               sp_axis: str = "sp", flash: bool = None
+               sp_axis: str = "sp", flash: "bool | None" = None
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Process a whole prompt in ONE forward and emit the populated cache.
 
@@ -177,6 +177,10 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
             raise ValueError(
                 f"lm_prefill: prompt length {t} not divisible by the "
                 f"{sp_axis!r} axis size {mesh.shape[sp_axis]}")
+        if flash:
+            raise ValueError(
+                "lm_prefill: flash=True conflicts with mesh= (the sp path "
+                "uses ring attention; run flash single-device)")
         attn = sp_attention_fn("ring", mesh, sp_axis, causal=True)
     elif flash if flash is not None \
             else os.environ.get("NNS_LM_FLASH", "") == "1":

@@ -256,3 +256,19 @@ def test_decode_past_cache_capacity_poisons_logits(bundle):
     # one past capacity: poisoned, not silently wrong
     logits, k, v, pos = step(tok, k, v, pos)
     assert np.isnan(np.asarray(logits)).all()
+
+
+def test_prefill_flash_conflicts_with_mesh():
+    """flash=True with a mesh must error, not silently use ring attention."""
+    import jax
+
+    from nnstreamer_tpu.models.causal_lm import init_causal_lm, lm_prefill
+    from nnstreamer_tpu.parallel import make_mesh
+
+    params = init_causal_lm(jax.random.PRNGKey(0), vocab=32, d_model=16,
+                            n_heads=2, n_layers=1, max_len=16)
+    mesh = make_mesh({"sp": 8})
+    toks = np.zeros((1, 8), np.int32)
+    with pytest.raises(ValueError, match="flash"):
+        lm_prefill(params, toks, n_heads=2, max_len=16, mesh=mesh,
+                   flash=True)
