@@ -89,10 +89,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
+def _flash_kernel_residual(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                           acc_ref, m_ref, l_ref, *, block_q: int,
+                           block_k: int, n_kblocks: int, causal: bool,
+                           true_len: int):
+    """Same online-softmax recurrence, but emits the UNNORMALIZED
+    accumulator plus the per-row softmax residuals (rowmax m, normalizer
+    l) so partial attentions over disjoint key sets merge exactly (ring
+    attention steps) without a divide/re-multiply round trip."""
+    _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                  block_q=block_q, block_k=block_k, n_kblocks=n_kblocks,
+                  causal=causal, true_len=true_len)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == n_kblocks - 1)
+    def _emit_residuals():
+        o_ref[0] = acc_ref[:]  # overwrite the normalized finalize
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    return_residuals: bool = False):
     """Causal (or full) attention over ``(B, H, L, D)`` tensors.
 
     Sequence length is padded up to a block multiple internally (padded
@@ -126,10 +147,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kf = k.reshape(bh, Lp, d)
     vf = v.reshape(bh, Lp, d)
 
+    kfn = _flash_kernel_residual if return_residuals else _flash_kernel
     kernel = functools.partial(
-        _flash_kernel, block_q=bq, block_k=bk, n_kblocks=n_k, causal=causal,
+        kfn, block_q=bq, block_k=bk, n_kblocks=n_k, causal=causal,
         true_len=L)
-    out = pl.pallas_call(
+    o_spec = pl.BlockSpec((1, bq, d), lambda s, i, j: (s, i, 0))
+    r_spec = pl.BlockSpec((1, bq, 1), lambda s, i, j: (s, i, 0))
+    o_shape = jax.ShapeDtypeStruct(
+        (bh, Lp, d), jnp.float32 if return_residuals else q.dtype)
+    r_shape = jax.ShapeDtypeStruct((bh, Lp, 1), jnp.float32)
+    result = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -137,8 +164,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, bk, d), lambda s, i, j: (s, j, 0)),
             pl.BlockSpec((1, bk, d), lambda s, i, j: (s, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda s, i, j: (s, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, Lp, d), q.dtype),
+        out_specs=[o_spec, r_spec, r_spec] if return_residuals else o_spec,
+        out_shape=[o_shape, r_shape, r_shape] if return_residuals
+        else o_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -146,4 +174,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, Lp, d)[:, :, :L]
+    if return_residuals:
+        acc, m_out, l_out = result
+        return (acc.reshape(b, h, Lp, d)[:, :, :L],
+                m_out.reshape(b, h, Lp)[:, :, :L],
+                l_out.reshape(b, h, Lp)[:, :, :L])
+    return result.reshape(b, h, Lp, d)[:, :, :L]

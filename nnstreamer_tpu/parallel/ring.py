@@ -93,6 +93,84 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _ring_flash_local(q, k, v, axis_name: str, causal: bool,
+                      block_q: int, block_k: int):
+    """Per-shard ring body where each shard-pair partial runs through the
+    blockwise pallas kernel (ops/pallas/flash_attention.py) instead of
+    materializing the (l_local, l_local) score matrix — the long-context
+    composition: ring over chips × flash within a chip. Partials merge
+    exactly via their softmax residuals (m, l)."""
+    from ..ops.pallas.flash_attention import flash_attention
+
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    m0 = jnp.full(q.shape[:-1], -1e30, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)  # o·l (unnormalized)
+    qf = q.astype(jnp.float32)
+
+    def partial_attn(is_causal):
+        def run(kk, vv):
+            # residual mode returns the UNNORMALIZED accumulator
+            return flash_attention(qf, kk, vv, causal=is_causal,
+                                   block_q=block_q, block_k=block_k,
+                                   return_residuals=True)
+
+        return run
+
+    def partial_skip(kk, vv):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.full(q.shape[:-1], -1e30, jnp.float32),
+                jnp.zeros(q.shape[:-1], jnp.float32))
+
+    def step(i, carry):
+        m, l, acc, kk, vv = carry
+        src = (my_idx + i) % axis_size
+        kkf = kk.astype(jnp.float32)
+        vvf = vv.astype(jnp.float32)
+        if causal:
+            # src < my: every key precedes every query (full);
+            # src == my: aligned causal; src > my: fully masked
+            branch = jnp.where(src < my_idx, 0,
+                               jnp.where(src == my_idx, 1, 2))
+            acc_i, m_i, l_i = jax.lax.switch(
+                branch,
+                [partial_attn(False), partial_attn(True), partial_skip],
+                kkf, vvf)
+        else:
+            acc_i, m_i, l_i = partial_attn(False)(kkf, vvf)
+        # exact merge of two attention partials over disjoint key sets
+        m_new = jnp.maximum(m, m_i)
+        a_old = jnp.exp(m - m_new)
+        a_new = jnp.exp(m_i - m_new)
+        l = l * a_old + l_i * a_new
+        acc = acc * a_old[..., None] + acc_i * a_new[..., None]
+        perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        return m_new, l, acc, kk, vv
+
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (m0, l0, acc0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         mesh: Mesh, axis_name: str = "sp",
+                         causal: bool = False, block_q: int = 128,
+                         block_k: int = 128) -> jax.Array:
+    """Ring attention with the pallas flash kernel per shard pair: memory
+    per device is O(block_q·block_k) instead of O((L/N)²) — the intended
+    configuration for genuinely long contexts."""
+    spec = P(None, None, axis_name, None)
+    fn = _shard_map(
+        functools.partial(_ring_flash_local, axis_name=axis_name,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "sp", causal: bool = False) -> jax.Array:
     """Exact attention over sequence shards on ``mesh[axis_name]``.
@@ -163,6 +241,9 @@ def sp_attention_fn(mode: str, mesh: Mesh, axis_name: str = "sp",
     if mode == "ring":
         return lambda q, k, v: ring_attention(q, k, v, mesh, axis_name,
                                               causal=causal)
+    if mode == "ring-flash":
+        return lambda q, k, v: ring_flash_attention(
+            q, k, v, mesh, axis_name, causal=causal)
     if mode in ("a2a", "ulysses"):
         if causal:
             raise ValueError("a2a/ulysses attention has no causal mode")

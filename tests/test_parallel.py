@@ -122,6 +122,42 @@ class TestSequenceParallel:
             a2a_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                           mesh, "sp")
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_flash_attention_exact(self, causal):
+        """Ring over devices × pallas flash within a device (the
+        long-context composition): exact vs the dense oracle, partials
+        merged by softmax residuals."""
+        from nnstreamer_tpu.parallel.ring import (
+            reference_attention,
+            ring_flash_attention,
+        )
+
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(seed=3)
+        out = ring_flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh, "sp",
+            causal=causal, block_q=8, block_k=8)
+        ref = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_ring_flash_via_dispatch(self):
+        """sp_attention_fn('ring-flash') routes to the composed kernel."""
+        from nnstreamer_tpu.parallel.ring import (
+            reference_attention,
+            sp_attention_fn,
+        )
+
+        mesh = make_mesh({"sp": 8})
+        q, k, v = self._qkv(seed=4)
+        fn = sp_attention_fn("ring-flash", mesh, "sp", causal=True)
+        out = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        ref = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_ring_under_jit(self):
         import jax
         from nnstreamer_tpu.parallel.ring import ring_attention
