@@ -181,7 +181,10 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
             raise ValueError(
                 "lm_prefill: flash=True conflicts with mesh= (the sp path "
                 "uses ring attention; run flash single-device)")
-        attn = sp_attention_fn("ring", mesh, sp_axis, causal=True)
+        # NNS_LM_SP_MODE=ring-flash composes the pallas kernel inside the
+        # ring steps (long-context memory profile); default plain ring
+        attn = sp_attention_fn(os.environ.get("NNS_LM_SP_MODE", "ring"),
+                               mesh, sp_axis, causal=True)
     elif flash if flash is not None \
             else os.environ.get("NNS_LM_FLASH", "") == "1":
         # single-device flash path: blockwise pallas kernel, no (t, t)

@@ -44,7 +44,7 @@ except Exception:  # pragma: no cover
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                   block_q: int, block_k: int, n_kblocks: int, causal: bool,
-                  true_len: int):
+                  true_len: int, normalize: bool = True):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -85,8 +85,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(ki == n_kblocks - 1)
     def _finalize():
-        denom = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if normalize:
+            denom = jnp.maximum(l_ref[:], 1e-30)
+            o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        else:  # residual mode: the UNNORMALIZED accumulator is the output
+            o_ref[0] = acc_ref[:].astype(o_ref.dtype)
 
 
 def _flash_kernel_residual(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
@@ -99,12 +102,11 @@ def _flash_kernel_residual(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
     attention steps) without a divide/re-multiply round trip."""
     _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                   block_q=block_q, block_k=block_k, n_kblocks=n_kblocks,
-                  causal=causal, true_len=true_len)
+                  causal=causal, true_len=true_len, normalize=False)
     ki = pl.program_id(2)
 
     @pl.when(ki == n_kblocks - 1)
     def _emit_residuals():
-        o_ref[0] = acc_ref[:]  # overwrite the normalized finalize
         m_out_ref[0] = m_ref[:]
         l_out_ref[0] = l_ref[:]
 

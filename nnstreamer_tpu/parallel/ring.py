@@ -100,20 +100,22 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool,
     materializing the (l_local, l_local) score matrix — the long-context
     composition: ring over chips × flash within a chip. Partials merge
     exactly via their softmax residuals (m, l)."""
-    from ..ops.pallas.flash_attention import flash_attention
+    from ..ops.pallas.flash_attention import _NEG_INF, flash_attention
 
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
 
-    m0 = jnp.full(q.shape[:-1], -1e30, jnp.float32)
+    # sentinel MUST match the kernel's so skip-branch partials underflow
+    # to zero contribution in the merge
+    m0 = jnp.full(q.shape[:-1], _NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1], jnp.float32)
     acc0 = jnp.zeros(q.shape, jnp.float32)  # o·l (unnormalized)
-    qf = q.astype(jnp.float32)
 
     def partial_attn(is_causal):
         def run(kk, vv):
-            # residual mode returns the UNNORMALIZED accumulator
-            return flash_attention(qf, kk, vv, causal=is_causal,
+            # residual mode returns the UNNORMALIZED accumulator; inputs
+            # keep their dtype (the kernel accumulates in f32 internally)
+            return flash_attention(q, kk, vv, causal=is_causal,
                                    block_q=block_q, block_k=block_k,
                                    return_residuals=True)
 
@@ -121,14 +123,12 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool,
 
     def partial_skip(kk, vv):
         return (jnp.zeros(q.shape, jnp.float32),
-                jnp.full(q.shape[:-1], -1e30, jnp.float32),
+                jnp.full(q.shape[:-1], _NEG_INF, jnp.float32),
                 jnp.zeros(q.shape[:-1], jnp.float32))
 
     def step(i, carry):
         m, l, acc, kk, vv = carry
         src = (my_idx + i) % axis_size
-        kkf = kk.astype(jnp.float32)
-        vvf = vv.astype(jnp.float32)
         if causal:
             # src < my: every key precedes every query (full);
             # src == my: aligned causal; src > my: fully masked
@@ -137,9 +137,9 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool,
             acc_i, m_i, l_i = jax.lax.switch(
                 branch,
                 [partial_attn(False), partial_attn(True), partial_skip],
-                kkf, vvf)
+                kk, vv)
         else:
-            acc_i, m_i, l_i = partial_attn(False)(kkf, vvf)
+            acc_i, m_i, l_i = partial_attn(False)(kk, vv)
         # exact merge of two attention partials over disjoint key sets
         m_new = jnp.maximum(m, m_i)
         a_old = jnp.exp(m - m_new)

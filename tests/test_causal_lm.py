@@ -272,3 +272,28 @@ def test_prefill_flash_conflicts_with_mesh():
     with pytest.raises(ValueError, match="flash"):
         lm_prefill(params, toks, n_heads=2, max_len=16, mesh=mesh,
                    flash=True)
+
+
+def test_prefill_sp_ring_flash_mode(monkeypatch):
+    """NNS_LM_SP_MODE=ring-flash: the sp prefill runs the pallas kernel
+    inside the ring and still matches the dense forward."""
+    import jax
+
+    from nnstreamer_tpu.models.causal_lm import (
+        init_causal_lm,
+        lm_forward,
+        lm_prefill,
+    )
+    from nnstreamer_tpu.parallel import make_mesh
+
+    params = init_causal_lm(jax.random.PRNGKey(0), vocab=32, d_model=16,
+                            n_heads=2, n_layers=2, max_len=32)
+    mesh = make_mesh({"sp": 8})
+    toks = np.asarray(
+        np.random.default_rng(7).integers(0, 32, (1, 32)), np.int32)
+    monkeypatch.setenv("NNS_LM_SP_MODE", "ring-flash")
+    logits, _, _, _ = lm_prefill(params, toks, n_heads=2, max_len=32,
+                                 mesh=mesh)
+    want = lm_forward(params, toks, n_heads=2)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
