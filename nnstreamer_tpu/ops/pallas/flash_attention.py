@@ -44,7 +44,7 @@ except Exception:  # pragma: no cover
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                   block_q: int, block_k: int, n_kblocks: int, causal: bool,
-                  true_len: int, normalize: bool = True):
+                  true_len: int, sm_scale: float, normalize: bool = True):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -64,7 +64,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0]                    # (block_k, d)
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        s = s / np.sqrt(q.shape[-1]).astype(np.float32)
+        s = s * np.float32(sm_scale)
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = cols < true_len  # padded keys must never win the softmax
@@ -95,14 +95,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 def _flash_kernel_residual(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
                            acc_ref, m_ref, l_ref, *, block_q: int,
                            block_k: int, n_kblocks: int, causal: bool,
-                           true_len: int):
+                           true_len: int, sm_scale: float):
     """Same online-softmax recurrence, but emits the UNNORMALIZED
     accumulator plus the per-row softmax residuals (rowmax m, normalizer
     l) so partial attentions over disjoint key sets merge exactly (ring
     attention steps) without a divide/re-multiply round trip."""
     _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                   block_q=block_q, block_k=block_k, n_kblocks=n_kblocks,
-                  causal=causal, true_len=true_len, normalize=False)
+                  causal=causal, true_len=true_len, sm_scale=sm_scale,
+                  normalize=False)
     ki = pl.program_id(2)
 
     @pl.when(ki == n_kblocks - 1)
@@ -115,20 +116,37 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None,
-                    return_residuals: bool = False):
+                    return_residuals: bool = False,
+                    _force_pad_d: bool = False):
     """Causal (or full) attention over ``(B, H, L, D)`` tensors.
 
     Sequence length is padded up to a block multiple internally (padded
-    keys are masked out via the causal structure / an explicit length
-    mask); the head dim runs as-is — keep D a multiple of 128 on real
-    TPUs for MXU-aligned blocks (the zoo transformer uses 64·h lanes;
-    pad externally if a model needs it).
+    keys are masked via an explicit length mask), and on real TPUs a
+    head dim that is not a multiple of the 128-wide lanes is zero-padded
+    internally too (score-neutral; padded v columns sliced off, softmax
+    scale from the true head dim) — callers never pad anything.
+
+    Precision model: scores and the output accumulate in f32; the
+    softmax weights are rounded to v's dtype before the PV matmul (the
+    standard flash configuration). With bf16 inputs this differs from a
+    full-f32 dense computation by ~1e-2 relative.
     """
     if pl is None:  # pragma: no cover
         raise RuntimeError("pallas unavailable in this jax build")
     if interpret is None:
         interpret = not _on_tpu()
-    b, h, L, d = q.shape
+    b, h, L, d_orig = q.shape
+    sm_scale = 1.0 / float(np.sqrt(d_orig))  # from the TRUE head dim
+    d = d_orig
+    if (not interpret or _force_pad_d) and d % 128:
+        # real-TPU lanes are 128-wide: zero-pad the head dim (zero q/k
+        # columns add nothing to the scores; zero v columns are sliced
+        # off at return). sm_scale above already uses the true d.
+        dpad = -(-d // 128) * 128 - d
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dpad)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
+        d = q.shape[-1]
     bq = min(block_q, L)
     bk = min(block_k, L)
     # pad to a COMMON multiple of both block sizes: rounding to only
@@ -152,7 +170,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kfn = _flash_kernel_residual if return_residuals else _flash_kernel
     kernel = functools.partial(
         kfn, block_q=bq, block_k=bk, n_kblocks=n_k, causal=causal,
-        true_len=L)
+        true_len=L, sm_scale=sm_scale)
     o_spec = pl.BlockSpec((1, bq, d), lambda s, i, j: (s, i, 0))
     r_spec = pl.BlockSpec((1, bq, 1), lambda s, i, j: (s, i, 0))
     o_shape = jax.ShapeDtypeStruct(
@@ -178,7 +196,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     )(qf, kf, vf)
     if return_residuals:
         acc, m_out, l_out = result
-        return (acc.reshape(b, h, Lp, d)[:, :, :L],
+        return (acc.reshape(b, h, Lp, d)[:, :, :L, :d_orig],
                 m_out.reshape(b, h, Lp)[:, :, :L],
                 l_out.reshape(b, h, Lp)[:, :, :L])
-    return result.reshape(b, h, Lp, d)[:, :, :L]
+    return result.reshape(b, h, Lp, d)[:, :, :L, :d_orig]

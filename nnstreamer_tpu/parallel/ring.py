@@ -114,7 +114,11 @@ def _ring_flash_local(q, k, v, axis_name: str, causal: bool,
     def partial_attn(is_causal):
         def run(kk, vv):
             # residual mode returns the UNNORMALIZED accumulator; inputs
-            # keep their dtype (the kernel accumulates in f32 internally)
+            # keep their dtype. NOTE the flash precision model: softmax
+            # weights round to v.dtype before the PV matmul (f32
+            # accumulate), so with bf16 inputs this path tracks the
+            # flash kernel's numerics, not plain ring_attention's
+            # full-f32 ones (~1e-2 relative with bf16)
             return flash_attention(q, kk, vv, causal=is_causal,
                                    block_q=block_q, block_k=block_k,
                                    return_residuals=True)

@@ -92,3 +92,39 @@ class TestFlashAttention:
         for a, b in zip(dense, flash):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
+
+
+def test_flash_head_dim_padding_numerics():
+    """The real-TPU head-dim pad to 128 lanes must not change results
+    (exercised in interpret mode via the test hook; sm_scale uses the
+    TRUE head dim, not the padded one)."""
+    from nnstreamer_tpu.ops.pallas.flash_attention import flash_attention
+    from nnstreamer_tpu.parallel.ring import reference_attention
+
+    rng = np.random.default_rng(11)
+    q, k, v = [rng.standard_normal((1, 2, 48, 64)).astype(np.float32)
+               for _ in range(3)]
+    out = np.asarray(flash_attention(q, k, v, causal=True, block_q=16,
+                                     block_k=16, _force_pad_d=True))
+    assert out.shape == q.shape  # padded d columns sliced off
+    ref = np.asarray(reference_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flash_bf16_inputs_tolerance():
+    """bf16 q/k/v: the flash precision model (bf16 softmax weights, f32
+    accumulate) tracks the f32 oracle to ~1e-2 relative."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.ops.pallas.flash_attention import flash_attention
+    from nnstreamer_tpu.parallel.ring import reference_attention
+
+    rng = np.random.default_rng(13)
+    qf, kf, vf = [rng.standard_normal((1, 2, 64, 32)).astype(np.float32)
+                  for _ in range(3)]
+    out = np.asarray(flash_attention(
+        jnp.asarray(qf, jnp.bfloat16), jnp.asarray(kf, jnp.bfloat16),
+        jnp.asarray(vf, jnp.bfloat16), causal=True,
+        block_q=16, block_k=16)).astype(np.float32)
+    ref = np.asarray(reference_attention(qf, kf, vf, causal=True))
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=3e-2)
