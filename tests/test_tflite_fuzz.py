@@ -24,6 +24,8 @@ from nnstreamer_tpu.models.tflite_import import load_tflite  # noqa: E402
 sys.path.insert(0, os.path.dirname(__file__))
 from test_tflite_ops import (  # noqa: E402
     F32,
+    INT32,
+    UINT8,
     build_tflite,
     conv_options,
     dwconv_options,
@@ -191,3 +193,109 @@ def test_fuzz_chain_matches_interpreter(case, tmp_path):
     np.testing.assert_allclose(
         ours, ref, rtol=1e-4, atol=1e-4,
         err_msg=f"case {case}: ops={[o['code'] for o in gb.operators]}")
+
+
+# --------------------------------------------------------------------------- #
+# Quantized chains: dequantized-float strategy vs true-int kernels
+# --------------------------------------------------------------------------- #
+
+
+def _build_quant_chain(rng, n_ops):
+    """conv→conv/pool chains where every tensor is uint8-quantized with
+    random (scale, zero_point) grids — the drift-accumulating case."""
+    h = w = 8
+    c = int(rng.integers(1, 3))
+    tensors = [{"shape": (1, h, w, c), "type": UINT8, "data": None,
+                "quant": (0.05, 128)}]
+    operators = []
+    shape = (1, h, w, c)
+
+    def out_t(shape, scale, zp):
+        tensors.append({"shape": shape, "type": UINT8, "data": None,
+                        "quant": (float(scale), int(zp))})
+        return len(tensors) - 1
+
+    for _ in range(n_ops):
+        n, h, w, c = shape
+        src = len(tensors) - 1
+        src_quant = tensors[src]["quant"]
+        if h >= 4 and rng.integers(2):
+            cout = int(rng.integers(1, 4))
+            k = 3
+            w_scale = 0.01
+            wq = rng.integers(0, 255, (cout, k, k, c), dtype=np.uint8)
+            bias = rng.integers(-50, 50, (cout,), dtype=np.int32)
+            tensors.append({"shape": wq.shape, "type": UINT8, "data": wq,
+                            "quant": (w_scale, 127)})
+            wi = len(tensors) - 1
+            # TFLite invariant: bias rides the ACCUMULATOR grid
+            # (input_scale * weight_scale); a mismatched declared scale
+            # would compare two different mathematical functions
+            tensors.append({"shape": bias.shape, "type": INT32,
+                            "data": bias,
+                            "quant": (src_quant[0] * w_scale, 0)})
+            bi = len(tensors) - 1
+            oh, ow = h - k + 1, w - k + 1
+            # output grid sized to the accumulation's rough spread so the
+            # comparison exercises real code points (a collapsed or
+            # rail-saturated grid would make the drift bound vacuous)
+            # typical (not worst-case) accumulation spread: dequantized
+            # activations ~U(+-128*s_in), weights ~U(+-1.27) summed over
+            # k*k*c taps -> std ~ s_in*37 * 0.73 * sqrt(taps)
+            acc_std = src_quant[0] * 37 * 0.73 * np.sqrt(k * k * c) * 127 * w_scale
+            out_scale = float(acc_std * 3 / 128.0 * rng.uniform(0.5, 1.5))
+            dst = out_t((n, oh, ow, cout), out_scale,
+                        rng.integers(100, 156))
+            operators.append(
+                {"code": 3, "inputs": [src, wi, bi], "outputs": [dst],
+                 "options": conv_options(stride=1, padding=1)})
+            shape = (n, oh, ow, cout)
+        else:
+            if h < 2:
+                break
+            oh, ow = h // 2, w // 2
+            # TFLite invariant: quantized pooling requires input and
+            # output grids to MATCH (the int kernel averages raw codes
+            # and ignores a differing declared output grid)
+            dst = out_t((n, oh, ow, c), src_quant[0], src_quant[1])
+            operators.append(
+                {"code": 1, "inputs": [src], "outputs": [dst],
+                 "options": pool_options(filt=2, stride=2, padding=1)})
+            shape = (n, oh, ow, c)
+    if not operators:
+        return None, None
+    return build_tflite(tensors, operators, inputs=[0],
+                        outputs=[len(tensors) - 1]), shape
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_fuzz_quant_chain_bounded_drift(case, tmp_path):
+    """Random quantized chains: dequantized-float must stay within a few
+    quant steps of the true-int interpreter at every grid."""
+    from nnstreamer_tpu.models.tflite_import import parse_tflite
+
+    # bounded deterministic re-rolls: random grids occasionally collapse
+    # the signal; the drift bound only means something on a live grid
+    rng = np.random.default_rng(7000 + case)
+    for _attempt in range(6):
+        blob, _ = _build_quant_chain(rng, int(rng.integers(2, 5)))
+        if blob is None:
+            continue
+        path = tmp_path / "q.tflite"
+        path.write_bytes(blob)
+        m = parse_tflite(str(path))
+        in_shape = m.tensors[m.inputs[0]].shape
+        x = rng.integers(0, 255, in_shape, dtype=np.uint8)
+        (ref,) = _interp_run(blob, x)
+        if len(np.unique(ref)) >= 8:
+            break
+    else:
+        pytest.skip("no non-degenerate grid found")
+    ours = np.asarray(jax.jit(load_tflite(str(path)).fn())(x)[0])
+    assert ours.dtype == ref.dtype == np.uint8
+    # non-degeneracy guard: the bound means nothing on a collapsed grid
+    assert len(np.unique(ref)) >= 8, \
+        f"case {case}: degenerate reference ({len(np.unique(ref))} codes)"
+    diff = np.abs(ours.astype(np.int32) - ref.astype(np.int32))
+    assert int(diff.max()) <= 3, \
+        f"case {case}: quant drift {int(diff.max())} steps"
