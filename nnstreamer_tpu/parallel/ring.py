@@ -189,9 +189,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     return fn(q, k, v)
 
 
-def _a2a_attention_local(q, k, v, axis_name: str):
+def _a2a_attention_local(q, k, v, axis_name: str, flash: bool = False):
     """Per-shard body: seq-sharded in, swap to head-sharded, attend, swap
-    back. Requires heads % axis_size == 0."""
+    back. Requires heads % axis_size == 0. With ``flash`` the per-head
+    full-sequence attention runs through the blockwise pallas kernel
+    instead of materializing the (L, L) score matrix."""
     # [b, H, l_local, d] → all_to_all over heads: [b, H/N, L, d]
     qh = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)
@@ -199,11 +201,18 @@ def _a2a_attention_local(q, k, v, axis_name: str):
                             tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)
-    d = qh.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
-                   kh.astype(jnp.float32)) / jnp.sqrt(d)
-    p = jax.nn.softmax(s, axis=-1)
-    oh = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    if flash:
+        from ..ops.pallas.flash_attention import flash_attention
+
+        oh = flash_attention(qh.astype(jnp.float32),
+                             kh.astype(jnp.float32),
+                             vh.astype(jnp.float32), causal=False)
+    else:
+        d = qh.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) / jnp.sqrt(d)
+        p = jax.nn.softmax(s, axis=-1)
+        oh = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
     # back: heads gathered, sequence re-sharded
     o = jax.lax.all_to_all(oh.astype(q.dtype), axis_name, split_axis=2,
                            concat_axis=1, tiled=True)
@@ -211,14 +220,16 @@ def _a2a_attention_local(q, k, v, axis_name: str):
 
 
 def a2a_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                  axis_name: str = "sp") -> jax.Array:
-    """Ulysses-style sequence-parallel attention (all_to_all re-sharding)."""
+                  axis_name: str = "sp", flash: bool = False) -> jax.Array:
+    """Ulysses-style sequence-parallel attention (all_to_all re-sharding);
+    ``flash=True`` runs each head subset through the pallas kernel."""
     n = mesh.shape[axis_name]
     if q.shape[1] % n != 0:
         raise ValueError(f"heads {q.shape[1]} not divisible by "
                          f"{axis_name} axis size {n}")
     spec = P(None, None, axis_name, None)
-    fn = _shard_map(functools.partial(_a2a_attention_local, axis_name=axis_name),
+    fn = _shard_map(functools.partial(_a2a_attention_local,
+                                      axis_name=axis_name, flash=flash),
                     mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
@@ -248,8 +259,10 @@ def sp_attention_fn(mode: str, mesh: Mesh, axis_name: str = "sp",
     if mode == "ring-flash":
         return lambda q, k, v: ring_flash_attention(
             q, k, v, mesh, axis_name, causal=causal)
-    if mode in ("a2a", "ulysses"):
+    if mode in ("a2a", "ulysses", "a2a-flash", "ulysses-flash"):
         if causal:
             raise ValueError("a2a/ulysses attention has no causal mode")
-        return lambda q, k, v: a2a_attention(q, k, v, mesh, axis_name)
+        use_flash = mode.endswith("-flash")
+        return lambda q, k, v: a2a_attention(q, k, v, mesh, axis_name,
+                                             flash=use_flash)
     raise ValueError(f"unknown sp mode {mode!r}")

@@ -507,3 +507,23 @@ def test_composite_query_failover_retry():
                        f"&num_classes=8&batch={batch}&dtype=float32")
     served = sharded_bundle(bundle, mesh)
     composite_query_retry_check(bundle, served, batch, size)
+
+
+def test_a2a_flash_attention_exact():
+    """Ulysses × flash: per-head-subset attention through the pallas
+    kernel after the all_to_all re-shard — exact vs the dense oracle."""
+    from nnstreamer_tpu.parallel.ring import (
+        a2a_attention,
+        reference_attention,
+    )
+
+    mesh = make_mesh({"sp": 8})
+    rng = np.random.default_rng(6)
+    q, k, v = [rng.standard_normal((1, 8, 64, 16)).astype(np.float32)
+               for _ in range(3)]
+    out = a2a_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        mesh, "sp", flash=True)
+    ref = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -240,9 +240,9 @@ def _build_quant_chain(rng, n_ops):
             # comparison exercises real code points (a collapsed or
             # rail-saturated grid would make the drift bound vacuous)
             # typical (not worst-case) accumulation spread: dequantized
-            # activations ~U(+-128*s_in), weights ~U(+-1.27) summed over
-            # k*k*c taps -> std ~ s_in*37 * 0.73 * sqrt(taps)
-            acc_std = src_quant[0] * 37 * 0.73 * np.sqrt(k * k * c) * 127 * w_scale
+            # activations ~U(±128·s_in) (std ≈ 74·s_in), weights
+            # ~U(±127·w_scale) (std ≈ 73·w_scale), summed over k·k·c taps
+            acc_std = (src_quant[0] * 74) * (w_scale * 73) * np.sqrt(k * k * c)
             out_scale = float(acc_std * 3 / 128.0 * rng.uniform(0.5, 1.5))
             dst = out_t((n, oh, ow, cout), out_scale,
                         rng.integers(100, 156))
@@ -293,9 +293,7 @@ def test_fuzz_quant_chain_bounded_drift(case, tmp_path):
         pytest.skip("no non-degenerate grid found")
     ours = np.asarray(jax.jit(load_tflite(str(path)).fn())(x)[0])
     assert ours.dtype == ref.dtype == np.uint8
-    # non-degeneracy guard: the bound means nothing on a collapsed grid
-    assert len(np.unique(ref)) >= 8, \
-        f"case {case}: degenerate reference ({len(np.unique(ref))} codes)"
+    # (non-degeneracy was established by the re-roll loop's break condition)
     diff = np.abs(ours.astype(np.int32) - ref.astype(np.int32))
     assert int(diff.max()) <= 3, \
         f"case {case}: quant drift {int(diff.max())} steps"
