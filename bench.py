@@ -232,9 +232,11 @@ def _extra_benches(tmpdir: str) -> dict:
     return out
 
 
-def _config_split(spec: str, size: int):
+def _config_split(spec: str, size: int, batch: int = 1, k: int = 16,
+                  device=None):
     """Per-config phase split (VERDICT r3 #3: says in one run whether a
-    config is invoke-, transfer-, or host-bound)."""
+    config is invoke-, transfer-, or host-bound). ``batch>1`` probes the
+    batched operating points of the sweep (VERDICT r4 #6)."""
     import jax
 
     from nnstreamer_tpu.models.zoo import get_model
@@ -242,9 +244,9 @@ def _config_split(spec: str, size: int):
 
     try:
         bundle = get_model(spec)
-        example = np.zeros((1, size, size, 3), np.uint8)
+        example = np.zeros((batch, size, size, 3), np.uint8)
         return probes.phase_split(bundle.fn(), [example],
-                                  device=jax.devices()[0], k=16)
+                                  device=device or jax.devices()[0], k=k)
     except Exception:
         import traceback
 
@@ -450,6 +452,14 @@ def _batch_sweep(labels_path: str, flops, device) -> dict:
             if flops:
                 point["mfu"] = round(
                     probes.mfu(flops, med, device) or 0.0, 6)
+            if batch in (8, 128):
+                # split only at the curve's ends: each probe is a second
+                # full-model compile, and the watchdog budget is fixed
+                _mark(f"batch sweep split probe b={batch}")
+                split = _config_split(_with_batch(MODEL, batch), SIZE,
+                                      batch=batch, k=8, device=device)
+                if split:
+                    point["split"] = split
             sweep[str(batch)] = point
             if batch == 8:
                 out["batch8_fps"] = point["fps"]
@@ -582,6 +592,106 @@ def _transformer_bench() -> dict:
                 score_flash, "_flash",
                 flops_override=dense_flops * 1e9 if dense_flops else None))
             _partial.update(row)
+        if os.environ.get("BENCH_LM_DECODE", "1") != "0":
+            _mark("transformer decode lane starting")
+            row.update(_decode_lane(params, H, T, device))
+            _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
+def _decode_lane(params, n_heads, max_len, device) -> dict:
+    """Autoregressive decode tokens/sec: greedy generation through the
+    streaming KV cache. The whole generate loop (prefill a 128-token
+    prompt, then ``lax.scan`` 64 decode steps feeding argmax back) runs
+    as ONE compiled program, so the measurement is device decode
+    throughput, not per-token tunnel RTT; wall-clock is taken at host
+    materialization of the generated tokens. This is the serving-side
+    complement to the prefill lanes — memory-bandwidth-bound (one cache
+    read per step) where prefill is MXU-bound."""
+    import traceback
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import causal_lm
+
+        B, P, G = 8, 128, 64
+        rng = np.random.default_rng(2)
+        V = params["embed"].shape[0]
+        prompt = jnp.asarray(
+            rng.integers(0, V, (B, P)).astype(np.int32))
+
+        @jax.jit
+        def generate(p, prompt):
+            # flash pinned off so the prefill share measures the same
+            # program as prefill_only regardless of ambient NNS_LM_FLASH
+            logits, kc, vc, pos = causal_lm._lm_prefill(
+                p, prompt, n_heads, max_len, flash=False)
+            first = jnp.argmax(
+                logits, -1)[:, None].astype(jnp.int32)
+
+            def step(carry, _):
+                tok, kc, vc, pos = carry
+                lg, kc, vc, pos = causal_lm._lm_decode_step(
+                    p, tok, kc, vc, pos, n_heads)
+                nxt = jnp.argmax(lg, -1)[:, None].astype(jnp.int32)
+                return (nxt, kc, vc, pos), nxt[:, 0]
+
+            (_, _, _, _), toks = jax.lax.scan(
+                step, (first, kc, vc, pos), None, length=G)
+            return toks.T  # (B, G)
+
+        @jax.jit
+        def prefill_only(p, prompt):
+            logits, _, _, _ = causal_lm._lm_prefill(
+                p, prompt, n_heads, max_len, flash=False)
+            return jnp.argmax(logits, -1)
+
+        def _timed(fn):
+            np.asarray(fn(params, prompt))  # compile + warm
+            ts = []
+            for _ in range(6):
+                t0 = time.monotonic()
+                np.asarray(fn(params, prompt))
+                ts.append(time.monotonic() - t0)
+            return float(np.median(ts))
+
+        with jax.default_matmul_precision("bfloat16"):
+            med = _timed(generate)
+            med_prefill = _timed(prefill_only)
+        # steady-state decode rate: subtract the separately measured
+        # prefill share so the row isn't dominated by the prompt matmul
+        decode_s = med - med_prefill
+        if decode_s <= 0:
+            # 6-sample medians through the tunnel can cross; a clamped
+            # subtraction would publish a garbage tokens/sec row
+            _mark("decode lane dropped: prefill share >= total "
+                  f"({med_prefill:.4f}s >= {med:.4f}s)")
+            return {}
+        row = {
+            "transformer_decode_tokens_per_s":
+                round(B * G / decode_s, 1),
+            "transformer_decode_config":
+                f"batch{B} prompt{P} gen{G} greedy kv-cache bf16",
+            "transformer_decode_wall_s_median": round(med, 4),
+            "transformer_decode_prefill_share_s": round(med_prefill, 4),
+        }
+        from nnstreamer_tpu.utils import probes
+
+        gen_flops = probes.model_flops(generate, params, prompt)
+        pre_flops = probes.model_flops(prefill_only, params, prompt)
+        if gen_flops and pre_flops and gen_flops > pre_flops:
+            # decode-only MFU: expected low (bandwidth-bound), reported
+            # so the prefill-vs-decode contrast is on the record
+            mfu_val = probes.mfu(
+                (gen_flops - pre_flops) / (B * G),
+                B * G / decode_s, device)
+            if mfu_val:
+                row["transformer_decode_mfu"] = round(mfu_val, 6)
         return row
     except Exception:
         traceback.print_exc(file=sys.stderr)
