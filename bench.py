@@ -471,7 +471,6 @@ def _batch_sweep(labels_path: str, flops, device) -> dict:
                 out["batch8_fps_median"] = point["fps_median"]
                 if "mfu" in point:
                     out["batch8_mfu"] = point["mfu"]
-            _partial.update({"batch_sweep": sweep})
         except Exception:
             traceback.print_exc(file=sys.stderr)
     try:

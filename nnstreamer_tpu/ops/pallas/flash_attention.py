@@ -112,9 +112,30 @@ def _flash_kernel_residual(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
         l_out_ref[0] = l_ref[:]
 
 
+def _pick_block(lp: int, want: int) -> int:
+    """Largest exact divisor of ``lp`` (a multiple of 128) that is
+    <= ``want``, preferring lane-aligned multiples of 128. Keeping
+    blocks as divisors of the padded length means no lcm re-padding —
+    a 640-long sequence gets 128-wide blocks, not a blow-up to
+    lcm(512, 640). Requests below 128 (tests, ring steps over short
+    shards) get the largest plain divisor <= the request, so explicit
+    small blocks still exercise multi-block tiling."""
+    m = lp // 128
+    best = 0
+    for d in range(1, m + 1):
+        if m % d == 0 and d * 128 <= want:
+            best = d * 128
+    if best:
+        return best
+    for d in range(1, min(want, lp) + 1):
+        if lp % d == 0:
+            best = d
+    return best or 1
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: Optional[bool] = None,
                     return_residuals: bool = False,
                     _force_pad_d: bool = False):
@@ -147,13 +168,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         d = q.shape[-1]
-    bq = min(block_q, L)
-    bk = min(block_k, L)
-    # pad to a COMMON multiple of both block sizes: rounding to only
-    # max(bq, bk) with floor-divided grid counts would silently drop
-    # trailing keys (or leave output rows unwritten) when bq != bk
-    cm = int(np.lcm(bq, bk))
-    Lp = -(-L // cm) * cm
+    # pad the sequence up to a lane-tile multiple, then pick blocks as
+    # exact divisors of the padded length (<= the requested sizes): both
+    # blocks always tile Lp exactly, so no second lcm padding pass
+    Lp = -(-L // 128) * 128
+    bq = _pick_block(Lp, min(block_q, Lp))
+    bk = _pick_block(Lp, min(block_k, Lp))
     if Lp != L:
         pad = Lp - L
         q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
@@ -192,6 +212,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
+        # batch·heads and q-blocks are independent; only the k axis is an
+        # accumulation (scratch carries across it) and must stay ordered
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)
     if return_residuals:

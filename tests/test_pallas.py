@@ -57,8 +57,16 @@ class TestFlashAttention:
 
     @pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16), (16, 4), (4, 16)])
     def test_unequal_blocks(self, bq, bk):
-        """block_q != block_k: padding must cover a COMMON multiple or
-        trailing keys drop / output rows go unwritten."""
+        """block_q != block_k with L=40 padded to 128: sub-128 requests
+        resolve to divisors of the padded length, so the multi-block
+        tiling (and the whole-k-block causal skip) really executes —
+        trailing keys must not drop / output rows must not go
+        unwritten."""
+        from nnstreamer_tpu.ops.pallas.flash_attention import _pick_block
+
+        # guard the guard: both picks must stay sub-128 multi-block
+        assert _pick_block(128, bq) > 1 and _pick_block(128, bq) <= bq
+        assert _pick_block(128, bk) > 1 and _pick_block(128, bk) <= bk
         self._check((1, 1, 40, 16), True, bq, bk)
         self._check((1, 1, 40, 16), False, bq, bk)
 
