@@ -246,26 +246,40 @@ def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
         params["pos_embed"][p][None, None, :]
     live = (jnp.arange(max_len) <= p)[None, None, None, :]
 
-    def block(h, layer):
-        wqkv, wo, w1, w2, ln1, ln2, kc_l, vc_l = layer
+    def block(carry, layer):
+        # the cache rides the CARRY, not the scan ys: a ys-threaded cache
+        # makes XLA rewrite all L·B·H·max_len slots every token, while a
+        # carried buffer takes in-place dynamic_update_slice writes of
+        # just the new (B, H, 1, hd) slot per layer — the difference is
+        # ~half the per-step HBM traffic at serving shapes
+        h, kc, vc = carry
+        wqkv, wo, w1, w2, ln1, ln2, li = layer
         a = _ln(h, ln1)
         q, k, v = jnp.split(a @ wqkv, 3, axis=-1)          # (B, 1, D)
         q = _split_heads(q, n_heads)                       # (B, H, 1, hd)
-        k = _split_heads(k, n_heads)
-        v = _split_heads(v, n_heads)
-        kc_l = jax.lax.dynamic_update_slice(kc_l, k, (0, 0, p, 0))
-        vc_l = jax.lax.dynamic_update_slice(vc_l, v, (0, 0, p, 0))
+        k = _split_heads(k, n_heads)[None].astype(kc.dtype)
+        v = _split_heads(v, n_heads)[None].astype(vc.dtype)
+        kc = jax.lax.dynamic_update_slice(kc, k, (li, 0, 0, p, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (li, 0, 0, p, 0))
+        kc_l = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+        vc_l = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kc_l) / math.sqrt(hd)
         s = jnp.where(live, s, -1e30)
         o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vc_l)
         o = o.transpose(0, 2, 1, 3).reshape(h.shape)
         h = h + o @ wo
         m = _ln(h, ln2)
-        return h + jax.nn.gelu(m @ w1) @ w2, (kc_l, vc_l)
+        return (h + jax.nn.gelu(m @ w1) @ w2, kc, vc), None
 
-    x, (kc, vc) = jax.lax.scan(
-        block, x, (params["wqkv"], params["wo"], params["w1"],
-                   params["w2"], params["ln1"], params["ln2"], kc, vc))
+    (x, kc, vc), _ = jax.lax.scan(
+        block, (x, kc, vc),
+        (params["wqkv"], params["wo"], params["w1"],
+         params["w2"], params["ln1"], params["ln2"],
+         jnp.arange(n_layers, dtype=jnp.int32)),
+        # full unroll: decode-step ops are tiny (B rows), so the win is
+        # XLA prefetching the next layer's weights while this one runs;
+        # n_layers is small and static, compile cost is bounded
+        unroll=True)
     logits = (_ln(x, params["lnf"]) @ params["embed"].T)[:, 0]
     # cache overflow (pos past capacity) surfaces as NaN logits, not as a
     # silent overwrite of the last cache slot — see lm_decode_step doc
