@@ -81,6 +81,10 @@ CLASSES = int(os.environ.get("BENCH_CLASSES", "1001"))
 #: to cover RTT / per-frame-host-time; 64 spans the tunnel's ~70-130 ms RTT
 #: at ~1-2 ms/frame of host work with negligible memory cost.
 DECODE_DEPTH = int(os.environ.get("BENCH_DEPTH", "64"))
+#: (V, D, H, L) of the bench LM — shared by the main prefill lane and the
+#: long-context lane; the longctx MFU extrapolation anchors on the main
+#: lane's FLOPs count, which is only valid when the model dims match
+_LM_DIMS = (8192, 1024, 16, 8)
 
 
 def _enable_compile_cache() -> None:
@@ -513,7 +517,7 @@ def _transformer_bench() -> dict:
         from nnstreamer_tpu.models.zoo import ModelBundle
         from nnstreamer_tpu.utils import probes
 
-        V, D, H, L = 8192, 1024, 16, 8
+        V, D, H, L = _LM_DIMS
         B, T = int(os.environ.get("BENCH_LM_BATCH", "8")), \
             int(os.environ.get("BENCH_LM_SEQ", "1024"))
         params = causal_lm.init_causal_lm(
@@ -606,6 +610,19 @@ def _transformer_bench() -> dict:
         return {}
 
 
+def _timed(fn, *args, reps: int = 6) -> float:
+    """Compile+warm once, then median wall-clock of ``reps`` host-
+    materialized invokes (shared by the direct-jit lanes: decode,
+    long-context)."""
+    np.asarray(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        np.asarray(fn(*args))
+        ts.append(time.monotonic() - t0)
+    return float(np.median(ts))
+
+
 def _decode_lane(params, n_heads, max_len, device) -> dict:
     """Autoregressive decode tokens/sec: greedy generation through the
     streaming KV cache. The whole generate loop (prefill a 128-token
@@ -664,18 +681,9 @@ def _decode_lane(params, n_heads, max_len, device) -> dict:
                 p, prompt, n_heads, max_len, flash=False)
             return jnp.argmax(logits, -1)
 
-        def _timed(fn):
-            np.asarray(fn(params, prompt))  # compile + warm
-            ts = []
-            for _ in range(6):
-                t0 = time.monotonic()
-                np.asarray(fn(params, prompt))
-                ts.append(time.monotonic() - t0)
-            return float(np.median(ts))
-
         with jax.default_matmul_precision("bfloat16"):
-            med = _timed(generate)
-            med_prefill = _timed(prefill_only)
+            med = _timed(generate, params, prompt)
+            med_prefill = _timed(prefill_only, params, prompt)
         # steady-state decode rate: subtract the separately measured
         # prefill share so the row isn't dominated by the prompt matmul
         decode_s = med - med_prefill
@@ -705,6 +713,119 @@ def _decode_lane(params, n_heads, max_len, device) -> dict:
                 B * G / decode_s, device)
             if mfu_val:
                 row["transformer_decode_mfu"] = round(mfu_val, 6)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
+def _longctx_lane(device) -> dict:
+    """Long-context prefill throughput: dense vs pallas-flash attention at
+    T=4096 (B=2), plus the T=8192 (B=1) point where the dense score
+    matrix cannot compile on this chip (FLASH_TUNE_r05.json: 8.6 GB
+    fails at compile) so flash is the only runnable path. All points
+    process 8192 tokens per step so rows are comparable to the main
+    prefill lane. Direct-jit wall-clock like the decode lane; the D2H
+    payload is the B last-token argmax ints, so the ~65 ms tunnel RTT
+    floor is common to every row."""
+    import traceback
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.utils import probes
+
+        V, D, H, L = _LM_DIMS
+        points = [(4096, 2, (False, True)), (8192, 1, (True,))]
+        if device.platform == "cpu" and \
+                os.environ.get("BENCH_LM_LONGCTX_FULL", "0") != "1":
+            # dense T=4096 attention on host CPU takes minutes per step;
+            # keep a tiny shape so validation runs still cover the lane
+            points = [(256, 2, (False, True))]
+        if os.environ.get("BENCH_LM_FLASH", "1") == "0":
+            # same kill switch as the main prefill flash lane: a pallas
+            # kernel that hangs the runtime can't be caught by try/except
+            points = [(t, b, tuple(m for m in modes if not m))
+                      for t, b, modes in points]
+            points = [(t, b, m) for t, b, m in points if m]
+
+        tokens_per_step = sorted({t * b for t, b, _ in points})
+        row: dict = {
+            "transformer_longctx_config":
+                f"d{D} L{L} h{H} V{V} bf16; "
+                f"{'/'.join(str(n) for n in tokens_per_step)} tokens/step",
+        }
+        rng = np.random.default_rng(3)
+        dense_flops: dict = {}
+        for T, B, flash_modes in points:
+            params = causal_lm.init_causal_lm(
+                jax.random.PRNGKey(0), V, D, H, L, T)
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16), params)
+            toks = jnp.asarray(
+                rng.integers(0, V, (B, T)).astype(np.int32))
+            for flash in flash_modes:
+                tag = "flash" if flash else "dense"
+                _mark(f"longctx lane T={T} {tag} starting")
+                try:
+                    @jax.jit
+                    def score(p, tokens, _flash=flash, _T=T):
+                        logits, _, _, _ = causal_lm._lm_prefill(
+                            p, tokens, H, _T, flash=_flash)
+                        return jnp.argmax(logits, -1).astype(jnp.int32)
+
+                    med = _timed(score, params, toks)
+                    key = f"transformer_longctx_t{T}_{tag}"
+                    row[f"{key}_tokens_per_s"] = round(B * T / med, 1)
+                    if not flash:
+                        # second compile inside model_flops is a
+                        # persistent-compile-cache hit (armed in main)
+                        mf = probes.model_flops(score, params, toks)
+                        if mf:
+                            dense_flops[T] = mf
+                    # a pallas custom call reports 0 flops: flash reuses
+                    # the same-shape dense count (identical math)
+                    flops = dense_flops.get(T)
+                    if flops:
+                        mfu_val = probes.mfu(flops, 1.0 / med, device)
+                        if mfu_val:
+                            row[f"{key}_mfu"] = round(mfu_val, 6)
+                except Exception:
+                    # a failed point (OOM/compile) must not drop the
+                    # points already measured — record and continue
+                    traceback.print_exc(file=sys.stderr)
+                    row[f"transformer_longctx_t{T}_{tag}_error"] = \
+                        "point failed (see stderr)"
+                _partial.update(row)
+        main_gf = _partial.get("transformer_gflops_per_prefill")
+        if main_gf and os.environ.get("BENCH_LM_SEQ", "1024") == "1024" \
+                and os.environ.get("BENCH_LM_BATCH", "8") == "8":
+            # the main lane's dense (T=1024, B=8, 8192 tokens/step) point
+            # anchors the extrapolation when only one longctx dense point
+            # compiled — valid only at the default shapes, where B*T
+            # matches the longctx points (attention flops linear in T)
+            dense_flops.setdefault(1024, main_gf * 1e9)
+        # dense never runs at T=8192, so the in-loop mfu for that point
+        # cannot have been set; extrapolation here is the only path
+        if len(dense_flops) >= 2 and (8192, 1) in [
+                (t, b) for t, b, _ in points]:
+            (t1, f1), (t2, f2) = sorted(dense_flops.items())[-2:]
+            flops = f2 + (f2 - f1) * (8192 - t2) / (t2 - t1)
+            med_key = "transformer_longctx_t8192_flash_tokens_per_s"
+            if row.get(med_key):
+                mfu_val = probes.mfu(flops, row[med_key] / 8192.0, device)
+                if mfu_val:
+                    row["transformer_longctx_t8192_flash_mfu"] = round(
+                        mfu_val, 6)
+                    row["transformer_longctx_t8192_flash_mfu_extrapolated"] \
+                        = True
+        if device.platform != "cpu":
+            row["transformer_longctx_t8192_dense"] = (
+                "skipped (expected OOM at compile on this chip class: "
+                "8.6GB score matrix, FLASH_TUNE_r05.json)")
+        _partial.update(row)
         return row
     except Exception:
         traceback.print_exc(file=sys.stderr)
@@ -1054,6 +1175,9 @@ def main() -> None:
             result.update(_adaptive_bench(labels_path))
             _mark("transformer prefill bench starting")
             result.update(_transformer_bench())
+            if os.environ.get("BENCH_LM_LONGCTX", "1") != "0":
+                _mark("long-context prefill lane starting")
+                result.update(_longctx_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if flops and result.get("adaptive_batch16_fps_median"):
