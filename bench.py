@@ -832,6 +832,84 @@ def _longctx_lane(device) -> dict:
         return {}
 
 
+def _serving_lane(device) -> dict:
+    """Continuous-batching LM serving (serving/lm_engine.py) vs the
+    static-batch baseline: the same mixed workload — varied prompt
+    lengths and generation budgets — through the same engine twice,
+    continuous admission vs gang (all-slots-free) admission. The row
+    pair quantifies what iteration-level scheduling buys on this chip;
+    results are greedy-exact in both modes (tests/test_lm_serving.py),
+    so the delta is pure scheduling."""
+    import traceback
+
+    try:
+        import jax
+
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.serving import LMEngine
+
+        V, D, H, L = _LM_DIMS
+        max_len, slots, chunk = 1024, 8, 16
+        n_reqs, plens, gens = 24, (64, 192, 384, 512), (32, 64, 96, 128)
+        if device.platform == "cpu" and \
+                os.environ.get("BENCH_LM_SERVING_FULL", "0") != "1":
+            # full-size decode on host CPU is minutes; tiny validation shape
+            V, D, H, L = 512, 64, 4, 2
+            max_len, slots, chunk = 128, 4, 8
+            n_reqs, plens, gens = 6, (8, 24), (8, 16)
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, max_len)
+
+        rng = np.random.default_rng(5)
+        reqs = [(rng.integers(0, V, plens[i % len(plens)])
+                 .astype(np.int32), gens[i % len(gens)])
+                for i in range(n_reqs)]
+
+        def run_mode(gang: bool):
+            eng = LMEngine(params, H, max_len, n_slots=slots,
+                           chunk=chunk, gang=gang)
+            for p, g in reqs:
+                eng.submit(p, max_new=g)
+            t0 = time.monotonic()
+            res = eng.run()
+            wall = time.monotonic() - t0
+            toks = sum(len(v) for v in res.values())
+            return toks / wall, eng.stats
+
+        _mark("serving lane warmup (compiles) starting")
+        run_mode(False)  # compile prefill buckets + chunk sizes once
+        _mark("serving lane continuous starting")
+        cont_tps, cont_stats = run_mode(False)
+        _mark("serving lane static (gang) starting")
+        gang_tps, gang_stats = run_mode(True)
+        row = {
+            "lm_serving_config":
+                f"d{D} L{L} V{V} slots{slots} chunk{chunk} "
+                f"reqs{n_reqs} prompts{min(plens)}-{max(plens)} "
+                f"gen{min(gens)}-{max(gens)} greedy",
+            "lm_serving_continuous_tokens_per_s": round(cont_tps, 1),
+            "lm_serving_static_tokens_per_s": round(gang_tps, 1),
+            "lm_serving_speedup": round(cont_tps / gang_tps, 3),
+            "lm_serving_continuous_decode_steps":
+                cont_stats["decode_steps"],
+            "lm_serving_static_decode_steps": gang_stats["decode_steps"],
+            # fraction of total slot capacity (slots x decode steps) that
+            # produced no kept token — the utilization gap the scheduler
+            # is fighting (engine invariant: capacity = kept + wasted)
+            "lm_serving_continuous_waste_frac": round(
+                cont_stats["wasted_slot_steps"]
+                / max(1, slots * cont_stats["decode_steps"]), 3),
+            "lm_serving_static_waste_frac": round(
+                gang_stats["wasted_slot_steps"]
+                / max(1, slots * gang_stats["decode_steps"]), 3),
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -1178,6 +1256,9 @@ def main() -> None:
             if os.environ.get("BENCH_LM_LONGCTX", "1") != "0":
                 _mark("long-context prefill lane starting")
                 result.update(_longctx_lane(device))
+            if os.environ.get("BENCH_LM_SERVING", "1") != "0":
+                _mark("continuous-batching serving lane starting")
+                result.update(_serving_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if flops and result.get("adaptive_batch16_fps_median"):

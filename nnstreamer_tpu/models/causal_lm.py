@@ -155,11 +155,16 @@ def lm_prefill(params: Dict[str, jax.Array], tokens: jax.Array,
 
 
 def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
-                flash=None):
+                flash=None, true_len=None):
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(
             f"lm_prefill: prompt length {t} exceeds max_len={max_len}")
+    if true_len is not None and (mesh is not None or flash):
+        raise ValueError(
+            "lm_prefill: true_len= (padded-prompt masking) is a "
+            "dense-attention feature; the ring/flash paths apply "
+            "causality internally and cannot see it")
     n_layers = params["wqkv"].shape[0]
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads
@@ -185,8 +190,12 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
         # ring steps (long-context memory profile); default plain ring
         attn = sp_attention_fn(os.environ.get("NNS_LM_SP_MODE", "ring"),
                                mesh, sp_axis, causal=True)
-    elif flash if flash is not None \
-            else os.environ.get("NNS_LM_FLASH", "") == "1":
+    elif true_len is None and (
+            flash if flash is not None
+            else os.environ.get("NNS_LM_FLASH", "") == "1"):
+        # (true_len forces the dense branch even under NNS_LM_FLASH=1:
+        # the kernel applies causality internally and cannot column-mask
+        # a padded prompt — explicit flash=True raised above)
         # single-device flash path: blockwise pallas kernel, no (t, t)
         # score matrix in HBM (ops/pallas/flash_attention.py). NOTE: both
         # the explicit flag and the env var resolve at TRACE time — a
@@ -199,16 +208,26 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
         # only the dense path needs the O(t²) mask; the sp path exists
         # precisely to avoid materializing it on one device
         mask = jnp.tril(jnp.ones((t, t), bool))
+        if true_len is not None:
+            # right-padded prompt: padded columns can never be attended
+            tl = jnp.asarray(true_len).reshape(()).astype(jnp.int32)
+            mask = mask & (jnp.arange(t) < tl)[None, :]
 
     def block(h, layer):
         h, kh, vh = _block_body(h, layer, mask, n_heads, attn)
         return h, (jnp.pad(kh, pad), jnp.pad(vh, pad))
 
     x, (kc, vc) = jax.lax.scan(block, x, _layer_stack(params))
-    logits = (_ln(x[:, -1:], params["lnf"]) @ params["embed"].T)[:, 0]
+    if true_len is None:
+        last = x[:, -1:]
+        pos = jnp.full((1,), t, jnp.int32)
+    else:
+        last = jax.lax.dynamic_index_in_dim(x, tl - 1, axis=1,
+                                            keepdims=True)
+        pos = tl.reshape(1)
+    logits = (_ln(last, params["lnf"]) @ params["embed"].T)[:, 0]
     flat = (n_layers * b * n_heads, max_len, hd)
-    return (logits, kc.reshape(flat), vc.reshape(flat),
-            jnp.full((1,), t, jnp.int32))
+    return logits, kc.reshape(flat), vc.reshape(flat), pos
 
 
 def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
@@ -287,6 +306,56 @@ def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
     flat = (n_layers * b * n_heads, max_len, hd)
     return (logits, kc.reshape(flat), vc.reshape(flat),
             (p + 1).reshape(1).astype(jnp.int32))
+
+
+def lm_prefill_masked(params: Dict[str, jax.Array], tokens: jax.Array,
+                      true_len: jax.Array, n_heads: int, max_len: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Prefill a right-PADDED prompt exactly: ``tokens`` is (1, Tb) with
+    the real prompt in the first ``true_len`` positions (traced scalar).
+
+    Serving engines bucket prompt lengths (pad Tb up to a few fixed
+    sizes) so admission costs one compile per BUCKET, not per distinct
+    prompt length. Exactness relies on two masks: attention columns are
+    limited to ``col < true_len`` (padded rows can't leak in), and the
+    returned last-token logits come from row ``true_len - 1``. K/V
+    written at positions >= true_len ARE garbage, but a decode step at
+    position p attends only ``col <= p`` after overwriting slot p, so a
+    garbage slot is always overwritten before it becomes visible
+    (`serving/lm_engine.py` relies on this).
+
+    Returns (logits (1, vocab), kcache, vcache, pos=true_len) in the
+    same flat transport layout as ``lm_prefill`` — it IS ``_lm_prefill``
+    (one shared body) with the extra column mask and last-row selection.
+    """
+    with jax.default_matmul_precision(_PRECISION):
+        return _lm_prefill(params, tokens, n_heads, max_len,
+                           true_len=true_len)
+
+
+def lm_decode_step_slots(params: Dict[str, jax.Array], tokens: jax.Array,
+                         kcaches: jax.Array, vcaches: jax.Array,
+                         poss: jax.Array, n_heads: int
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array]:
+    """One decode step for S INDEPENDENT streams at per-slot positions.
+
+    The continuous-batching primitive: ``jax.vmap`` of the single-stream
+    ``lm_decode_step`` over a leading slot axis, so each slot carries its
+    own cache, write position, and liveness mask while the matmuls batch
+    onto the MXU. Per-slot cache writes lower to one batched scatter.
+    Exactness with the single-stream path is by construction (same
+    program under vmap; tests/test_lm_serving.py pins it).
+
+    tokens: (S, 1, 1) int32; kcaches/vcaches: (S, layers·heads, max_len,
+    head_dim); poss: (S, 1) int32. Returns (logits (S, 1, vocab),
+    kcaches', vcaches', poss+1). Slots past capacity NaN-poison their own
+    row only.
+    """
+    with jax.default_matmul_precision(_PRECISION):
+        step = lambda tok, kc, vc, pos: _lm_decode_step(  # noqa: E731
+            params, tok, kc, vc, pos, n_heads)
+        return jax.vmap(step)(tokens, kcaches, vcaches, poss)
 
 
 def empty_cache(n_layers: int, batch: int, n_heads: int, max_len: int,

@@ -1,0 +1,12 @@
+"""Serving-side engines built on the model families.
+
+New capability beyond the reference (whose serving story is per-buffer
+pipeline invoke, `/root/reference/gst/nnstreamer/tensor_filter/` — no
+notion of multiplexed autoregressive streams): `LMEngine` provides
+continuous batching for causal-LM generation — many generation streams
+multiplexed into one compiled batched decode step.
+"""
+
+from .lm_engine import LMEngine, next_pow2_bucket
+
+__all__ = ["LMEngine", "next_pow2_bucket"]

@@ -1,0 +1,283 @@
+"""Continuous batching for causal-LM generation.
+
+The TPU-native answer to LM serving throughput: S fixed cache slots, one
+compiled batched decode step (``lm_decode_step_slots`` — vmap of the
+single-stream step), and a host-side iteration-level scheduler that
+admits queued prompts into free slots the moment they open. Decode work
+never waits for a whole batch to finish (the static-batch failure mode):
+a stream that completes frees its slot at the next iteration boundary
+and the next prompt prefills into it while the other slots keep
+decoding.
+
+XLA-shaped design decisions:
+- **Static shapes everywhere.** The slot axis S, cache capacity
+  ``max_len``, and chunk sizes are compile-time constants; per-slot
+  write positions and liveness are traced VALUES (masks/scatters), so
+  the whole serving loop reuses a handful of cached executables.
+- **Bucketed prefill.** Prompts are right-padded to a power-of-two
+  bucket and prefilled with ``lm_prefill_masked`` — one compile per
+  bucket, exact by masking (padded K/V slots are provably overwritten
+  before any step can attend to them).
+- **Chunked decode.** Between scheduler interventions the engine runs
+  ``chunk`` decode steps as ONE jitted ``lax.scan`` (greedy argmax fed
+  back on-device), so host round-trips per generated token are 1/chunk.
+  A stream finishing mid-chunk wastes at most chunk-1 slot-steps (its
+  discarded tokens are garbage only to itself — slot isolation is by
+  vmap construction). ``chunk=1`` gives lowest admission latency;
+  larger chunks amortize dispatch (through a high-RTT link they are the
+  difference between RTT-bound and compute-bound serving).
+
+Greedy-exactness contract: every stream's output matches isolated
+single-stream generation token-for-token regardless of what shares the
+batch, when it was admitted, or the chunk size (tests/test_lm_serving.py).
+
+The reference has no analog (its `/root/reference/gst/nnstreamer/
+tensor_filter/` serves stateless per-buffer invokes); this composes with
+the pipeline via the query layer: a serversrc feeding prompts into an
+engine-backed worker, generated sequences flowing back per request.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import causal_lm
+
+
+def next_pow2_bucket(n: int, lo: int = 16) -> int:
+    """Smallest power of two >= n (floored at ``lo``): the default
+    prompt-length bucketing — compile count is log2(max_len) worst case."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+#: the jitted kernels live at module level (static args, not closures) so
+#: their executable caches are shared by every LMEngine instance — a
+#: second engine over the same model shapes compiles nothing
+
+
+@partial(jax.jit, static_argnames=("n_heads", "max_len"))
+def _prefill_admit(params, padded, true_len, n_heads, max_len):
+    logits, kc, vc, pos = causal_lm.lm_prefill_masked(
+        params, padded, true_len, n_heads, max_len)
+    first = jnp.argmax(logits[0], -1).astype(jnp.int32)
+    return first, kc, vc, pos
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _slot_insert(store, value, slot):
+    # the caller always rebinds the result over `store`, so the old
+    # buffer is donated — the multi-hundred-MB KV stores update in place
+    # instead of being copied every admission
+    return jax.lax.dynamic_update_slice(
+        store, value[None].astype(store.dtype),
+        (slot,) + (0,) * value.ndim)
+
+
+@partial(jax.jit, static_argnames=("n_heads", "n_steps"),
+         donate_argnums=(1, 2, 3, 4))
+def _decode_chunk(params, tokens, kc, vc, pos, n_heads, n_steps):
+    def one(carry, _):
+        tokens, kc, vc, pos = carry
+        logits, kc, vc, pos = causal_lm.lm_decode_step_slots(
+            params, tokens, kc, vc, pos, n_heads)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)  # (S, 1)
+        return (nxt[:, :, None], kc, vc, pos), nxt[:, 0]
+
+    (tokens, kc, vc, pos), outs = jax.lax.scan(
+        one, (tokens, kc, vc, pos), None, length=n_steps)
+    return tokens, kc, vc, pos, outs.T  # outs (S, n_steps)
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray          # (T,) int32
+    max_new: int
+    eos: Optional[int]
+    out: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class LMEngine:
+    """Continuous-batching engine over one causal LM.
+
+    params/n_heads/max_len as for `models.causal_lm`; ``n_slots`` is the
+    decode batch (slot) count; ``chunk`` the decode steps per scheduler
+    iteration. ``bucket`` maps a prompt length to its padded prefill
+    length (defaults to power-of-two buckets capped at max_len).
+    """
+
+    def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
+                 n_slots: int = 4, chunk: int = 8,
+                 bucket=None, gang: bool = False) -> None:
+        if n_slots < 1 or chunk < 1:
+            raise ValueError("n_slots and chunk must be >= 1")
+        self.params = params
+        self.n_heads = n_heads
+        self.max_len = max_len
+        self.n_slots = n_slots
+        self.chunk = chunk
+        #: gang=True degrades to STATIC batching (admit only when every
+        #: slot is free) — the baseline continuous batching is measured
+        #: against; exactness is identical, throughput is not
+        self.gang = gang
+        self._bucket = bucket or (
+            lambda n: min(next_pow2_bucket(n), max_len))
+        L = params["wqkv"].shape[0]
+        hd = params["embed"].shape[1] // n_heads
+        flat = L * n_heads
+        # device-resident slot state (leading axis = slot)
+        self._tokens = jnp.zeros((n_slots, 1, 1), jnp.int32)
+        self._kc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
+        self._vc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
+        self._pos = jnp.zeros((n_slots, 1), jnp.int32)
+        # host-side scheduler state (incl. a per-slot position mirror:
+        # positions are deterministic — true_len at admission, +n per
+        # chunk — so the capacity cap never needs a blocking D2H read)
+        self._pos_host: List[int] = [0] * n_slots
+        self._slot_req: List[Optional[_Request]] = [None] * n_slots
+        self._queue: deque[_Request] = deque()
+        self._finished: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        self.stats = {"prefills": 0, "decode_steps": 0,
+                      "slot_steps": 0, "wasted_slot_steps": 0,
+                      "tokens_out": 0, "wall_s": 0.0}
+
+    # -- public API ------------------------------------------------------- #
+
+    def submit(self, prompt: Sequence[int], max_new: int,
+               eos: Optional[int] = None) -> int:
+        """Queue a generation request; returns its request id."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if p.size < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if p.size + max_new - 1 > self.max_len:
+            # the LAST generated token needs no cache slot, hence -1
+            raise ValueError(
+                f"prompt ({p.size}) + max_new ({max_new}) exceeds cache "
+                f"capacity max_len={self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, p, max_new, eos))
+        return rid
+
+    def pending(self) -> int:
+        return len(self._queue) + sum(
+            r is not None for r in self._slot_req)
+
+    def step_iteration(self) -> bool:
+        """One scheduler iteration: admit into free slots, then one
+        decode chunk. Returns True while work remains."""
+        t0 = time.monotonic()
+        self._admit()
+        self._decode()
+        self.stats["wall_s"] += time.monotonic() - t0
+        return self.pending() > 0
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until every queued/active request finishes; returns
+        {request_id: generated tokens} for all finished requests."""
+        while self.step_iteration():
+            pass
+        return dict(self._finished)
+
+    @property
+    def results(self) -> Dict[int, List[int]]:
+        return dict(self._finished)
+
+    # -- scheduler internals ---------------------------------------------- #
+
+    def _admit(self) -> None:
+        if self.gang and any(r is not None for r in self._slot_req):
+            return  # static batching: wait for the whole gang to finish
+        for slot in range(self.n_slots):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            t = int(req.prompt.size)
+            tb = self._bucket(t)
+            padded = np.zeros((1, tb), np.int32)
+            padded[0, :t] = req.prompt
+            first, kc, vc, pos = _prefill_admit(
+                self.params, jnp.asarray(padded), jnp.int32(t),
+                n_heads=self.n_heads, max_len=self.max_len)
+            self.stats["prefills"] += 1
+            sl = jnp.int32(slot)
+            self._kc = _slot_insert(self._kc, kc, sl)
+            self._vc = _slot_insert(self._vc, vc, sl)
+            self._pos = _slot_insert(self._pos, pos, sl)
+            self._tokens = _slot_insert(
+                self._tokens, first.reshape(1, 1), sl)
+            req.out.append(int(first))
+            self._pos_host[slot] = t
+            self._slot_req[slot] = req
+            self._retire_if_done(slot, req)
+
+    def _decode(self) -> None:
+        active = [s for s, r in enumerate(self._slot_req) if r is not None]
+        if not active:
+            return
+        # cap the chunk so no ACTIVE slot decodes past cache capacity
+        # (an overflowing row NaN-poisons itself by contract); submit()'s
+        # `prompt + max_new - 1 <= max_len` guard keeps cap >= 1 for
+        # every active slot, so this never clamps to a forced overflow
+        cap = self.max_len - max(self._pos_host[s] for s in active)
+        remaining = max(r.max_new - len(r.out) for r in self._slot_req
+                        if r is not None)
+        n = max(1, min(self.chunk, cap, remaining))
+        if n < self.chunk:
+            # floor TAILS to a power of two: chunk length is a static
+            # shape, so every distinct n is its own executable — pow2
+            # tails bound the cache at log2(chunk) entries instead of
+            # one per tail length (full-size chunks keep the user's
+            # exact value, whatever it is)
+            n = 1 << (n.bit_length() - 1)
+        self._tokens, self._kc, self._vc, self._pos, outs = \
+            _decode_chunk(self.params, self._tokens, self._kc,
+                          self._vc, self._pos,
+                          n_heads=self.n_heads, n_steps=n)
+        outs = np.asarray(outs)  # (S, n)
+        for s in range(self.n_slots):
+            self._pos_host[s] += n  # device pos advances for EVERY slot
+        self.stats["decode_steps"] += n
+        self.stats["slot_steps"] += n * len(active)
+        for slot in active:
+            req = self._slot_req[slot]
+            for i in range(n):
+                if req.done or len(req.out) >= req.max_new:
+                    # invariant: slots x steps = kept tokens + wasted
+                    # (bench waste_frac reads this stat directly)
+                    self.stats["wasted_slot_steps"] += 1
+                    continue
+                tok = int(outs[slot, i])
+                req.out.append(tok)
+                if req.eos is not None and tok == req.eos:
+                    req.done = True  # tail of the chunk counts as waste
+            self._retire_if_done(slot, req)
+        # slot-steps spent by empty slots decoding garbage
+        self.stats["wasted_slot_steps"] += n * (
+            self.n_slots - len(active))
+
+    def _retire_if_done(self, slot: int, req: _Request) -> None:
+        # both append sites stop at an eos token immediately, so eos can
+        # only ever be the LAST element — no truncation needed
+        hit_eos = req.eos is not None and bool(req.out) \
+            and req.out[-1] == req.eos
+        if hit_eos or len(req.out) >= req.max_new:
+            req.done = True
+            self.stats["tokens_out"] += len(req.out)
+            self._finished[req.rid] = req.out
+            self._slot_req[slot] = None

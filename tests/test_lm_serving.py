@@ -1,0 +1,267 @@
+"""Continuous-batching LM engine: greedy-exactness vs isolated decode.
+
+Contract (serving/lm_engine.py): every stream's output matches isolated
+single-stream generation token-for-token, regardless of batch
+composition, admission time, chunk size, or prompt-length bucketing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import causal_lm
+from nnstreamer_tpu.serving import LMEngine, next_pow2_bucket
+
+V, D, H, L, MAXLEN = 97, 32, 4, 2, 64
+
+
+@pytest.fixture(scope="module")
+def params():
+    return causal_lm.init_causal_lm(
+        jax.random.PRNGKey(7), V, D, H, L, MAXLEN)
+
+
+def isolated_generate(params, prompt, max_new, eos=None):
+    """Single-stream oracle: unpadded prefill + one-at-a-time decode."""
+    logits, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(np.asarray(prompt, np.int32)[None]),
+        H, MAXLEN)
+    out = [int(jnp.argmax(logits[0]))]
+    while len(out) < max_new and not (eos is not None and out[-1] == eos):
+        tok = jnp.asarray([[out[-1]]], jnp.int32)
+        logits, kc, vc, pos = causal_lm.lm_decode_step(
+            params, tok, kc, vc, pos, H)
+        out.append(int(jnp.argmax(logits[0])))
+    return out
+
+
+def prompts_rng(n, lo=1, hi=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, rng.integers(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def test_single_request_matches_isolated(params):
+    prompt = prompts_rng(1, lo=5, hi=6)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    rid = eng.submit(prompt, max_new=12)
+    got = eng.run()[rid]
+    assert got == isolated_generate(params, prompt, 12)
+
+
+def test_more_requests_than_slots_slot_reuse(params):
+    prompts = prompts_rng(7, seed=1)
+    eng = LMEngine(params, H, MAXLEN, n_slots=3, chunk=4)
+    rids = [eng.submit(p, max_new=6 + i % 5) for i, p in enumerate(prompts)]
+    res = eng.run()
+    assert eng.stats["prefills"] == 7
+    for i, (rid, p) in enumerate(zip(rids, prompts)):
+        assert res[rid] == isolated_generate(params, p, 6 + i % 5), \
+            f"request {i} diverged"
+
+
+def test_mid_flight_admission(params):
+    prompts = prompts_rng(5, seed=2)
+    eng = LMEngine(params, H, MAXLEN, n_slots=4, chunk=2)
+    rids = [eng.submit(p, max_new=10) for p in prompts[:2]]
+    eng.step_iteration()
+    eng.step_iteration()
+    rids += [eng.submit(p, max_new=10) for p in prompts[2:]]
+    res = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert res[rid] == isolated_generate(params, p, 10)
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 16])
+def test_chunk_size_invariance(params, chunk):
+    prompts = prompts_rng(4, seed=3)
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=chunk)
+    rids = [eng.submit(p, max_new=9) for p in prompts]
+    res = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert res[rid] == isolated_generate(params, p, 9)
+
+
+def test_eos_early_stop(params):
+    # pick an eos the model actually emits: generate once, then use a
+    # token from the middle of that stream as the eos marker
+    prompt = prompts_rng(1, lo=8, hi=9, seed=4)[0]
+    ref_free = isolated_generate(params, prompt, 20)
+    eos = ref_free[len(ref_free) // 2]
+    ref = isolated_generate(params, prompt, 20, eos=eos)
+    assert ref[-1] == eos and len(ref) < 20
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    rid = eng.submit(prompt, max_new=20, eos=eos)
+    filler = prompts_rng(1, seed=5)[0]
+    rid2 = eng.submit(filler, max_new=20)
+    res = eng.run()
+    assert res[rid] == ref
+    assert res[rid2] == isolated_generate(params, filler, 20)
+    # capacity invariant even with a mid-chunk eos: every slot-step
+    # either produced a kept token or is counted as waste
+    st = eng.stats
+    assert eng.n_slots * st["decode_steps"] == \
+        (st["tokens_out"] - st["prefills"]) + st["wasted_slot_steps"]
+
+
+def test_max_new_one_retires_at_admission(params):
+    prompt = prompts_rng(1, seed=6)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=1, chunk=4)
+    rid = eng.submit(prompt, max_new=1)
+    res = eng.run()
+    assert res[rid] == isolated_generate(params, prompt, 1)
+    assert eng.stats["decode_steps"] == 0
+
+
+def test_capacity_boundary(params):
+    # prompt + max_new - 1 == max_len exactly fills the cache
+    t = MAXLEN - 8
+    prompt = prompts_rng(1, lo=t, hi=t + 1, seed=7)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=1, chunk=16)
+    rid = eng.submit(prompt, max_new=9)
+    got = eng.run()[rid]
+    ref = isolated_generate(params, prompt, 9)
+    assert got == ref and not any(np.isnan(got))
+
+
+def test_submit_rejections(params):
+    eng = LMEngine(params, H, MAXLEN, n_slots=1)
+    with pytest.raises(ValueError, match="capacity"):
+        eng.submit(np.zeros(MAXLEN, np.int32), max_new=2)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([], max_new=2)
+    with pytest.raises(ValueError, match="max_new"):
+        eng.submit([1, 2], max_new=0)
+
+
+def test_bucketing_is_exact_and_bounded(params):
+    # distinct prompt lengths land in few buckets: prefill compiles are
+    # bounded by the bucket count, and results stay exact
+    assert next_pow2_bucket(1) == 16 and next_pow2_bucket(17) == 32
+    prompts = [np.arange(1, n + 1, dtype=np.int32) % V
+               for n in (1, 3, 15, 16, 17, 31, 33)]
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    rids = [eng.submit(p, max_new=5) for p in prompts]
+    res = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert res[rid] == isolated_generate(params, p, 5)
+
+
+def test_masked_prefill_matches_unpadded(params):
+    prompt = prompts_rng(1, lo=11, hi=12, seed=8)[0]
+    t = prompt.size
+    lg_ref, kc_ref, vc_ref, pos_ref = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt[None]), H, MAXLEN)
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :t] = prompt
+    lg, kc, vc, pos = causal_lm.lm_prefill_masked(
+        params, jnp.asarray(padded), jnp.int32(t), H, MAXLEN)
+    assert int(pos[0]) == int(pos_ref[0]) == t
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_ref),
+                               rtol=1e-5, atol=1e-6)
+    # cache rows BELOW true_len must match; rows past it are garbage by
+    # contract (overwritten before visible)
+    np.testing.assert_allclose(np.asarray(kc[:, :t]),
+                               np.asarray(kc_ref[:, :t]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vc[:, :t]),
+                               np.asarray(vc_ref[:, :t]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_slot_step_matches_single_stream(params):
+    # lm_decode_step_slots == stacked single-stream lm_decode_step
+    rng = np.random.default_rng(9)
+    S = 3
+    states = []
+    for s in range(S):
+        prompt = rng.integers(0, V, 4 + 3 * s).astype(np.int32)
+        lg, kc, vc, pos = causal_lm.lm_prefill(
+            params, jnp.asarray(prompt[None]), H, MAXLEN)
+        tok = jnp.argmax(lg, -1).astype(jnp.int32)[:, None]
+        states.append((tok, kc, vc, pos))
+    toks = jnp.stack([s[0] for s in states])
+    kcs = jnp.stack([s[1] for s in states])
+    vcs = jnp.stack([s[2] for s in states])
+    poss = jnp.stack([s[3] for s in states])
+    lg_b, kcs2, vcs2, poss2 = causal_lm.lm_decode_step_slots(
+        params, toks, kcs, vcs, poss, H)
+    for s, (tok, kc, vc, pos) in enumerate(states):
+        lg1, kc1, vc1, pos1 = causal_lm.lm_decode_step(
+            params, tok, kc, vc, pos, H)
+        np.testing.assert_allclose(np.asarray(lg_b[s]), np.asarray(lg1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(kcs2[s]), np.asarray(kc1),
+                                   rtol=1e-5, atol=1e-6)
+        assert int(poss2[s, 0]) == int(pos1[0])
+
+
+def test_engine_exact_under_env_flash_flag(params, monkeypatch):
+    # NNS_LM_FLASH=1 must not reroute the masked prefill onto the flash
+    # path (which cannot column-mask a padded prompt): admission forces
+    # dense and results stay exact
+    monkeypatch.setenv("NNS_LM_FLASH", "1")
+    prompt = prompts_rng(1, lo=6, hi=7, seed=12)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    rid = eng.submit(prompt, max_new=8)
+    got = eng.run()[rid]
+    monkeypatch.delenv("NNS_LM_FLASH")
+    assert got == isolated_generate(params, prompt, 8)
+
+
+def test_nonpow2_chunk_kept_at_steady_state(params):
+    # chunk=6 is not a power of two: full-size chunks must run 6 steps
+    # (only TAIL chunks floor to pow2 for executable-cache bounding)
+    prompt = prompts_rng(1, lo=4, hi=5, seed=13)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=1, chunk=6)
+    rid = eng.submit(prompt, max_new=14)  # 1 prefill + 13 decode
+    got = eng.run()[rid]
+    assert got == isolated_generate(params, prompt, 14)
+    # 13 remaining -> chunks of 6, 6, then tail 1 (pow2): 3 iterations
+    assert eng.stats["decode_steps"] == 13
+
+
+def test_host_pos_mirror_tracks_device(params):
+    prompts = prompts_rng(3, seed=14)
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    for p in prompts:
+        eng.submit(p, max_new=7)
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng._pos)[:, 0], np.asarray(eng._pos_host))
+
+
+def test_gang_mode_static_batching_exact(params):
+    # gang=True (the static-batch baseline) admits only into an all-free
+    # engine; results stay exact, but later requests wait for the whole
+    # first gang, so more decode steps run than in continuous mode
+    prompts = prompts_rng(5, seed=11)
+    lens = [4, 16, 4, 16, 4]
+    cont = LMEngine(params, H, MAXLEN, n_slots=2, chunk=2)
+    gang = LMEngine(params, H, MAXLEN, n_slots=2, chunk=2, gang=True)
+    rc = [cont.submit(p, max_new=n) for p, n in zip(prompts, lens)]
+    rg = [gang.submit(p, max_new=n) for p, n in zip(prompts, lens)]
+    res_c, res_g = cont.run(), gang.run()
+    for rid_c, rid_g, p, n in zip(rc, rg, prompts, lens):
+        ref = isolated_generate(params, p, n)
+        assert res_c[rid_c] == ref and res_g[rid_g] == ref
+    assert gang.stats["decode_steps"] >= cont.stats["decode_steps"]
+
+
+def test_stats_account_for_waste(params):
+    prompts = prompts_rng(2, seed=10)
+    eng = LMEngine(params, H, MAXLEN, n_slots=4, chunk=4)
+    rids = [eng.submit(p, max_new=3 + 5 * i) for i, p in enumerate(prompts)]
+    res = eng.run()
+    for rid, p, n in zip(rids, prompts, (3, 8)):
+        assert res[rid] == isolated_generate(params, p, n)
+    st = eng.stats
+    assert st["prefills"] == 2
+    assert st["tokens_out"] == 3 + 8
+    # 2 empty slots ride every chunk; the short request wastes steps too
+    assert st["wasted_slot_steps"] > 0
+    assert st["slot_steps"] >= st["tokens_out"] - st["prefills"]
+    assert eng.n_slots * st["decode_steps"] == \
+        (st["tokens_out"] - st["prefills"]) + st["wasted_slot_steps"]
