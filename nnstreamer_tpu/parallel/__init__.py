@@ -1,6 +1,7 @@
 """SPMD parallel layer: device meshes, GSPMD shardings, sharded steps,
 pipeline stages (pp), and expert parallelism (ep)."""
 
+from .checkpoint import restore_sharded_state, save_sharded_state
 from .mesh import auto_mesh_2d, batch_sharding, make_mesh, replicated
 from .moe import (
     init_moe_params,
@@ -30,4 +31,5 @@ __all__ = [
     "stack_stage_params",
     "init_moe_params", "make_expert_parallel_moe", "moe_apply",
     "moe_shardings",
+    "restore_sharded_state", "save_sharded_state",
 ]

@@ -1,0 +1,163 @@
+"""Sharded train-state checkpointing: save from a mesh, restore to a mesh.
+
+The reference has no training and no checkpoint concept (SURVEY §5:
+closest is model hot-reload); this is the capability a distributed
+trainer needs on top of `utils/checkpoints.py`'s host-pytree
+(de)serialization: the state LIVES sharded over a `jax.sharding.Mesh`,
+and a restore may target a DIFFERENT mesh layout than the save ran on
+(elastic resume: job restarts on a re-shaped slice).
+
+Design: orbax `StandardCheckpointer` already speaks `jax.Array` — saving
+a sharded pytree writes the logical arrays, and restoring against a
+target of `jax.ShapeDtypeStruct`s that carry `NamedSharding`s
+materializes each leaf directly in its target placement (no host
+round-trip through a replicated copy, no resharding collective
+afterwards). Resume-equivalence — save → restore (same or re-shaped
+mesh) → continue == train straight through — is pinned by
+tests/test_parallel.py and a `dryrun_multichip` lane.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from ..utils.checkpoints import save_variables
+from .sharding import param_shardings
+
+
+def save_sharded_state(path: str, params: Any,
+                       opt_state: Any = None) -> None:
+    """Write a (possibly sharded) train state as one orbax checkpoint.
+
+    Leaves may be `jax.Array`s on any mesh/sharding — orbax serializes
+    the logical array. ``opt_state=None`` saves params only.
+    """
+    if path.endswith(".msgpack"):
+        raise ValueError(
+            "sharded checkpoints are orbax directories; the flat "
+            ".msgpack format (utils/checkpoints.save_variables) has no "
+            "restore path here — use a directory path")
+    state = {"params": params}
+    if opt_state is not None:
+        state["opt_state"] = opt_state
+    save_variables(path, state)  # utils/checkpoints orbax path
+
+
+def _as_target(tree: Any, shardings: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                             sharding=s),
+        tree, shardings)
+
+
+#: sentinel: metadata introspection failed (orbax layout change) —
+#: distinct from "checkpoint is params-only", so a full checkpoint with
+#: unreadable metadata does not silently drop its optimizer state
+_META_UNKNOWN = object()
+
+
+def _saved_opt_meta(ckptr, path: str):
+    """The checkpoint's own 'opt_state' metadata subtree; None when the
+    checkpoint was saved params-only; ``_META_UNKNOWN`` when the
+    metadata layout could not be read."""
+    try:
+        meta = ckptr.metadata(path)
+        tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+        if not isinstance(tree, dict) or "params" not in tree:
+            return _META_UNKNOWN
+        return tree.get("opt_state")
+    except Exception:  # pragma: no cover - older orbax layouts
+        return _META_UNKNOWN
+
+
+def restore_sharded_state(path: str, params_like: Any,
+                          mesh: Optional[Mesh] = None,
+                          opt_state_like: Any = None
+                          ) -> Tuple[Any, Any]:
+    """Restore (params, opt_state) directly into mesh placement.
+
+    ``params_like``/``opt_state_like`` provide shapes+dtypes (abstract or
+    concrete; they are NOT read). With ``mesh``, params restore into
+    `param_shardings(params_like, mesh)` — the same placement rule the
+    train step was built with, so the restored state feeds
+    `make_sharded_train_step`'s jitted step with zero relayout; the mesh
+    may differ from the one the checkpoint was saved under (orbax
+    re-lays out on read). Optimizer-state leaves mirror the sharding of
+    the param leaf they track (optax states are param-pytree-shaped);
+    scalar/step leaves replicate. Without ``mesh``, leaves restore as
+    plain host (numpy) arrays.
+
+    Either side may be partial: a params-only restore of a full
+    checkpoint discards the stored optimizer state (its leaves restore
+    from the checkpoint's own metadata, host-side, and are dropped), and
+    an ``opt_state_like`` against a params-only checkpoint returns
+    ``opt_state=None``. If the checkpoint's metadata cannot be read at
+    all, the target mirrors exactly what the caller provided — a
+    structure mismatch then surfaces as orbax's loud error rather than a
+    silently dropped optimizer state.
+    """
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    abspath = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    opt_meta = _saved_opt_meta(ckptr, abspath)
+    if opt_meta is _META_UNKNOWN:
+        # no introspection: trust the caller's template shape
+        opt_meta = None if opt_state_like is None else opt_state_like
+    want_opt = opt_state_like is not None and opt_meta is not None
+
+    def _host_target(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(tuple(leaf.shape),
+                                              leaf.dtype), tree)
+
+    if mesh is None:
+        target = {"params": _host_target(params_like)}
+    else:
+        p_shardings = param_shardings(params_like, mesh)
+        target = {"params": _as_target(params_like, p_shardings)}
+    if opt_meta is not None:
+        if opt_state_like is None:
+            # checkpoint carries an opt_state the caller doesn't want:
+            # orbax restore targets must match the saved structure, so
+            # restore it from its own metadata and drop it
+            target["opt_state"] = jax.tree_util.tree_map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+                opt_meta)
+        elif mesh is None:
+            target["opt_state"] = _host_target(opt_state_like)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            # an optax state is a pytree whose array leaves are either
+            # param-shaped (momentum/trace: shard like the param) or
+            # scalars (counts: replicate). Match by shape against the
+            # param tree — robust to optax's own wrapper structures.
+            by_shape = {}
+            for leaf, s in zip(jax.tree_util.tree_leaves(params_like),
+                               jax.tree_util.tree_leaves(p_shardings)):
+                by_shape.setdefault(tuple(leaf.shape), s)
+
+            def opt_target(leaf):
+                s = by_shape.get(tuple(leaf.shape), repl)
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=s)
+
+            target["opt_state"] = jax.tree_util.tree_map(
+                opt_target, opt_state_like)
+    restored = ckptr.restore(abspath, target=target)
+    params_r = restored["params"]
+    opt_r = restored["opt_state"] if want_opt else None
+    if mesh is None:
+        # documented host restore: concrete numpy leaves, no device pins
+        to_host = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: np.asarray(a), t)
+        params_r = to_host(params_r)
+        opt_r = to_host(opt_r) if opt_r is not None else None
+    return params_r, opt_r
