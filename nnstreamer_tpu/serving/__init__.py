@@ -7,6 +7,7 @@ continuous batching for causal-LM generation — many generation streams
 multiplexed into one compiled batched decode step.
 """
 
+from . import sampling
 from .lm_engine import LMEngine, next_pow2_bucket
 
-__all__ = ["LMEngine", "next_pow2_bucket"]
+__all__ = ["LMEngine", "next_pow2_bucket", "sampling"]

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import causal_lm
+from . import sampling
 
 
 def next_pow2_bucket(n: int, lo: int = 16) -> int:
@@ -67,10 +68,13 @@ def next_pow2_bucket(n: int, lo: int = 16) -> int:
 
 
 @partial(jax.jit, static_argnames=("n_heads", "max_len"))
-def _prefill_admit(params, padded, true_len, n_heads, max_len):
+def _prefill_admit(params, padded, true_len, skey, temp, top_k, top_p,
+                   n_heads, max_len):
     logits, kc, vc, pos = causal_lm.lm_prefill_masked(
         params, padded, true_len, n_heads, max_len)
-    first = jnp.argmax(logits[0], -1).astype(jnp.int32)
+    # the first token is emitted having consumed true_len prompt tokens
+    first = sampling.sample_row(
+        logits[0], jax.random.fold_in(skey, true_len), temp, top_k, top_p)
     return first, kc, vc, pos
 
 
@@ -86,13 +90,27 @@ def _slot_insert(store, value, slot):
 
 @partial(jax.jit, static_argnames=("n_heads", "n_steps"),
          donate_argnums=(1, 2, 3, 4))
-def _decode_chunk(params, tokens, kc, vc, pos, n_heads, n_steps):
+def _decode_chunk(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
+                  n_heads, n_steps):
     def one(carry, _):
         tokens, kc, vc, pos = carry
         logits, kc, vc, pos = causal_lm.lm_decode_step_slots(
             params, tokens, kc, vc, pos, n_heads)
-        nxt = jnp.argmax(logits, -1).astype(jnp.int32)  # (S, 1)
-        return (nxt[:, :, None], kc, vc, pos), nxt[:, 0]
+
+        # pos is post-step = tokens consumed; keys derive from (seed,
+        # consumed) only, so sampling is batch-composition-independent
+        def sampled(lg):
+            keys = sampling.step_keys(skeys, pos[:, 0])
+            return sampling.sample_logits(
+                lg[:, 0], keys, temp, top_k, top_p)  # (S,)
+
+        def greedy(lg):
+            return jnp.argmax(lg[:, 0], -1).astype(jnp.int32)
+
+        # an all-greedy batch (the default) skips the sampler's
+        # full-vocab top_k/softmax/cumsum in the decode hot loop
+        nxt = jax.lax.cond(jnp.all(temp <= 0.0), greedy, sampled, logits)
+        return (nxt[:, None, None], kc, vc, pos), nxt
 
     (tokens, kc, vc, pos), outs = jax.lax.scan(
         one, (tokens, kc, vc, pos), None, length=n_steps)
@@ -105,6 +123,10 @@ class _Request:
     prompt: np.ndarray          # (T,) int32
     max_new: int
     eos: Optional[int]
+    temperature: float = 0.0    # <= 0 → greedy
+    top_k: int = 0              # <= 0 → disabled
+    top_p: float = 1.0          # >= 1 → disabled
+    seed: int = 0
     out: List[int] = field(default_factory=list)
     done: bool = False
 
@@ -142,6 +164,12 @@ class LMEngine:
         self._kc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
         self._vc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
         self._pos = jnp.zeros((n_slots, 1), jnp.int32)
+        # per-slot sampling controls (traced values — greedy and sampled
+        # streams share one executable; see serving/sampling.py)
+        self._skeys = jnp.zeros((n_slots, 2), jnp.uint32)
+        self._temp = jnp.zeros((n_slots,), jnp.float32)
+        self._topk = jnp.zeros((n_slots,), jnp.int32)
+        self._topp = jnp.ones((n_slots,), jnp.float32)
         # host-side scheduler state (incl. a per-slot position mirror:
         # positions are deterministic — true_len at admission, +n per
         # chunk — so the capacity cap never needs a blocking D2H read)
@@ -157,8 +185,16 @@ class LMEngine:
     # -- public API ------------------------------------------------------- #
 
     def submit(self, prompt: Sequence[int], max_new: int,
-               eos: Optional[int] = None) -> int:
-        """Queue a generation request; returns its request id."""
+               eos: Optional[int] = None, *, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> int:
+        """Queue a generation request; returns its request id.
+
+        ``temperature``/``top_k``/``top_p`` select the decoding mode per
+        request (defaults = greedy, bit-identical to the pre-sampling
+        engine). ``seed`` fixes the request's PRNG stream: the sampled
+        output is reproducible and independent of batch composition
+        (serving/sampling.py key schedule).
+        """
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size < 1:
             raise ValueError("empty prompt")
@@ -171,7 +207,9 @@ class LMEngine:
                 f"capacity max_len={self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, p, max_new, eos))
+        self._queue.append(_Request(
+            rid, p, max_new, eos, temperature=float(temperature),
+            top_k=int(top_k), top_p=float(top_p), seed=int(seed)))
         return rid
 
     def pending(self) -> int:
@@ -211,8 +249,12 @@ class LMEngine:
             tb = self._bucket(t)
             padded = np.zeros((1, tb), np.int32)
             padded[0, :t] = req.prompt
+            skey = sampling.seed_key(req.seed)
+            temp = jnp.float32(req.temperature)
+            tk, tp = jnp.int32(req.top_k), jnp.float32(req.top_p)
             first, kc, vc, pos = _prefill_admit(
                 self.params, jnp.asarray(padded), jnp.int32(t),
+                skey, temp, tk, tp,
                 n_heads=self.n_heads, max_len=self.max_len)
             self.stats["prefills"] += 1
             sl = jnp.int32(slot)
@@ -221,6 +263,10 @@ class LMEngine:
             self._pos = _slot_insert(self._pos, pos, sl)
             self._tokens = _slot_insert(
                 self._tokens, first.reshape(1, 1), sl)
+            self._skeys = _slot_insert(self._skeys, skey, sl)
+            self._temp = _slot_insert(self._temp, temp, sl)
+            self._topk = _slot_insert(self._topk, tk, sl)
+            self._topp = _slot_insert(self._topp, tp, sl)
             req.out.append(int(first))
             self._pos_host[slot] = t
             self._slot_req[slot] = req
@@ -247,7 +293,8 @@ class LMEngine:
             n = 1 << (n.bit_length() - 1)
         self._tokens, self._kc, self._vc, self._pos, outs = \
             _decode_chunk(self.params, self._tokens, self._kc,
-                          self._vc, self._pos,
+                          self._vc, self._pos, self._skeys,
+                          self._temp, self._topk, self._topp,
                           n_heads=self.n_heads, n_steps=n)
         outs = np.asarray(outs)  # (S, n)
         for s in range(self.n_slots):
