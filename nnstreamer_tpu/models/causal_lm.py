@@ -252,8 +252,51 @@ def lm_decode_step(params: Dict[str, jax.Array], token: jax.Array,
 
 
 def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
+    # exactly the W=1 case of the verify window (one shared body — the
+    # cache-write/masking/poison contracts live in one place)
+    logits, kc, vc, pos = _lm_verify_window(
+        params, token, kcache, vcache, pos, n_heads)
+    return logits[:, 0], kc, vc, pos
+
+
+def lm_verify_window(params: Dict[str, jax.Array], tokens: jax.Array,
+                     kcache: jax.Array, vcache: jax.Array, pos: jax.Array,
+                     n_heads: int
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Speculative-verify step: consume a WINDOW of W tokens at cache
+    positions pos..pos+W-1 and return logits at EVERY window position.
+
+    The device half of speculative decoding: the caller feeds
+    ``[carried_token, draft_1..draft_{W-1}]``, gets back the model's
+    next-token distribution after each of them in ONE dispatch, and
+    accepts the longest prefix where the draft matches the model
+    (`serving/lm_engine.py` speculative mode). Within the window, query
+    row j attends cache columns <= pos+j — K/V for the whole window are
+    written first, and rows never see later columns, so row j's logits
+    equal a sequential decode step that consumed tokens[:, :j+1] up to
+    matmul associativity (~1e-7 at f32: the W-row matmul contracts in a
+    different order) with identical argmax except at ties below that
+    scale — greedy acceptance reproduces sequential greedy decode
+    (tests/test_lm_spec.py pins both levels).
+    Rejected-draft K/V slots beyond the accepted count become garbage,
+    but a later step at position p attends col <= p only after
+    overwriting slot p — the same overwrite-before-visible invariant
+    bucketed prefill relies on (lm_prefill_masked), so the caller
+    "rolls back" by just setting pos lower.
+
+    tokens: (B, W) int32; caches in the flat transport layout; pos:
+    (1,) int32. Returns (logits (B, W, vocab), kcache', vcache',
+    pos+W). Windows past capacity (pos+W > max_len) NaN-poison the
+    logits, mirroring lm_decode_step's contract.
+    """
+    with jax.default_matmul_precision(_PRECISION):
+        return _lm_verify_window(
+            params, tokens, kcache, vcache, pos, n_heads)
+
+
+def _lm_verify_window(params, tokens, kcache, vcache, pos, n_heads):
     n_layers = params["wqkv"].shape[0]
-    b = token.shape[0]
+    b, w = tokens.shape
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads
     max_len = kcache.shape[-2]
@@ -261,21 +304,23 @@ def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
 
     kc = kcache.reshape(n_layers, b, n_heads, max_len, hd)
     vc = vcache.reshape(n_layers, b, n_heads, max_len, hd)
-    x = params["embed"][token[:, 0]][:, None, :] + \
-        params["pos_embed"][p][None, None, :]
-    live = (jnp.arange(max_len) <= p)[None, None, None, :]
+    x = params["embed"][tokens] + \
+        jax.lax.dynamic_slice_in_dim(params["pos_embed"], p, w)[None]
+    # row j sees columns <= p+j (its own slot included, later rows' not)
+    live = (jnp.arange(max_len)[None, :] <=
+            (p + jnp.arange(w))[:, None])[None, None]   # (1,1,W,max_len)
 
     def block(carry, layer):
         # the cache rides the CARRY, not the scan ys: a ys-threaded cache
         # makes XLA rewrite all L·B·H·max_len slots every token, while a
         # carried buffer takes in-place dynamic_update_slice writes of
-        # just the new (B, H, 1, hd) slot per layer — the difference is
+        # just the new (B, H, W, hd) slots per layer — the difference is
         # ~half the per-step HBM traffic at serving shapes
         h, kc, vc = carry
         wqkv, wo, w1, w2, ln1, ln2, li = layer
         a = _ln(h, ln1)
-        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)          # (B, 1, D)
-        q = _split_heads(q, n_heads)                       # (B, H, 1, hd)
+        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)          # (B, W, D)
+        q = _split_heads(q, n_heads)                       # (B, H, W, hd)
         k = _split_heads(k, n_heads)[None].astype(kc.dtype)
         v = _split_heads(v, n_heads)[None].astype(vc.dtype)
         kc = jax.lax.dynamic_update_slice(kc, k, (li, 0, 0, p, 0))
@@ -295,17 +340,35 @@ def _lm_decode_step(params, token, kcache, vcache, pos, n_heads):
         (params["wqkv"], params["wo"], params["w1"],
          params["w2"], params["ln1"], params["ln2"],
          jnp.arange(n_layers, dtype=jnp.int32)),
-        # full unroll: decode-step ops are tiny (B rows), so the win is
-        # XLA prefetching the next layer's weights while this one runs;
+        # full unroll: step ops are tiny (B·W rows), so the win is XLA
+        # prefetching the next layer's weights while this one runs;
         # n_layers is small and static, compile cost is bounded
         unroll=True)
-    logits = (_ln(x, params["lnf"]) @ params["embed"].T)[:, 0]
-    # cache overflow (pos past capacity) surfaces as NaN logits, not as a
-    # silent overwrite of the last cache slot — see lm_decode_step doc
-    logits = jnp.where(p >= max_len, jnp.nan, logits)
+    logits = _ln(x, params["lnf"]) @ params["embed"].T   # (B, W, vocab)
+    # cache overflow (window past capacity) surfaces as NaN logits, not
+    # as a silent clamped overwrite of the last slots — lm_decode_step doc
+    logits = jnp.where(p + w > max_len, jnp.nan, logits)
     flat = (n_layers * b * n_heads, max_len, hd)
     return (logits, kc.reshape(flat), vc.reshape(flat),
-            (p + 1).reshape(1).astype(jnp.int32))
+            (p + w).reshape(1).astype(jnp.int32))
+
+
+def lm_verify_window_slots(params: Dict[str, jax.Array], tokens: jax.Array,
+                           kcaches: jax.Array, vcaches: jax.Array,
+                           poss: jax.Array, n_heads: int
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                      jax.Array]:
+    """Verify windows for S independent streams at per-slot positions
+    (``jax.vmap`` of :func:`lm_verify_window`, the same construction as
+    lm_decode_step_slots). tokens: (S, W); caches with a leading slot
+    axis; poss: (S, 1). Returns (logits (S, W, vocab), caches',
+    poss+W)."""
+    with jax.default_matmul_precision(_PRECISION):
+        step = lambda tok, kc, vc, pos: _lm_verify_window(  # noqa: E731
+            params, tok[None], kc, vc, pos, n_heads)
+        logits, kc, vc, pos = jax.vmap(step)(
+            tokens, kcaches, vcaches, poss)
+        return logits[:, 0], kc, vc, pos
 
 
 def lm_prefill_masked(params: Dict[str, jax.Array], tokens: jax.Array,
@@ -350,12 +413,11 @@ def lm_decode_step_slots(params: Dict[str, jax.Array], tokens: jax.Array,
     tokens: (S, 1, 1) int32; kcaches/vcaches: (S, layers·heads, max_len,
     head_dim); poss: (S, 1) int32. Returns (logits (S, 1, vocab),
     kcaches', vcaches', poss+1). Slots past capacity NaN-poison their own
-    row only.
+    row only. Exactly the W=1 case of :func:`lm_verify_window_slots`
+    (one shared vmap wrapper; only the token layout differs).
     """
-    with jax.default_matmul_precision(_PRECISION):
-        step = lambda tok, kc, vc, pos: _lm_decode_step(  # noqa: E731
-            params, tok, kc, vc, pos, n_heads)
-        return jax.vmap(step)(tokens, kcaches, vcaches, poss)
+    return lm_verify_window_slots(
+        params, tokens[:, :, 0], kcaches, vcaches, poss, n_heads)
 
 
 def prefill_flops(batch: int, seq: int, d_model: int, n_layers: int,

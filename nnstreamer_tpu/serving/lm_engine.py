@@ -117,6 +117,36 @@ def _decode_chunk(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
     return tokens, kc, vc, pos, outs.T  # outs (S, n_steps)
 
 
+@partial(jax.jit, static_argnames=("n_heads",),
+         donate_argnums=(2, 3, 4))
+def _verify_chunk(params, tokens_in, kc, vc, pos, n_heads):
+    """One speculative iteration: verify W-token windows for all slots,
+    accept per-slot prefixes, and roll positions back past rejected
+    drafts — one dispatch, like a decode chunk.
+
+    tokens_in (S, W) = [carried token, draft_1..draft_{W-1}] per slot.
+    Each slot accepts 1 + the longest draft prefix the model's own
+    argmax confirms (row j logits match a sequential step's up to
+    ~1e-7 matmul associativity with identical argmax —
+    lm_verify_window). Greedy-only by design: the engine gates
+    speculation to all-greedy active sets (a sampled stream can only
+    ever accept one token per dispatch, which plain chunks serve
+    strictly better), so no sampler runs here. Returns
+    (carried' (S,1,1), kc, vc, pos+m, outs (S, W), m (S,)).
+    """
+    w = tokens_in.shape[1]
+    logits, kc, vc, pos_w = causal_lm.lm_verify_window_slots(
+        params, tokens_in, kc, vc, pos, n_heads)
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)      # (S, W)
+    # draft token j (input col j, j>=1) is confirmed iff it equals the
+    # model's output at col j-1 AND every earlier draft was confirmed
+    ok = (tokens_in[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+    m = 1 + jnp.cumprod(ok, axis=-1).sum(-1)               # (S,) in 1..W
+    pos_m = pos_w - w + m[:, None]                         # = pos + m
+    carried = jnp.take_along_axis(greedy, m[:, None] - 1, axis=1)
+    return carried[:, :, None], kc, vc, pos_m, greedy, m
+
+
 @dataclass
 class _Request:
     rid: int
@@ -142,9 +172,12 @@ class LMEngine:
 
     def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
                  n_slots: int = 4, chunk: int = 8,
-                 bucket=None, gang: bool = False) -> None:
+                 bucket=None, gang: bool = False,
+                 spec_draft: int = 0) -> None:
         if n_slots < 1 or chunk < 1:
             raise ValueError("n_slots and chunk must be >= 1")
+        if spec_draft < 0 or spec_draft + 1 > max_len:
+            raise ValueError("spec_draft must be in [0, max_len-1]")
         self.params = params
         self.n_heads = n_heads
         self.max_len = max_len
@@ -154,6 +187,14 @@ class LMEngine:
         #: slot is free) — the baseline continuous batching is measured
         #: against; exactness is identical, throughput is not
         self.gang = gang
+        #: speculative decoding: draft spec_draft tokens per iteration
+        #: by prompt-lookup (trailing n-gram match in the stream's own
+        #: history) and verify them in ONE dispatch (_verify_chunk).
+        #: Greedy outputs stay bit-identical (tests/test_lm_spec.py);
+        #: accepted-per-iteration rides text repetitiveness, so the win
+        #: is workload-dependent where chunking's is unconditional —
+        #: the two compose by falling back to chunks near capacity
+        self.spec_draft = spec_draft
         self._bucket = bucket or (
             lambda n: min(next_pow2_bucket(n), max_len))
         L = params["wqkv"].shape[0]
@@ -178,9 +219,16 @@ class LMEngine:
         self._queue: deque[_Request] = deque()
         self._finished: Dict[int, List[int]] = {}
         self._next_rid = 0
+        # decode_steps/slot_steps/wasted_slot_steps account the CHUNK
+        # path only (bench waste_frac reads them; its serving lane runs
+        # chunk mode); speculative iterations are accounted separately
+        # by the spec_* keys — tokens from them are in tokens_out but
+        # not in the slots x steps = kept + wasted chunk invariant
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "slot_steps": 0, "wasted_slot_steps": 0,
-                      "tokens_out": 0, "wall_s": 0.0}
+                      "tokens_out": 0, "wall_s": 0.0,
+                      "spec_iterations": 0, "spec_drafted": 0,
+                      "spec_accepted": 0}
 
     # -- public API ------------------------------------------------------- #
 
@@ -276,6 +324,24 @@ class LMEngine:
         active = [s for s, r in enumerate(self._slot_req) if r is not None]
         if not active:
             return
+        if self.spec_draft > 0 and self.max_len - max(
+                self._pos_host[s] for s in active) >= self.spec_draft + 1 \
+                and all(self._slot_req[s].temperature <= 0.0
+                        for s in active) \
+                and any(self._slot_req[s].max_new - len(self._slot_req[s].out)
+                        > 1 for s in active):
+            # the last gate: a verify window costs (spec_draft+1)x a
+            # decode step's matmul rows — pointless when every active
+            # stream needs at most one more token (the chunk path caps
+            # its step count by `remaining` instead)
+            # verify writes spec_draft+1 cache slots per iteration; near
+            # capacity fall through to plain chunks (which self-cap).
+            # Speculation is gated to ALL-greedy active sets: a sampled
+            # stream can only accept one token per dispatch (its draw is
+            # sequential by definition), so any batch containing one is
+            # served strictly better by chunked decode
+            self._decode_speculative(active)
+            return
         # cap the chunk so no ACTIVE slot decodes past cache capacity
         # (an overflowing row NaN-poisons itself by contract); submit()'s
         # `prompt + max_new - 1 <= max_len` guard keeps cap >= 1 for
@@ -318,6 +384,68 @@ class LMEngine:
         self.stats["wasted_slot_steps"] += n * (
             self.n_slots - len(active))
 
+    def _decode_speculative(self, active: List[int]) -> None:
+        """One speculative iteration: host-drafted prompt-lookup tokens
+        verified in one dispatch; per-slot acceptance rolls pos back
+        past rejected drafts (lm_verify_window's overwrite-before-
+        visible invariant makes that roll-back free)."""
+        g = self.spec_draft
+        drafts = np.zeros((self.n_slots, g), np.int32)
+        for s in active:
+            drafts[s] = self._draft_tokens(self._slot_req[s], g)
+        tokens_in = jnp.concatenate(
+            [self._tokens[:, 0], jnp.asarray(drafts)], axis=1)  # (S, 1+g)
+        (self._tokens, self._kc, self._vc, self._pos, outs, m) = \
+            _verify_chunk(self.params, tokens_in, self._kc, self._vc,
+                          self._pos, n_heads=self.n_heads)
+        outs = np.asarray(outs)
+        m = np.asarray(m)
+        for s in range(self.n_slots):
+            # unlike chunks, per-slot advance is data-dependent — the
+            # mirror updates from the fetched acceptance counts
+            self._pos_host[s] += int(m[s])
+        self.stats["spec_iterations"] += 1
+        for slot in active:
+            req = self._slot_req[slot]
+            took = 0
+            for i in range(int(m[slot])):
+                if req.done or len(req.out) >= req.max_new:
+                    break
+                tok = int(outs[slot, i])
+                req.out.append(tok)
+                took += 1
+                if req.eos is not None and tok == req.eos:
+                    req.done = True
+            self.stats["spec_drafted"] += g
+            # tokens beyond the first are the speculation win: they
+            # would each have cost a dispatch under chunk=1 decode
+            self.stats["spec_accepted"] += max(0, took - 1)
+            self._retire_if_done(slot, req)
+
+    @staticmethod
+    def _draft_tokens(req: _Request, g: int) -> np.ndarray:
+        """Prompt-lookup drafting: find the last earlier occurrence of
+        the stream's trailing n-gram (n=3,2,1) in its own history and
+        propose the g tokens that followed it (padded by repetition).
+        Model-free — correctness never depends on draft quality, only
+        the acceptance rate does."""
+        hist = np.concatenate(
+            [req.prompt, np.asarray(req.out, np.int32)])
+        for n in (3, 2, 1):
+            if len(hist) <= n:
+                continue
+            pat = hist[-n:]
+            windows = np.lib.stride_tricks.sliding_window_view(
+                hist[:-1], n)
+            hits = np.flatnonzero((windows == pat).all(1))
+            if len(hits):
+                i = int(hits[-1])
+                cont = hist[i + n:i + n + g]
+                out = np.full(g, int(cont[-1]), np.int32)
+                out[:len(cont)] = cont
+                return out
+        return np.full(g, int(hist[-1]), np.int32)
+
     def _retire_if_done(self, slot: int, req: _Request) -> None:
         # both append sites stop at an eos token immediately, so eos can
         # only ever be the LAST element — no truncation needed
@@ -328,3 +456,11 @@ class LMEngine:
             self.stats["tokens_out"] += len(req.out)
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None
+            if req.temperature > 0.0:
+                # restore greedy defaults so a finished sampled stream
+                # doesn't keep the all-greedy fast path (and the
+                # speculation gate) disabled for the slots that remain
+                sl = jnp.int32(slot)
+                self._temp = _slot_insert(self._temp, jnp.float32(0.0), sl)
+                self._topk = _slot_insert(self._topk, jnp.int32(0), sl)
+                self._topp = _slot_insert(self._topp, jnp.float32(1.0), sl)
