@@ -1,0 +1,14 @@
+"""Make the repo root importable when examples run from a source
+checkout (``python examples/foo.py``): Python puts the SCRIPT's
+directory on sys.path — examples/, not the repo root — so
+``import nnstreamer_tpu`` fails unless the package is pip-installed.
+Importing this module (the script directory IS on sys.path) prepends
+the repo root; harmless no-op when the package is installed.
+"""
+
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

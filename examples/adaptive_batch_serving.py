@@ -11,6 +11,8 @@ latency.
     python examples/adaptive_batch_serving.py [--frames 400] [--batch 16]
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import argparse
 import sys
 import tempfile

@@ -3,6 +3,8 @@
     python examples/classify_stream.py [--frames 100] [--cpu]
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import argparse
 import sys
 import tempfile

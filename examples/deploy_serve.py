@@ -7,6 +7,8 @@ source, no zoo access, no checkpoint surgery at serving time.
 Run: python examples/deploy_serve.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import os
 import tempfile
 

@@ -10,6 +10,8 @@ subscribe too.
 Run: python examples/mqtt_fanout.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import time
 
 import numpy as np

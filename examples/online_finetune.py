@@ -4,6 +4,8 @@ and a tensor_trainer; trained params hot-swap into the server periodically.
     python examples/online_finetune.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import numpy as np
 
 from nnstreamer_tpu.core import Caps, TensorsConfig, TensorsInfo

@@ -7,6 +7,8 @@ both to 1 for the reference's strict synchronous per-buffer semantics).
     python examples/remote_offload.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import time
 
 import numpy as np

@@ -1,0 +1,74 @@
+"""Continuous-batching LM serving: mixed decoding modes in one engine.
+
+Submits greedy, sampled (temperature/top-k/nucleus), and EOS-bounded
+requests to one `serving.LMEngine`; all streams multiplex into a single
+compiled batched decode step, and sampled streams are reproducible
+(seeded) regardless of what shares the batch. A second engine with
+`spec_draft` shows prompt-lookup speculative decoding accepting multiple
+tokens per dispatch on repetitive text with greedy output unchanged.
+
+    python examples/serve_lm.py [--cpu]
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax
+
+    from nnstreamer_tpu.models import causal_lm
+    from nnstreamer_tpu.serving import LMEngine
+
+    V, D, H, L, MAXLEN = 128, 64, 4, 2, 128
+    params = causal_lm.init_causal_lm(
+        jax.random.PRNGKey(0), V, D, H, L, MAXLEN)
+
+    eng = LMEngine(params, n_heads=H, max_len=MAXLEN, n_slots=4, chunk=8)
+    rng = np.random.default_rng(0)
+    rids = {
+        "greedy": eng.submit(rng.integers(0, V, 12), max_new=16),
+        "sampled t=1.0": eng.submit(
+            rng.integers(0, V, 9), max_new=16, temperature=1.0, seed=7),
+        "nucleus p=0.9": eng.submit(
+            rng.integers(0, V, 5), max_new=16, temperature=1.2,
+            top_p=0.9, seed=8),
+        "top-k 16": eng.submit(
+            rng.integers(0, V, 7), max_new=16, temperature=0.8,
+            top_k=16, seed=9),
+    }
+    results = eng.run()
+    for name, rid in rids.items():
+        print(f"{name:14s} -> {results[rid]}")
+    print("engine stats:", {k: v for k, v in eng.stats.items()
+                            if not k.startswith("spec")})
+
+    # speculative decoding on repetitive text: greedy output unchanged,
+    # multiple tokens accepted per dispatch
+    rep = np.array([5, 9, 2, 7] * 4, np.int32)
+    plain = LMEngine(params, n_heads=H, max_len=MAXLEN, n_slots=1)
+    spec = LMEngine(params, n_heads=H, max_len=MAXLEN, n_slots=1,
+                    spec_draft=4)
+    a = plain.submit(rep, max_new=24)
+    b = spec.submit(rep, max_new=24)
+    assert plain.run()[a] == spec.run()[b], "speculation changed output"
+    st = spec.stats
+    print(f"speculative: identical greedy output; "
+          f"{st['spec_accepted']} drafts accepted over "
+          f"{st['spec_iterations']} iterations "
+          f"(acceptance {st['spec_accepted'] / max(1, st['spec_drafted']):.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,8 @@ pipeline string (paths aside) for one backend family:
 Run:  python examples/serve_reference_models.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import os
 import sys
 import tempfile

@@ -8,6 +8,8 @@ app: the sink's logits pick the next token pushed into appsrc.
     python examples/streaming_generate.py [--tokens 24] [--cpu]
 """
 
+import _bootstrap  # noqa: F401  (repo-root import shim for source checkouts)
+
 import argparse
 import sys
 
