@@ -16,6 +16,7 @@ from .stages import (
     shard_stage_params,
     stack_stage_params,
 )
+from .tp_decode import make_tp_generate, tp_shard_cache, tp_shard_params
 from .train import (
     cross_entropy_loss,
     make_sharded_infer_step,
@@ -32,4 +33,5 @@ __all__ = [
     "init_moe_params", "make_expert_parallel_moe", "moe_apply",
     "moe_shardings",
     "restore_sharded_state", "save_sharded_state",
+    "make_tp_generate", "tp_shard_cache", "tp_shard_params",
 ]

@@ -1,0 +1,85 @@
+"""Tensor-parallel KV-cache decode (parallel/tp_decode.py).
+
+Exactness vs the single-device decode loop on the virtual 8-device CPU
+mesh: greedy tokens must match token-for-token (logits only to float
+tolerance — psum reduction order differs from a fused matmul), with the
+cache prefilled on one device and resharded head-major.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nnstreamer_tpu.models import causal_lm
+from nnstreamer_tpu.parallel.tp_decode import (
+    make_tp_generate, tp_shard_cache, tp_shard_params)
+
+V, D, H, L, MAXLEN = 89, 64, 8, 3, 96
+
+
+@pytest.fixture(scope="module")
+def params():
+    return causal_lm.init_causal_lm(
+        jax.random.PRNGKey(11), V, D, H, L, MAXLEN)
+
+
+def _single_device_generate(params, prompt, n_steps):
+    logits, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    first = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    toks, tok = [], first
+    for _ in range(n_steps):
+        lg, kc, vc, pos = causal_lm.lm_decode_step(
+            params, tok, kc, vc, pos, H)
+        tok = jnp.argmax(lg, -1)[:, None].astype(jnp.int32)
+        toks.append(np.asarray(tok[:, 0]))
+    return first, np.stack(toks, 1)  # (B, n_steps)
+
+
+@pytest.mark.parametrize("n_model", [2, 4, 8])
+def test_tp_decode_matches_single_device(params, n_model):
+    if len(jax.devices()) < n_model:
+        pytest.skip("needs virtual multi-device CPU")
+    mesh = Mesh(np.array(jax.devices()[:n_model]), ("model",))
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, V, (2, 13)).astype(np.int32)
+    first, want = _single_device_generate(params, prompt, 20)
+
+    logits, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    tp = tp_shard_params(params, H, mesh)
+    kc_tp, vc_tp = tp_shard_cache(kc, vc, L, 2, H, mesh)
+    gen = make_tp_generate(H, MAXLEN, mesh)
+    got = np.asarray(gen(tp, first, kc_tp, vc_tp, pos, 20))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_requires_divisible_heads(params):
+    if len(jax.devices()) < 3:
+        pytest.skip("needs virtual multi-device CPU")
+    mesh = Mesh(np.array(jax.devices()[:3]), ("model",))
+    with pytest.raises(ValueError):
+        tp_shard_params(params, H, mesh)  # 8 % 3 != 0
+
+
+def test_tp_generate_is_one_executable_per_length(params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs virtual multi-device CPU")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    prompt = np.arange(6, dtype=np.int32)[None]
+    logits, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    first = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    tp = tp_shard_params(params, H, mesh)
+    gen = make_tp_generate(H, MAXLEN, mesh)
+    outs = []
+    for _ in range(2):  # second call hits the compiled cache
+        kc_tp, vc_tp = tp_shard_cache(kc, vc, L, 1, H, mesh)
+        outs.append(np.asarray(gen(tp, first, kc_tp, vc_tp, pos, 8)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    assert len(gen.compiled) == 1  # one executable per distinct n_steps
+    with pytest.raises(ValueError):  # overflow is loud, not NaN-argmax
+        gen(tp, first, kc_tp, vc_tp, pos, MAXLEN + 1)
