@@ -116,6 +116,67 @@ def tp_shard_cache(kcache: jax.Array, vcache: jax.Array, n_layers: int,
             jax.device_put(relayout(vcache), dev))
 
 
+def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
+                  max_len: int, axis: str):
+    """One TP decode step on one device shard — the per-layer math BOTH
+    TP consumers share (`make_tp_generate` here and
+    `serving/tp_engine.py`'s chunk kernel), so the mask/psum/cache
+    semantics live in exactly one place.
+
+    tok (B, 1) int32; kc/vc (L, B, hn, max_len, hd) = this device's
+    head shard; p scalar position. tp carries the per-device weight
+    slices (leading device axis already stripped). Returns
+    (logits (B, vocab) — replicated post-psum, kc', vc')."""
+    wq, wk, wv = tp["wq"], tp["wk"], tp["wv"]
+    wo, w1, w2 = tp["wo"], tp["w1"], tp["w2"]
+    L, D = wq.shape[0], wq.shape[1]
+    hd = D // n_heads
+    b = tok.shape[0]
+    x = tp["embed"][tok[:, 0]][:, None, :] + \
+        tp["pos_embed"][p][None, None, :]
+    live = (jnp.arange(max_len) <= p)[None, None, None, :]
+
+    def block(carry, layer):
+        h, kc, vc = carry
+        wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2, li = layer
+        a = _ln(h, ln1)
+        # local heads only: (B, hn, 1, hd)
+        q = (a @ wq_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        k = (a @ wk_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        v = (a @ wv_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        # write this step's K/V at column p: update (1, B, hn, 1, hd)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k.transpose(0, 2, 1, 3)[None]
+            .transpose(0, 1, 3, 2, 4), (li, 0, 0, p, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.transpose(0, 2, 1, 3)[None]
+            .transpose(0, 1, 3, 2, 4), (li, 0, 0, p, 0))
+        kc_l = jax.lax.dynamic_index_in_dim(
+            kc, li, 0, keepdims=False)        # (B, hn, M, hd)
+        vc_l = jax.lax.dynamic_index_in_dim(
+            vc, li, 0, keepdims=False)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc_l) / math.sqrt(hd)
+        s = jnp.where(live, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(s, axis=-1), vc_l)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, hn * hd)
+        # the Megatron pair: partial attention-out and MLP products
+        # reduce across the model axis
+        h = h + jax.lax.psum(o @ wo_l, axis)
+        m = _ln(h, ln2)
+        mlp = jax.lax.psum(jax.nn.gelu(m @ w1_l) @ w2_l, axis)
+        return (h + mlp, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        block, (x, kc, vc),
+        (wq, wk, wv, wo, w1, w2, tp["ln1"], tp["ln2"],
+         jnp.arange(L, dtype=jnp.int32)),
+        unroll=True)
+    logits = (_ln(x, tp["lnf"]) @ tp["embed"].T)[:, 0]
+    logits = jnp.where(p >= max_len, jnp.nan, logits)
+    return logits, kc, vc
+
+
 def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
                      axis: str = "model"):
     """Build a TP greedy-generate callable: (tp_params, first_token
@@ -124,7 +185,9 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
 
     Each argmax feeds back on-device; the whole G-step loop is ONE
     compiled program per distinct n_steps (dispatch count does not grow
-    with G, matching the single-device decode lane's design)."""
+    with G, matching the single-device decode lane's design). The cache
+    arguments are DONATED — rebuild or re-shard them before calling
+    again (the sharded KV store updates in place, not by copy)."""
     n = mesh.shape[axis]
     hn = n_heads // n
 
@@ -132,64 +195,20 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
         def per_device(tp, tok0, kc, vc, pos):
             # sharded leaves arrive as the (1, ...) device slice;
             # replicated leaves arrive whole
-            wq, wk, wv = tp["wq"][0], tp["wk"][0], tp["wv"][0]
-            wo, w1, w2 = tp["wo"][0], tp["w1"][0], tp["w2"][0]
+            tp = {k: (tp[k][0] if k in _DEVICE_KEYS else tp[k])
+                  for k in tp}
             kc, vc = kc[0], vc[0]          # (L*B*hn, max_len, hd)
-            L, D = wq.shape[0], wq.shape[1]
-            hd = D // n_heads
+            L = tp["wq"].shape[0]
+            hd = tp["wq"].shape[1] // n_heads
             b = tok0.shape[0]
             kc = kc.reshape(L, b, hn, max_len, hd)
             vc = vc.reshape(L, b, hn, max_len, hd)
 
             def step(carry, _):
                 tok, kc, vc, p = carry
-                x = tp["embed"][tok[:, 0]][:, None, :] + \
-                    tp["pos_embed"][p][None, None, :]
-                live = (jnp.arange(max_len) <= p)[None, None, None, :]
-
-                def block(carry, layer):
-                    h, kc, vc = carry
-                    wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2, li = \
-                        layer
-                    a = _ln(h, ln1)
-                    # local heads only: (B, hn, 1, hd)
-                    q = (a @ wq_l).reshape(b, 1, hn, hd) \
-                        .transpose(0, 2, 1, 3)
-                    k = (a @ wk_l).reshape(b, 1, hn, hd) \
-                        .transpose(0, 2, 1, 3)
-                    v = (a @ wv_l).reshape(b, 1, hn, hd) \
-                        .transpose(0, 2, 1, 3)
-                    # write this step's K/V at column p: update shape
-                    # (1, b, hn, 1, hd) against cache (L, b, hn, M, hd)
-                    kc = jax.lax.dynamic_update_slice(
-                        kc, k[None], (li, 0, 0, p, 0))
-                    vc = jax.lax.dynamic_update_slice(
-                        vc, v[None], (li, 0, 0, p, 0))
-                    kc_l = jax.lax.dynamic_index_in_dim(
-                        kc, li, 0, keepdims=False)   # (b, hn, M, hd)
-                    vc_l = jax.lax.dynamic_index_in_dim(
-                        vc, li, 0, keepdims=False)
-                    s = jnp.einsum("bhqd,bhkd->bhqk", q,
-                                   kc_l) / math.sqrt(hd)
-                    s = jnp.where(live, s, -1e30)
-                    o = jnp.einsum("bhqk,bhkd->bhqd",
-                                   jax.nn.softmax(s, axis=-1), vc_l)
-                    o = o.transpose(0, 2, 1, 3).reshape(b, 1, hn * hd)
-                    # the Megatron pair: partial attention-out and MLP
-                    # products reduce across the model axis
-                    h = h + jax.lax.psum(o @ wo_l, axis)
-                    m = _ln(h, ln2)
-                    mlp = jax.lax.psum(
-                        jax.nn.gelu(m @ w1_l) @ w2_l, axis)
-                    return (h + mlp, kc, vc), None
-
-                (x, kc, vc), _ = jax.lax.scan(
-                    block, (x, kc, vc),
-                    (wq, wk, wv, wo, w1, w2, tp["ln1"], tp["ln2"],
-                     jnp.arange(L, dtype=jnp.int32)),
-                    unroll=True)
-                logits = (_ln(x, tp["lnf"]) @ tp["embed"].T)[:, 0]
-                logits = jnp.where(p >= max_len, jnp.nan, logits)
+                logits, kc, vc = tp_token_step(
+                    tp, tok, kc, vc, p, n_heads=n_heads, hn=hn,
+                    max_len=max_len, axis=axis)
                 nxt = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
                 return (nxt, kc, vc, p + 1), nxt[:, 0]
 
@@ -202,7 +221,8 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
                     | {k: P() for k in _REPL_KEYS},
                     P(), P(axis), P(axis), P())
         return jax.jit(_shard_map(per_device, mesh,
-                                  in_specs=in_specs, out_specs=P()))
+                                  in_specs=in_specs, out_specs=P()),
+                       donate_argnums=(2, 3))
 
     compiled: Dict[int, Any] = {}
 

@@ -9,5 +9,6 @@ multiplexed into one compiled batched decode step.
 
 from . import sampling
 from .lm_engine import LMEngine, next_pow2_bucket
+from .tp_engine import TPLMEngine
 
-__all__ = ["LMEngine", "next_pow2_bucket", "sampling"]
+__all__ = ["LMEngine", "TPLMEngine", "next_pow2_bucket", "sampling"]

@@ -199,11 +199,11 @@ class LMEngine:
             lambda n: min(next_pow2_bucket(n), max_len))
         L = params["wqkv"].shape[0]
         hd = params["embed"].shape[1] // n_heads
-        flat = L * n_heads
-        # device-resident slot state (leading axis = slot)
+        # device-resident slot state (leading axis = slot); cache
+        # allocation is a hook so a mesh-sharded engine never
+        # materializes the unsharded stores (serving/tp_engine.py)
         self._tokens = jnp.zeros((n_slots, 1, 1), jnp.int32)
-        self._kc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
-        self._vc = jnp.zeros((n_slots, flat, max_len, hd), jnp.float32)
+        self._kc, self._vc = self._alloc_slot_caches(L, hd)
         self._pos = jnp.zeros((n_slots, 1), jnp.int32)
         # per-slot sampling controls (traced values — greedy and sampled
         # streams share one executable; see serving/sampling.py)
@@ -229,6 +229,13 @@ class LMEngine:
                       "tokens_out": 0, "wall_s": 0.0,
                       "spec_iterations": 0, "spec_drafted": 0,
                       "spec_accepted": 0}
+
+    def _alloc_slot_caches(self, n_layers: int, hd: int):
+        """Zero per-slot KV stores, (S, L·H, max_len, hd). Overridden by
+        the mesh-sharded engine to allocate sharded-from-birth."""
+        shape = (self.n_slots, n_layers * self.n_heads, self.max_len, hd)
+        return (jnp.zeros(shape, jnp.float32),
+                jnp.zeros(shape, jnp.float32))
 
     # -- public API ------------------------------------------------------- #
 
@@ -300,15 +307,9 @@ class LMEngine:
             skey = sampling.seed_key(req.seed)
             temp = jnp.float32(req.temperature)
             tk, tp = jnp.int32(req.top_k), jnp.float32(req.top_p)
-            first, kc, vc, pos = _prefill_admit(
-                self.params, jnp.asarray(padded), jnp.int32(t),
-                skey, temp, tk, tp,
-                n_heads=self.n_heads, max_len=self.max_len)
+            first = self._prefill_into(slot, padded, t, skey, temp, tk, tp)
             self.stats["prefills"] += 1
             sl = jnp.int32(slot)
-            self._kc = _slot_insert(self._kc, kc, sl)
-            self._vc = _slot_insert(self._vc, vc, sl)
-            self._pos = _slot_insert(self._pos, pos, sl)
             self._tokens = _slot_insert(
                 self._tokens, first.reshape(1, 1), sl)
             self._skeys = _slot_insert(self._skeys, skey, sl)
@@ -319,6 +320,21 @@ class LMEngine:
             self._pos_host[slot] = t
             self._slot_req[slot] = req
             self._retire_if_done(slot, req)
+
+    def _prefill_into(self, slot: int, padded, true_len: int, skey,
+                      temp, tk, tp):
+        """Prefill one padded prompt and install its cache into ``slot``;
+        returns the first generated token. The device-layout hook a
+        mesh-sharded engine overrides (serving/tp_engine.py)."""
+        first, kc, vc, pos = _prefill_admit(
+            self.params, jnp.asarray(padded), jnp.int32(true_len),
+            skey, temp, tk, tp,
+            n_heads=self.n_heads, max_len=self.max_len)
+        sl = jnp.int32(slot)
+        self._kc = _slot_insert(self._kc, kc, sl)
+        self._vc = _slot_insert(self._vc, vc, sl)
+        self._pos = _slot_insert(self._pos, pos, sl)
+        return first
 
     def _decode(self) -> None:
         active = [s for s, r in enumerate(self._slot_req) if r is not None]
@@ -357,12 +373,7 @@ class LMEngine:
             # one per tail length (full-size chunks keep the user's
             # exact value, whatever it is)
             n = 1 << (n.bit_length() - 1)
-        self._tokens, self._kc, self._vc, self._pos, outs = \
-            _decode_chunk(self.params, self._tokens, self._kc,
-                          self._vc, self._pos, self._skeys,
-                          self._temp, self._topk, self._topp,
-                          n_heads=self.n_heads, n_steps=n)
-        outs = np.asarray(outs)  # (S, n)
+        outs = np.asarray(self._run_chunk(n))  # (S, n)
         for s in range(self.n_slots):
             self._pos_host[s] += n  # device pos advances for EVERY slot
         self.stats["decode_steps"] += n
@@ -383,6 +394,17 @@ class LMEngine:
         # slot-steps spent by empty slots decoding garbage
         self.stats["wasted_slot_steps"] += n * (
             self.n_slots - len(active))
+
+    def _run_chunk(self, n: int):
+        """Run ``n`` decode steps over all slots, updating the carried
+        device state; returns the (S, n) generated tokens. The second
+        device-layout hook a mesh-sharded engine overrides."""
+        self._tokens, self._kc, self._vc, self._pos, outs = \
+            _decode_chunk(self.params, self._tokens, self._kc,
+                          self._vc, self._pos, self._skeys,
+                          self._temp, self._topk, self._topp,
+                          n_heads=self.n_heads, n_steps=n)
+        return outs
 
     def _decode_speculative(self, active: List[int]) -> None:
         """One speculative iteration: host-drafted prompt-lookup tokens

@@ -1,0 +1,195 @@
+"""Distributed continuous batching: the slot engine over a TP mesh.
+
+`TPLMEngine` keeps `LMEngine`'s scheduler — queues, slots, chunking,
+admission, retirement, sampling controls — and swaps the two device
+kernels for mesh-sharded ones: the per-slot KV caches shard by
+attention head over the mesh's model axis (`parallel/tp_decode.py`
+layout), and each decode-chunk step runs the shared TP token step
+(`tp_token_step` — one definition of the mask/psum/cache semantics for
+every TP consumer) inside one `shard_map` program vmapped over slots.
+A model whose serving cache exceeds one chip's HBM gets continuous
+batching across the slice with the SAME outputs: greedy and sampled
+streams match the single-device engine token-for-token (sampling runs
+on the replicated psum'd logits with the same fold_in(seed, consumed)
+keys, so the key schedule never sees the mesh).
+
+Executable sharing follows the module-level-kernel convention stated in
+lm_engine.py: the chunk/relayout kernels are built by lru_cached
+module functions keyed on (mesh, axis, shapes), so a second engine over
+the same mesh and model shapes compiles nothing, and the sharded KV
+stores are donated through each chunk (in-place update, no copy).
+
+v1 scope decisions:
+- prefill runs REPLICATED (every device computes the full prompt
+  forward; the resulting cache reshards head-major once per admission).
+  Real deployments would TP the prefill too; admission cost here is
+  one wasted forward per non-primary device, while the steady-state
+  decode loop — where serving time goes — is fully sharded.
+- speculative decoding is not composed with the mesh yet
+  (spec_draft raises).
+
+The reference has no distributed serving of any kind (SURVEY §2.3/§2.5:
+stateless per-buffer invokes + TCP offload of whole buffers).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring import _shard_map
+from ..parallel.tp_decode import (
+    _DEVICE_KEYS, _REPL_KEYS, tp_shard_params, tp_token_step)
+from . import sampling
+from .lm_engine import LMEngine, _prefill_admit, _slot_insert
+
+__all__ = ["TPLMEngine"]
+
+
+@functools.lru_cache(maxsize=None)
+def _relayout_fn(mesh: Mesh, axis: str, n_layers: int, hn: int,
+                 max_len: int, hd: int):
+    """flat (L*H, M, hd) single-device cache → head-major TP layout
+    (n, L*hn, M, hd); the out_sharding materializes the reshard once."""
+    n = mesh.shape[axis]
+    out_sh = NamedSharding(mesh, P(axis))
+
+    @functools.partial(jax.jit, out_shardings=(out_sh, out_sh))
+    def to_tp(kc, vc):
+        def rl(c):
+            c = c.reshape(n_layers, n, hn, max_len, hd)
+            return c.transpose(1, 0, 2, 3, 4).reshape(
+                n, n_layers * hn, max_len, hd)
+
+        return rl(kc), rl(vc)
+
+    return to_tp
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
+              n_steps: int):
+    """Build the jitted TP decode-chunk executable for these shapes —
+    shared by every TPLMEngine over the same mesh/model geometry."""
+    n = mesh.shape[axis]
+    hn = n_heads // n
+
+    def per_device(tp, tokens, kc, vc, pos, skeys, temp, topk, topp):
+        tp = {k: (tp[k][0] if k in _DEVICE_KEYS else tp[k]) for k in tp}
+        kc, vc = kc[:, 0], vc[:, 0]        # (S, L*hn, M, hd)
+        L = tp["wq"].shape[0]
+        hd = tp["wq"].shape[1] // n_heads
+        S = tokens.shape[0]
+        kc = kc.reshape(S, L, 1, hn, max_len, hd)
+        vc = vc.reshape(S, L, 1, hn, max_len, hd)
+
+        def slot_step(tok, kc_s, vc_s, p):
+            # tok (1, 1); kc_s (L, 1, hn, M, hd); psums ride vmap
+            logits, kc_s, vc_s = tp_token_step(
+                tp, tok, kc_s, vc_s, jnp.asarray(p).reshape(()),
+                n_heads=n_heads, hn=hn, max_len=max_len, axis=axis)
+            return logits[0], kc_s, vc_s, (p.reshape(()) + 1).reshape(1)
+
+        def one(carry, _):
+            tokens, kc, vc, pos = carry
+            logits, kc, vc, pos = jax.vmap(slot_step)(
+                tokens, kc, vc, pos)
+            # logits (S, V) are replicated (post-psum identical on
+            # every device) — sampling/argmax therefore agree too
+
+            def sampled(lg):
+                keys = sampling.step_keys(skeys, pos[:, 0])
+                return sampling.sample_logits(lg, keys, temp, topk, topp)
+
+            def greedy(lg):
+                return jnp.argmax(lg, -1).astype(jnp.int32)
+
+            nxt = jax.lax.cond(
+                jnp.all(temp <= 0.0), greedy, sampled, logits)
+            return (nxt[:, None, None], kc, vc, pos), nxt
+
+        (tokens, kc, vc, pos), outs = jax.lax.scan(
+            one, (tokens, kc, vc, pos), None, length=n_steps)
+        kc = kc.reshape(S, 1, L * hn, max_len, hd)
+        vc = vc.reshape(S, 1, L * hn, max_len, hd)
+        return tokens, kc, vc, pos, outs.T
+
+    spec_dev = P(None, axis)
+    in_specs = ({k: P(axis) for k in _DEVICE_KEYS}
+                | {k: P() for k in _REPL_KEYS},
+                P(), spec_dev, spec_dev, P(), P(), P(), P(), P())
+    out_specs = (P(), spec_dev, spec_dev, P(), P())
+    return jax.jit(_shard_map(per_device, mesh, in_specs=in_specs,
+                              out_specs=out_specs),
+                   donate_argnums=(1, 2, 3, 4))
+
+
+class TPLMEngine(LMEngine):
+    """Continuous-batching engine with the KV cache head-sharded over
+    ``mesh[axis]``. Same public API and outputs as `LMEngine`."""
+
+    def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
+                 mesh: Mesh, axis: str = "model", **kw) -> None:
+        if kw.get("spec_draft"):
+            raise NotImplementedError(
+                "speculative decoding is not composed with the TP mesh "
+                "yet — use spec_draft=0 (default)")
+        n = mesh.shape[axis]
+        if n_heads % n:
+            raise ValueError(f"n_heads={n_heads} not divisible by "
+                             f"mesh axis {axis}={n}")
+        # set before super().__init__: _alloc_slot_caches reads these
+        self.mesh, self.axis, self._n = mesh, axis, n
+        super().__init__(params, n_heads, max_len, **kw)
+        self._tp = tp_shard_params(params, n_heads, mesh, axis)
+        # replicated full params for the prefill path
+        rep = NamedSharding(mesh, P())
+        self.params = jax.device_put(params, rep)
+        for name in ("_tokens", "_pos", "_skeys", "_temp", "_topk",
+                     "_topp"):
+            setattr(self, name, jax.device_put(
+                np.asarray(getattr(self, name)), rep))
+
+    # -- device-layout hooks ---------------------------------------------- #
+
+    def _alloc_slot_caches(self, n_layers: int, hd: int):
+        # sharded from birth: the unsharded (S, L*H, M, hd) zeros the
+        # base class would allocate may not FIT one device in the
+        # regime this engine exists for
+        hn = self.n_heads // self._n
+        shape = (self.n_slots, self._n, n_layers * hn, self.max_len, hd)
+        dev = NamedSharding(self.mesh, P(None, self.axis))
+        zero = functools.partial(jnp.zeros, dtype=jnp.float32)
+        return (jax.device_put(zero(shape), dev),
+                jax.device_put(zero(shape), dev))
+
+    def _prefill_into(self, slot, padded, true_len, skey, temp, tk, tp):
+        first, kc, vc, pos = _prefill_admit(
+            self.params, jnp.asarray(padded), jnp.int32(true_len),
+            skey, temp, tk, tp,
+            n_heads=self.n_heads, max_len=self.max_len)
+        L = self.params["wqkv"].shape[0]
+        hd = self.params["embed"].shape[1] // self.n_heads
+        kc_tp, vc_tp = _relayout_fn(
+            self.mesh, self.axis, L, self.n_heads // self._n,
+            self.max_len, hd)(kc, vc)
+        sl = jnp.int32(slot)
+        self._kc = _slot_insert(self._kc, kc_tp, sl)
+        self._vc = _slot_insert(self._vc, vc_tp, sl)
+        self._pos = _slot_insert(self._pos, pos, sl)
+        return first
+
+    def _run_chunk(self, n_steps: int):
+        with jax.default_matmul_precision("float32"):
+            self._tokens, self._kc, self._vc, self._pos, outs = \
+                _chunk_fn(self.mesh, self.axis, self.n_heads,
+                          self.max_len, n_steps)(
+                    self._tp, self._tokens, self._kc, self._vc,
+                    self._pos, self._skeys, self._temp, self._topk,
+                    self._topp)
+        return outs
