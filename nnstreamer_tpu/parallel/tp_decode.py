@@ -95,6 +95,17 @@ def tp_shard_params(params: Dict[str, jax.Array], n_heads: int,
     return out
 
 
+def head_major_relayout(c, n_layers: int, batch: int, n: int, hn: int):
+    """Flat single-device cache (L·B·H, M, hd) → head-major TP layout
+    (n, L·B·hn, M, hd) — the ONE definition of the resharding transform
+    (works on numpy and jax arrays alike; `tp_shard_cache` and the TP
+    engine's jitted per-admission reshard both call it)."""
+    M, hd = c.shape[-2:]
+    c = c.reshape(n_layers, batch, n, hn, M, hd)
+    return c.transpose(2, 0, 1, 3, 4, 5).reshape(
+        n, n_layers * batch * hn, M, hd)
+
+
 def tp_shard_cache(kcache: jax.Array, vcache: jax.Array, n_layers: int,
                    batch: int, n_heads: int, mesh: Mesh,
                    axis: str = "model") -> Tuple[Any, Any]:
@@ -103,17 +114,12 @@ def tp_shard_cache(kcache: jax.Array, vcache: jax.Array, n_layers: int,
     (e.g. data-parallel over the same mesh), then decode head-sharded."""
     n = mesh.shape[axis]
     hn = n_heads // n
-    M, hd = np.asarray(kcache).shape[-2:]
-
-    def relayout(c):
-        c = np.asarray(c).reshape(n_layers, batch, n, hn, M, hd)
-        return np.ascontiguousarray(
-            c.transpose(2, 0, 1, 3, 4, 5)).reshape(
-                n, n_layers * batch * hn, M, hd)
-
     dev = NamedSharding(mesh, P(axis))
-    return (jax.device_put(relayout(kcache), dev),
-            jax.device_put(relayout(vcache), dev))
+    return tuple(
+        jax.device_put(
+            head_major_relayout(np.asarray(c), n_layers, batch, n, hn),
+            dev)
+        for c in (kcache, vcache))
 
 
 def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
@@ -145,12 +151,8 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         k = (a @ wk_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
         v = (a @ wv_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
         # write this step's K/V at column p: update (1, B, hn, 1, hd)
-        kc = jax.lax.dynamic_update_slice(
-            kc, k.transpose(0, 2, 1, 3)[None]
-            .transpose(0, 1, 3, 2, 4), (li, 0, 0, p, 0))
-        vc = jax.lax.dynamic_update_slice(
-            vc, v.transpose(0, 2, 1, 3)[None]
-            .transpose(0, 1, 3, 2, 4), (li, 0, 0, p, 0))
+        kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, 0, p, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, 0, p, 0))
         kc_l = jax.lax.dynamic_index_in_dim(
             kc, li, 0, keepdims=False)        # (B, hn, M, hd)
         vc_l = jax.lax.dynamic_index_in_dim(

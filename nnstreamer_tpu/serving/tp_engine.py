@@ -44,7 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.ring import _shard_map
 from ..parallel.tp_decode import (
-    _DEVICE_KEYS, _REPL_KEYS, tp_shard_params, tp_token_step)
+    _DEVICE_KEYS, _REPL_KEYS, head_major_relayout, tp_shard_params,
+    tp_token_step)
 from . import sampling
 from .lm_engine import LMEngine, _prefill_admit, _slot_insert
 
@@ -55,18 +56,15 @@ __all__ = ["TPLMEngine"]
 def _relayout_fn(mesh: Mesh, axis: str, n_layers: int, hn: int,
                  max_len: int, hd: int):
     """flat (L*H, M, hd) single-device cache → head-major TP layout
-    (n, L*hn, M, hd); the out_sharding materializes the reshard once."""
+    (n, L*hn, M, hd); the out_sharding materializes the reshard once.
+    The transform itself has ONE definition (head_major_relayout)."""
     n = mesh.shape[axis]
     out_sh = NamedSharding(mesh, P(axis))
 
     @functools.partial(jax.jit, out_shardings=(out_sh, out_sh))
     def to_tp(kc, vc):
-        def rl(c):
-            c = c.reshape(n_layers, n, hn, max_len, hd)
-            return c.transpose(1, 0, 2, 3, 4).reshape(
-                n, n_layers * hn, max_len, hd)
-
-        return rl(kc), rl(vc)
+        return (head_major_relayout(kc, n_layers, 1, n, hn),
+                head_major_relayout(vc, n_layers, 1, n, hn))
 
     return to_tp
 
