@@ -879,6 +879,76 @@ def _prefill_knee_lane(device) -> dict:
         return {}
 
 
+def _roofline_lane(device) -> dict:
+    """MXU-roofline prefill: what the framework reaches when the model
+    is actually MXU-shaped. The main lane's d1024 matmuls are small for
+    a 128x128 systolic array (each layer's biggest GEMM tile is
+    1024x4096 — utilization is capped by shape, not by the stack), so
+    this lane runs a wide config — d4096, 32 heads of head_dim 128
+    (exactly the TPU lane width), flash attention, bf16 — sized so one
+    dispatch carries ~40 TFLOP and the ~65 ms tunnel RTT floor is a
+    minor share (~20% at the measured 0.33 s step) instead of ~95%. The d1024 rows measure the small-model dispatch floor;
+    this row measures the compiled-program ceiling on the same stack
+    (same _lm_prefill code path, only the dims differ)."""
+    import traceback
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.utils import probes
+
+        V, D, H, L = 8192, 4096, 32, 6
+        B, T = 8, 2048
+        if device.platform == "cpu" and \
+                os.environ.get("BENCH_LM_ROOFLINE_FULL", "0") != "1":
+            V, D, H, L = 512, 256, 4, 2
+            B, T = 4, 256
+        use_flash = os.environ.get("BENCH_LM_FLASH", "1") != "0" \
+            and device.platform != "cpu"
+
+        # init+cast under one jit so each f32 leaf is freed after its
+        # bf16 cast (the f32 tree alone is ~5 GB at these dims)
+        @jax.jit
+        def init(key):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16),
+                causal_lm.init_causal_lm(key, V, D, H, L, T))
+
+        params = init(jax.random.PRNGKey(0))
+
+        @jax.jit
+        def score(p, tokens):
+            logits, _, _, _ = causal_lm._lm_prefill(
+                p, tokens, H, T, flash=use_flash)
+            # last-token argmax: D2H is B ints, same contract as the
+            # other prefill lanes
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+
+        rng = np.random.default_rng(7)
+        toks = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+        med = _timed(score, params, toks, reps=4)
+        flops = causal_lm.prefill_flops(B, T, D, L, V)
+        row = {
+            "transformer_roofline_config":
+                f"d{D} L{L} h{H} V{V} batch{B} seq{T} bf16 "
+                f"{'flash' if use_flash else 'dense'}",
+            "transformer_roofline_tokens_per_s": round(B * T / med, 1),
+            "transformer_roofline_tflops_per_dispatch":
+                round(flops / 1e12, 2),
+            "transformer_roofline_step_s_median": round(med, 4),
+        }
+        m = probes.mfu(flops, 1.0 / med, device)
+        if m:
+            row["transformer_roofline_mfu"] = round(m, 6)
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _serving_lane(device) -> dict:
     """Continuous-batching LM serving (serving/lm_engine.py) vs the
     static-batch baseline: the same mixed workload — varied prompt
@@ -1368,6 +1438,9 @@ def main() -> None:
             if os.environ.get("BENCH_LM_KNEE", "1") != "0":
                 _mark("prefill batch-knee lane starting")
                 result.update(_prefill_knee_lane(device))
+            if os.environ.get("BENCH_LM_ROOFLINE", "1") != "0":
+                _mark("MXU roofline lane starting")
+                result.update(_roofline_lane(device))
             if os.environ.get("BENCH_LM_SERVING", "1") != "0":
                 _mark("continuous-batching serving lane starting")
                 result.update(_serving_lane(device))
