@@ -943,6 +943,28 @@ def _roofline_lane(device) -> dict:
         if m:
             row["transformer_roofline_mfu"] = round(m, 6)
         _partial.update(row)
+
+        if os.environ.get("BENCH_LM_W8A8", "1") != "0":
+            # w8a8 point: same program shape, GEMMs on the MXU's int8
+            # double-rate path (ops/int8.py; v5e 394 TOPS vs 197 TFLOP/s
+            # bf16). score() retraces on the quantized pytree. The MFU
+            # field keeps the bf16-peak basis so the speedup is visible
+            # as a ratio; int8_util is the same time against the 2x peak
+            _mark("roofline w8a8 point starting")
+            qparams = jax.jit(causal_lm.quantize_lm_params)(params)
+            med_q = _timed(score, qparams, toks, reps=4)
+            row["transformer_roofline_w8a8_tokens_per_s"] = \
+                round(B * T / med_q, 1)
+            row["transformer_roofline_w8a8_step_s_median"] = round(med_q, 4)
+            row["transformer_roofline_w8a8_speedup_vs_bf16"] = \
+                round(med / med_q, 3)
+            mq = probes.mfu(flops, 1.0 / med_q, device)
+            if mq:
+                row["transformer_roofline_w8a8_mfu_bf16_basis"] = \
+                    round(mq, 6)
+                row["transformer_roofline_w8a8_int8_util"] = \
+                    round(mq / 2.0, 6)
+            _partial.update(row)
         return row
     except Exception:
         traceback.print_exc(file=sys.stderr)

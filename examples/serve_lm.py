@@ -67,6 +67,15 @@ def main() -> int:
           f"{st['spec_accepted']} drafts accepted over "
           f"{st['spec_iterations']} iterations "
           f"(acceptance {st['spec_accepted'] / max(1, st['spec_drafted']):.0%})")
+
+    # w8a8 int8 serving: the same engine over a quantized param tree —
+    # GEMMs run on the MXU's double-rate int8 path (ops/int8.py);
+    # greedy output tracks the float engine (drift is a few percent of
+    # logit scale, documented in docs/performance.md §5d′)
+    qparams = causal_lm.quantize_lm_params(params)
+    q = LMEngine(qparams, n_heads=H, max_len=MAXLEN, n_slots=2, chunk=8)
+    qrid = q.submit(rng.integers(0, V, 10), max_new=12)
+    print("w8a8 int8  ->", q.run()[qrid])
     return 0
 
 

@@ -254,15 +254,18 @@ class XLAFilter(FilterFramework):
         quant = opts.get("quant", "")
         if not quant:
             return bundle
-        if quant not in ("w8", "int8"):
+        if quant not in ("w8", "int8", "w8a8"):
             raise ValueError(f"xla-tpu: unknown quant mode {quant!r} "
-                             "(supported: w8)")
-        cached = bundle.metadata.get("_w8_bundle")
+                             "(supported: w8, w8a8)")
+        key = "_w8a8_bundle" if quant == "w8a8" else "_w8_bundle"
+        cached = bundle.metadata.get(key)
         if cached is None:
-            from ..models.quantize import quantize_bundle
+            from ..models.quantize import (quantize_bundle,
+                                           quantize_bundle_w8a8)
 
-            cached = quantize_bundle(bundle)
-            bundle.metadata["_w8_bundle"] = cached
+            cached = (quantize_bundle_w8a8(bundle) if quant == "w8a8"
+                      else quantize_bundle(bundle))
+            bundle.metadata[key] = cached
         return cached
 
     def _refresh_device(self) -> None:

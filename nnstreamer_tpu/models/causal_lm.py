@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import TensorsInfo
+from ..ops.int8 import matmul_any as _mm
+from ..ops.int8 import quantize_weight, stack_shape
 from .zoo import ModelBundle, register_model
 
 
@@ -57,6 +59,25 @@ def init_causal_lm(rng: jax.Array, vocab: int, d_model: int, n_heads: int,
         "ln2": jnp.ones((L, d_model)),
         "lnf": jnp.ones((d_model,)),
     }
+
+
+def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """w8a8 serving form of an LM param tree: the four GEMM stacks
+    (wqkv/wo/w1/w2) become int8 payloads + per-output-channel scales
+    (ops/int8.quantize_weight); embeddings and norms stay float. Every
+    execution form — forward, prefill (dense/flash/ring), decode step,
+    verify window, vmapped slots — consumes the quantized tree through
+    the same ``matmul_any`` sites, so this one transform turns the whole
+    family int8 with no flag-threading; the scanned layer stacks slice
+    into per-layer quantized dicts transparently. TPU v5e runs the int8
+    contractions at 2x the bf16 peak (docs/performance.md roofline).
+    Composes with the TP mesh: `parallel/tp_decode.tp_shard_params`
+    relayouts a quantized tree preserving the single-device grids, so
+    distributed int8 decode matches this path token-for-token."""
+    qp = dict(params)
+    for k in ("wqkv", "wo", "w1", "w2"):
+        qp[k] = quantize_weight(params[k])
+    return qp
 
 
 def _ln(x, scale):
@@ -94,7 +115,7 @@ def _block_body(h, layer, mask, n_heads, attention_fn=None):
     itself)."""
     wqkv, wo, w1, w2, ln1, ln2 = layer
     a = _ln(h, ln1)
-    q, k, v = jnp.split(a @ wqkv, 3, axis=-1)
+    q, k, v = jnp.split(_mm(a, wqkv), 3, axis=-1)
     qh, kh, vh = (_split_heads(z, n_heads) for z in (q, k, v))
     if attention_fn is not None:
         o = attention_fn(qh, kh, vh)
@@ -103,9 +124,9 @@ def _block_body(h, layer, mask, n_heads, attention_fn=None):
         s = jnp.where(mask, s, -1e30)
         o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vh)
     o = o.transpose(0, 2, 1, 3).reshape(h.shape)
-    h = h + o @ wo
+    h = h + _mm(o, wo)
     m = _ln(h, ln2)
-    return h + jax.nn.gelu(m @ w1) @ w2, kh, vh
+    return h + _mm(jax.nn.gelu(_mm(m, w1)), w2), kh, vh
 
 
 def _layer_stack(params):
@@ -165,7 +186,7 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
             "lm_prefill: true_len= (padded-prompt masking) is a "
             "dense-attention feature; the ring/flash paths apply "
             "causality internally and cannot see it")
-    n_layers = params["wqkv"].shape[0]
+    n_layers = stack_shape(params["wqkv"])[0]
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads
     x = params["embed"][tokens] + params["pos_embed"][:t][None]
@@ -295,7 +316,7 @@ def lm_verify_window(params: Dict[str, jax.Array], tokens: jax.Array,
 
 
 def _lm_verify_window(params, tokens, kcache, vcache, pos, n_heads):
-    n_layers = params["wqkv"].shape[0]
+    n_layers = stack_shape(params["wqkv"])[0]
     b, w = tokens.shape
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads
@@ -319,7 +340,7 @@ def _lm_verify_window(params, tokens, kcache, vcache, pos, n_heads):
         h, kc, vc = carry
         wqkv, wo, w1, w2, ln1, ln2, li = layer
         a = _ln(h, ln1)
-        q, k, v = jnp.split(a @ wqkv, 3, axis=-1)          # (B, W, D)
+        q, k, v = jnp.split(_mm(a, wqkv), 3, axis=-1)      # (B, W, D)
         q = _split_heads(q, n_heads)                       # (B, H, W, hd)
         k = _split_heads(k, n_heads)[None].astype(kc.dtype)
         v = _split_heads(v, n_heads)[None].astype(vc.dtype)
@@ -331,9 +352,9 @@ def _lm_verify_window(params, tokens, kcache, vcache, pos, n_heads):
         s = jnp.where(live, s, -1e30)
         o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vc_l)
         o = o.transpose(0, 2, 1, 3).reshape(h.shape)
-        h = h + o @ wo
+        h = h + _mm(o, wo)
         m = _ln(h, ln2)
-        return (h + jax.nn.gelu(m @ w1) @ w2, kc, vc), None
+        return (h + _mm(jax.nn.gelu(_mm(m, w1)), w2), kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(
         block, (x, kc, vc),

@@ -115,3 +115,34 @@ def quantize_bundle(bundle: ModelBundle,
                   # a fresh jit cache: the float bundle's compiled
                   # programs must not be reused for the tagged pytree
                   "_jit_cache": {}})
+
+
+def quantize_bundle_w8a8(bundle: ModelBundle) -> ModelBundle:
+    """Serving bundle on the MXU's int8 double-rate path (w8a8): int8
+    weights AND dynamically-quantized int8 activations, contracted in
+    exact int32 (ops/int8.py — 2x the bf16 peak on v5e).
+
+    Unlike weight-only ``quantize_bundle`` this needs the model's GEMM
+    sites instrumented (ops/int8.matmul_any), which the causal-LM family
+    is — so it applies to param trees with the LM's GEMM stacks. The
+    apply is UNCHANGED: matmul_any dispatches on the quantized leaves.
+    """
+    p = bundle.params
+    if p is None or not isinstance(p, dict) or \
+            not all(k in p for k in ("wqkv", "wo", "w1", "w2")):
+        raise ValueError(
+            "quant=w8a8 serves models whose GEMMs run through "
+            "ops/int8.matmul_any (the causal-LM family: zoo://causal_lm "
+            "param trees); use quant=w8 (weight-only) for arbitrary "
+            "bundles")
+    from .causal_lm import quantize_lm_params
+
+    qparams = quantize_lm_params(p)
+    return replace(
+        bundle,
+        name=f"{bundle.name}:w8a8",
+        params=qparams,
+        metadata={**bundle.metadata, "quantized": "w8a8",
+                  "params_nbytes": params_nbytes(qparams),
+                  "params_nbytes_f32": params_nbytes(bundle.params),
+                  "_jit_cache": {}})

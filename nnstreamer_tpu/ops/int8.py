@@ -1,0 +1,134 @@
+"""Dynamic-activation int8 matmul (w8a8) for the MXU's double-rate path.
+
+``models/quantize.py`` (``quant=w8``) is weight-ONLY: int8 weights are
+dequantized inside the program and the matmul itself runs bf16 — a
+bandwidth win, compute unchanged. This module is the compute-side
+complement: both operands are int8 and the contraction runs on the
+MXU's int8 path, which on TPU v5e is **2x the bf16 peak** (394 TOPS vs
+197 TFLOP/s; measured on this chip: ~326 TOPS vs ~176 TFLOP/s on an
+8192³ matmul chain — see docs/performance.md).
+
+Recipe (the standard dynamic-quant serving scheme):
+
+* weights: per-output-channel absmax int8, quantized ONCE at load
+  (`quantize_weight`) — same grid as quantize.py's w8;
+* activations: per-row (per-token) absmax int8, quantized dynamically
+  inside the program right before each GEMM (`quant_act`) — the
+  quantize/rescale elementwise work fuses around the dot;
+* accumulation: exact int32 (``preferred_element_type``), rescaled to
+  float by the outer product of the two scale vectors.
+
+Because int32 accumulation is EXACT (no float contraction-order drift),
+two execution forms that disagree only in how they batch the same GEMMs
+(prefill vs step decode, single-stream vs vmapped slots) produce
+bit-identical quantized GEMM results — the causal-LM family's
+exactness-between-forms contract survives quantization (pinned by
+tests/test_lm_w8a8.py).
+
+The reference serves quantized models through TFLite's int8 kernels
+(tensor_filter_tensorflow_lite.cc; mobilenet_*_quant.tflite test
+models); this is the TPU-idiomatic equivalent for the transformer
+serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: dict key tagging a w8a8-quantized weight leaf (int8 payload under the
+#: tag, f32 per-output-channel scales under "s") — a zero-collision
+#: marker the shared matmul sites dispatch on
+W8A8_TAG = "__w8a8__"
+
+
+def quantize_weight(w: Any) -> Dict[str, jax.Array]:
+    """(…, K, N) float weight → ``{W8A8_TAG: int8, "s": f32 (…, N)}``.
+
+    Per-output-channel absmax over the contracted axis K, the same grid
+    as quantize.py's weight-only path. Leading axes (e.g. a layer stack
+    L) pass through, so a scanned stack slices into per-layer dicts.
+    """
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise ValueError(f"quantize_weight: need rank>=2, got {w.shape}")
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return {W8A8_TAG: q, "s": scale.astype(jnp.float32)}
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and W8A8_TAG in w
+
+
+def stack_shape(w: Any) -> Tuple[int, ...]:
+    """Shape of a weight leaf, quantized or not (the int8 payload keeps
+    the float weight's shape, so introspection sites stay one-liners)."""
+    return w[W8A8_TAG].shape if is_quantized(w) else w.shape
+
+
+def quant_act(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row dynamic activation quant: (…, K) float → (int8, f32
+    (…, 1) scales). Rows are tokens at every call site, so each token
+    gets its own grid — the scheme's accuracy comes from this."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def int8_matmul(x: jax.Array, w: Dict[str, jax.Array]) -> jax.Array:
+    """x (…, K) float @ quantized w (K, N) → (…, N) in x's dtype.
+
+    int8·int8→int32 on the MXU's double-rate path; the surrounding
+    quant/rescale is elementwise and fuses."""
+    xq, xs = quant_act(x)
+    y = jax.lax.dot_general(
+        xq, w[W8A8_TAG], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y.astype(jnp.float32) * xs * w["s"]).astype(x.dtype)
+
+
+def quant_act_global(x: jax.Array, axis_name: str
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """`quant_act` for an activation whose logical row is COLUMN-SHARDED
+    across a mesh axis (each device holds a slice of the row): the
+    per-row absmax is taken locally then ``lax.pmax``-ed over the axis,
+    so every device quantizes its slice on the same GLOBAL grid — the
+    grid a single device would have used on the full row. This is what
+    makes a tensor-parallel int8 GEMM bit-identical to its single-device
+    form (parallel/tp_decode.py): same grid → same int8 codes → the
+    int32 partials psum exactly."""
+    xf = x.astype(jnp.float32)
+    absmax = jax.lax.pmax(
+        jnp.max(jnp.abs(xf), axis=-1, keepdims=True), axis_name)
+    s = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def int8_partial(xq: jax.Array, wq: jax.Array) -> jax.Array:
+    """Exact int32 partial products of a ROW-SHARDED int8 GEMM: this
+    device's slice of the contraction. The caller ``psum``s the int32
+    partials (integer addition — exact, no reduction-order drift) and
+    rescales with the global grids afterwards."""
+    return jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def matmul_any(x: jax.Array, w: Any) -> jax.Array:
+    """``x @ w`` that dispatches on the leaf: float weights take the
+    ordinary (bf16/f32) MXU path, w8a8 dicts take the int8 path. The
+    ONE matmul used by every causal-LM execution form, so passing a
+    `quantize_lm_params` tree through ANY of them — forward, prefill
+    (dense/flash/ring), decode step, verify window, vmapped slots —
+    serves int8 with zero flag-threading."""
+    if is_quantized(w):
+        return int8_matmul(x, w)
+    return x @ w

@@ -39,12 +39,47 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.causal_lm import _ln
+from ..ops.int8 import (W8A8_TAG, int8_partial, is_quantized, matmul_any,
+                        quant_act_global, stack_shape)
 from .ring import _shard_map
 
 __all__ = ["tp_shard_params", "tp_shard_cache", "make_tp_generate"]
 
 _DEVICE_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2")
 _REPL_KEYS = ("embed", "pos_embed", "ln1", "ln2", "lnf")
+#: global per-output-channel grids of the row-sharded int8 weights —
+#: replicated (they describe the FULL contraction, not a device slice)
+_QSCALE_KEYS = ("wo_s", "w2_s")
+
+
+def _col_shard(m: np.ndarray, n: int, chunk: int) -> np.ndarray:
+    """(L, K, n·chunk) → (n, L, K, chunk): contiguous column chunks per
+    device — the ONE definition of the column (head/MLP-up) slicing,
+    shared by the float and w8a8 relayouts."""
+    L, K, _ = m.shape
+    return np.ascontiguousarray(
+        m.reshape(L, K, n, chunk).transpose(2, 0, 1, 3))
+
+
+def _row_shard(m: np.ndarray, n: int, chunk: int) -> np.ndarray:
+    """(L, n·chunk, N) → (n, L, chunk, N): contiguous row chunks per
+    device (attention-out / MLP-down contractions)."""
+    L, _, N = m.shape
+    return np.ascontiguousarray(
+        m.reshape(L, n, chunk, N).transpose(1, 0, 2, 3))
+
+
+def _scale_shard(s: np.ndarray, n: int, chunk: int) -> np.ndarray:
+    """(L, n·chunk) per-output-channel scales → (n, L, chunk): the scale
+    slicing that mirrors _col_shard (a column keeps its grid)."""
+    L, _ = s.shape
+    return np.ascontiguousarray(s.reshape(L, n, chunk).transpose(1, 0, 2))
+
+
+def _mlp_chunk(F: int, n: int) -> int:
+    if F % n:
+        raise ValueError(f"d_ff={F} not divisible by {n} devices")
+    return F // n
 
 
 def _restructure(params: Dict[str, jax.Array], n_heads: int, n: int
@@ -53,28 +88,54 @@ def _restructure(params: Dict[str, jax.Array], n_heads: int, n: int
     per-device stacks (leading axis = device along the model axis)."""
     L, D, _ = params["wqkv"].shape
     hd = D // n_heads
-    hn = n_heads // n  # heads per device
+    hc = (n_heads // n) * hd  # columns/rows per device at head grain
     w = np.asarray(params["wqkv"])
-    q, k, v = w[:, :, :D], w[:, :, D:2 * D], w[:, :, 2 * D:]
+    fc = _mlp_chunk(params["w1"].shape[-1], n)
+    return {"wq": _col_shard(w[:, :, :D], n, hc),
+            "wk": _col_shard(w[:, :, D:2 * D], n, hc),
+            "wv": _col_shard(w[:, :, 2 * D:], n, hc),
+            "wo": _row_shard(np.asarray(params["wo"]), n, hc),
+            "w1": _col_shard(np.asarray(params["w1"]), n, fc),
+            "w2": _row_shard(np.asarray(params["w2"]), n, fc)}
 
-    def heads_cols(m):  # (L, D, D) → (n, L, D, hn*hd): columns by head
-        return np.ascontiguousarray(
-            m.reshape(L, D, n, hn * hd).transpose(2, 0, 1, 3))
 
-    wo = np.asarray(params["wo"])  # rows by head: (n, L, hn*hd, D)
-    wo_s = np.ascontiguousarray(
-        wo.reshape(L, n, hn * hd, D).transpose(1, 0, 2, 3))
-    F = params["w1"].shape[-1]
-    if F % n:
-        raise ValueError(f"d_ff={F} not divisible by {n} devices")
-    w1 = np.ascontiguousarray(                      # cols  (n, L, D, F/n)
-        np.asarray(params["w1"]).reshape(L, D, n, F // n)
-        .transpose(2, 0, 1, 3))
-    w2 = np.ascontiguousarray(                      # rows  (n, L, F/n, D)
-        np.asarray(params["w2"]).reshape(L, n, F // n, D)
-        .transpose(1, 0, 2, 3))
-    return {"wq": heads_cols(q), "wk": heads_cols(k),
-            "wv": heads_cols(v), "wo": wo_s, "w1": w1, "w2": w2}
+def _restructure_w8a8(qparams: Dict[str, Any], n_heads: int, n: int
+                      ) -> Dict[str, np.ndarray]:
+    """Head-major relayout of a `quantize_lm_params` tree, PRESERVING
+    the single-device quantization grids:
+
+    * column-sharded weights (wq/wk/wv/w1): slice int8 columns AND their
+      per-column scales — a column's grid is unchanged by slicing, so
+      each device's codes are exactly the single-device codes;
+    * row-sharded weights (wo/w2): slice int8 rows, but keep the GLOBAL
+      per-output-channel scales replicated (`wo_s`/`w2_s`) — partials
+      are summed in exact int32 across the axis, then rescaled on the
+      full-contraction grid.
+
+    With activations quantized on pmax-global grids (ops/int8.
+    quant_act_global), every GEMM is bit-identical to the single-device
+    w8a8 path — the TP exactness contract extends to int8.
+    """
+    qw, qs = np.asarray(qparams["wqkv"][W8A8_TAG]), \
+        np.asarray(qparams["wqkv"]["s"])
+    L, D, _ = qw.shape
+    hc = (n_heads // n) * (D // n_heads)
+    fc = _mlp_chunk(qparams["w1"][W8A8_TAG].shape[-1], n)
+
+    out: Dict[str, np.ndarray] = {}
+    for name, w, s in (("wq", qw[:, :, :D], qs[:, :D]),
+                       ("wk", qw[:, :, D:2 * D], qs[:, D:2 * D]),
+                       ("wv", qw[:, :, 2 * D:], qs[:, 2 * D:])):
+        out[name] = {W8A8_TAG: _col_shard(w, n, hc),
+                     "s": _scale_shard(s, n, hc)}
+    out["wo"] = _row_shard(np.asarray(qparams["wo"][W8A8_TAG]), n, hc)
+    out["wo_s"] = np.asarray(qparams["wo"]["s"])    # (L, D) global
+    out["w1"] = {
+        W8A8_TAG: _col_shard(np.asarray(qparams["w1"][W8A8_TAG]), n, fc),
+        "s": _scale_shard(np.asarray(qparams["w1"]["s"]), n, fc)}
+    out["w2"] = _row_shard(np.asarray(qparams["w2"][W8A8_TAG]), n, fc)
+    out["w2_s"] = np.asarray(qparams["w2"]["s"])    # (L, D) global
+    return out
 
 
 def tp_shard_params(params: Dict[str, jax.Array], n_heads: int,
@@ -85,11 +146,14 @@ def tp_shard_params(params: Dict[str, jax.Array], n_heads: int,
     n = mesh.shape[axis]
     if n_heads % n:
         raise ValueError(f"n_heads={n_heads} not divisible by {n}")
-    sharded = _restructure(params, n_heads, n)
+    quantized = is_quantized(params.get("wqkv"))
+    sharded = (_restructure_w8a8 if quantized else _restructure)(
+        params, n_heads, n)
     dev = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
-    out: Dict[str, Any] = {k: jax.device_put(v, dev)
-                           for k, v in sharded.items()}
+    out: Dict[str, Any] = {
+        k: jax.device_put(v, rep if k in _QSCALE_KEYS else dev)
+        for k, v in sharded.items()}
     for k in _REPL_KEYS:
         out[k] = jax.device_put(np.asarray(params[k]), rep)
     return out
@@ -135,21 +199,38 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
     (logits (B, vocab) — replicated post-psum, kc', vc')."""
     wq, wk, wv = tp["wq"], tp["wk"], tp["wv"]
     wo, w1, w2 = tp["wo"], tp["w1"], tp["w2"]
-    L, D = wq.shape[0], wq.shape[1]
+    L, D = stack_shape(wq)[0], stack_shape(wq)[1]
     hd = D // n_heads
     b = tok.shape[0]
+    # w8a8 trees carry the row-sharded weights' GLOBAL grids: column
+    # GEMMs go through matmul_any on single-device codes; row GEMMs
+    # psum exact int32 partials then rescale (see _restructure_w8a8)
+    quantized = "wo_s" in tp
     x = tp["embed"][tok[:, 0]][:, None, :] + \
         tp["pos_embed"][p][None, None, :]
     live = (jnp.arange(max_len) <= p)[None, None, None, :]
 
+    def _row_sharded_mm(g, w_l, s_l):
+        """g (B, 1, K_local) float @ int8 rows w_l (K_local, N) with the
+        replicated global grid s_l (N,): pmax-global activation codes,
+        exact int32 psum, then one rescale — bit-identical to the
+        single-device int8_matmul over the full contraction."""
+        gq, gs = quant_act_global(g, axis)
+        tot = jax.lax.psum(int8_partial(gq, w_l), axis)
+        return (tot.astype(jnp.float32) * gs * s_l).astype(g.dtype)
+
     def block(carry, layer):
         h, kc, vc = carry
-        wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2, li = layer
+        if quantized:
+            (wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2,
+             wo_s, w2_s, li) = layer
+        else:
+            wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2, li = layer
         a = _ln(h, ln1)
         # local heads only: (B, hn, 1, hd)
-        q = (a @ wq_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
-        k = (a @ wk_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
-        v = (a @ wv_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        q = matmul_any(a, wq_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        k = matmul_any(a, wk_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
+        v = matmul_any(a, wv_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
         # write this step's K/V at column p: update (1, B, hn, 1, hd)
         kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, 0, p, 0))
         vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, 0, p, 0))
@@ -164,16 +245,23 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         o = o.transpose(0, 2, 1, 3).reshape(b, 1, hn * hd)
         # the Megatron pair: partial attention-out and MLP products
         # reduce across the model axis
-        h = h + jax.lax.psum(o @ wo_l, axis)
-        m = _ln(h, ln2)
-        mlp = jax.lax.psum(jax.nn.gelu(m @ w1_l) @ w2_l, axis)
+        if quantized:
+            h = h + _row_sharded_mm(o, wo_l, wo_s)
+            m = _ln(h, ln2)
+            mlp = _row_sharded_mm(jax.nn.gelu(matmul_any(m, w1_l)),
+                                  w2_l, w2_s)
+        else:
+            h = h + jax.lax.psum(o @ wo_l, axis)
+            m = _ln(h, ln2)
+            mlp = jax.lax.psum(jax.nn.gelu(m @ w1_l) @ w2_l, axis)
         return (h + mlp, kc, vc), None
 
+    xs = [wq, wk, wv, wo, w1, w2, tp["ln1"], tp["ln2"]]
+    if quantized:
+        xs += [tp["wo_s"], tp["w2_s"]]
+    xs.append(jnp.arange(L, dtype=jnp.int32))
     (x, kc, vc), _ = jax.lax.scan(
-        block, (x, kc, vc),
-        (wq, wk, wv, wo, w1, w2, tp["ln1"], tp["ln2"],
-         jnp.arange(L, dtype=jnp.int32)),
-        unroll=True)
+        block, (x, kc, vc), tuple(xs), unroll=True)
     logits = (_ln(x, tp["lnf"]) @ tp["embed"].T)[:, 0]
     logits = jnp.where(p >= max_len, jnp.nan, logits)
     return logits, kc, vc
@@ -193,15 +281,16 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
     n = mesh.shape[axis]
     hn = n_heads // n
 
-    def build(n_steps: int):
+    def build(n_steps: int, quantized: bool):
         def per_device(tp, tok0, kc, vc, pos):
             # sharded leaves arrive as the (1, ...) device slice;
-            # replicated leaves arrive whole
-            tp = {k: (tp[k][0] if k in _DEVICE_KEYS else tp[k])
+            # replicated leaves (incl. the w8a8 global grids) whole
+            tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
+                      if k in _DEVICE_KEYS else tp[k])
                   for k in tp}
             kc, vc = kc[0], vc[0]          # (L*B*hn, max_len, hd)
-            L = tp["wq"].shape[0]
-            hd = tp["wq"].shape[1] // n_heads
+            L = stack_shape(tp["wq"])[0]
+            hd = stack_shape(tp["wq"])[1] // n_heads
             b = tok0.shape[0]
             kc = kc.reshape(L, b, hn, max_len, hd)
             vc = vc.reshape(L, b, hn, max_len, hd)
@@ -219,14 +308,16 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
                 None, length=n_steps)
             return toks.T  # (B, n_steps) — identical on every device
 
-        in_specs = ({k: P(axis) for k in _DEVICE_KEYS}
-                    | {k: P() for k in _REPL_KEYS},
-                    P(), P(axis), P(axis), P())
+        param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
+                       | {k: P() for k in _REPL_KEYS})
+        if quantized:
+            param_specs |= {k: P() for k in _QSCALE_KEYS}
+        in_specs = (param_specs, P(), P(axis), P(axis), P())
         return jax.jit(_shard_map(per_device, mesh,
                                   in_specs=in_specs, out_specs=P()),
                        donate_argnums=(2, 3))
 
-    compiled: Dict[int, Any] = {}
+    compiled: Dict[Any, Any] = {}
 
     def generate(tp_params, first_token, kc_tp, vc_tp, pos, n_steps: int):
         # eager capacity check: the compiled program can only NaN-poison
@@ -237,10 +328,12 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
             raise ValueError(
                 f"decode past cache capacity: pos={p0} + n_steps="
                 f"{n_steps} > max_len={max_len}")
-        if n_steps not in compiled:
-            compiled[n_steps] = build(n_steps)
+        quantized = "wo_s" in tp_params
+        key = (n_steps, quantized)
+        if key not in compiled:
+            compiled[key] = build(n_steps, quantized)
         with jax.default_matmul_precision("float32"):
-            return compiled[n_steps](
+            return compiled[key](
                 tp_params, first_token, kc_tp, vc_tp, pos)
 
     generate.compiled = compiled  # exposed for executable-count tests

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import causal_lm
+from ..ops.int8 import stack_shape
 from . import sampling
 
 
@@ -197,7 +198,7 @@ class LMEngine:
         self.spec_draft = spec_draft
         self._bucket = bucket or (
             lambda n: min(next_pow2_bucket(n), max_len))
-        L = params["wqkv"].shape[0]
+        L = stack_shape(params["wqkv"])[0]
         hd = params["embed"].shape[1] // n_heads
         # device-resident slot state (leading axis = slot); cache
         # allocation is a hook so a mesh-sharded engine never

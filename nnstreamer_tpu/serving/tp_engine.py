@@ -42,10 +42,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.int8 import stack_shape
 from ..parallel.ring import _shard_map
 from ..parallel.tp_decode import (
-    _DEVICE_KEYS, _REPL_KEYS, head_major_relayout, tp_shard_params,
-    tp_token_step)
+    _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS, head_major_relayout,
+    tp_shard_params, tp_token_step)
 from . import sampling
 from .lm_engine import LMEngine, _prefill_admit, _slot_insert
 
@@ -71,17 +72,18 @@ def _relayout_fn(mesh: Mesh, axis: str, n_layers: int, hn: int,
 
 @functools.lru_cache(maxsize=None)
 def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
-              n_steps: int):
+              n_steps: int, quantized: bool = False):
     """Build the jitted TP decode-chunk executable for these shapes —
     shared by every TPLMEngine over the same mesh/model geometry."""
     n = mesh.shape[axis]
     hn = n_heads // n
 
     def per_device(tp, tokens, kc, vc, pos, skeys, temp, topk, topp):
-        tp = {k: (tp[k][0] if k in _DEVICE_KEYS else tp[k]) for k in tp}
+        tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
+                  if k in _DEVICE_KEYS else tp[k]) for k in tp}
         kc, vc = kc[:, 0], vc[:, 0]        # (S, L*hn, M, hd)
-        L = tp["wq"].shape[0]
-        hd = tp["wq"].shape[1] // n_heads
+        L = stack_shape(tp["wq"])[0]
+        hd = stack_shape(tp["wq"])[1] // n_heads
         S = tokens.shape[0]
         kc = kc.reshape(S, L, 1, hn, max_len, hd)
         vc = vc.reshape(S, L, 1, hn, max_len, hd)
@@ -118,8 +120,11 @@ def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
         return tokens, kc, vc, pos, outs.T
 
     spec_dev = P(None, axis)
-    in_specs = ({k: P(axis) for k in _DEVICE_KEYS}
-                | {k: P() for k in _REPL_KEYS},
+    param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
+                   | {k: P() for k in _REPL_KEYS})
+    if quantized:
+        param_specs |= {k: P() for k in _QSCALE_KEYS}
+    in_specs = (param_specs,
                 P(), spec_dev, spec_dev, P(), P(), P(), P(), P())
     out_specs = (P(), spec_dev, spec_dev, P(), P())
     return jax.jit(_shard_map(per_device, mesh, in_specs=in_specs,
@@ -171,7 +176,7 @@ class TPLMEngine(LMEngine):
             self.params, jnp.asarray(padded), jnp.int32(true_len),
             skey, temp, tk, tp,
             n_heads=self.n_heads, max_len=self.max_len)
-        L = self.params["wqkv"].shape[0]
+        L = stack_shape(self.params["wqkv"])[0]
         hd = self.params["embed"].shape[1] // self.n_heads
         kc_tp, vc_tp = _relayout_fn(
             self.mesh, self.axis, L, self.n_heads // self._n,
@@ -186,7 +191,8 @@ class TPLMEngine(LMEngine):
         with jax.default_matmul_precision("float32"):
             self._tokens, self._kc, self._vc, self._pos, outs = \
                 _chunk_fn(self.mesh, self.axis, self.n_heads,
-                          self.max_len, n_steps)(
+                          self.max_len, n_steps,
+                          quantized="wo_s" in self._tp)(
                     self._tp, self._tokens, self._kc, self._vc,
                     self._pos, self._skeys, self._temp, self._topk,
                     self._topp)

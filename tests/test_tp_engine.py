@@ -53,6 +53,18 @@ def test_tp_engine_matches_single_device(params, mesh):
     assert got == want
 
 
+def test_tp_engine_w8a8_matches_single_device(params, mesh):
+    """Distributed int8 continuous batching: a w8a8 tree through the TP
+    engine equals the single-device int8 engine on the same mixed
+    greedy+sampled workload (grids preserved by _restructure_w8a8;
+    int32 partials psum exactly — tests/test_lm_w8a8.py pins the
+    underlying step)."""
+    qp = causal_lm.quantize_lm_params(params)
+    want = _workload(LMEngine(qp, H, MAXLEN, n_slots=3, chunk=4))
+    got = _workload(TPLMEngine(qp, H, MAXLEN, mesh, n_slots=3, chunk=4))
+    assert got == want
+
+
 def test_tp_engine_cache_is_sharded(params, mesh):
     eng = TPLMEngine(params, H, MAXLEN, mesh, n_slots=2, chunk=2)
     rid = eng.submit(np.arange(6, dtype=np.int32), max_new=6)
