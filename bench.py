@@ -717,6 +717,24 @@ def _decode_lane(params, n_heads, max_len, device) -> dict:
             dec_flops / (B * G), B * G / decode_s, device)
         if mfu_val:
             row["transformer_decode_mfu"] = round(mfu_val, 6)
+
+        if os.environ.get("BENCH_LM_W8A8", "1") != "0":
+            # w8a8 point: decode is WEIGHT-bandwidth-bound (every step
+            # re-reads the full stack), so int8 weights halve the bound
+            # resource vs bf16; the same generate program retraces on
+            # the quantized pytree through the shared matmul sites
+            _mark("decode w8a8 point starting")
+            qparams = jax.jit(causal_lm.quantize_lm_params)(params)
+            med_q = _timed(generate, qparams, prompt)
+            med_qp = _timed(prefill_only, qparams, prompt)
+            dec_q = med_q - med_qp
+            if dec_q > 0:
+                row["transformer_decode_w8a8_tokens_per_s"] = \
+                    round(B * G / dec_q, 1)
+                row["transformer_decode_w8a8_speedup_vs_bf16"] = \
+                    round(decode_s / dec_q, 3)
+            else:
+                _mark("decode w8a8 point dropped: prefill share >= total")
         return row
     except Exception:
         traceback.print_exc(file=sys.stderr)

@@ -122,6 +122,22 @@ def int8_partial(xq: jax.Array, wq: jax.Array) -> jax.Array:
         preferred_element_type=jnp.int32)
 
 
+def int8_row_sharded_matmul(x: jax.Array, wq: jax.Array,
+                            w_scale: jax.Array, axis_name: str
+                            ) -> jax.Array:
+    """The distributed w8a8 GEMM for a ROW-SHARDED weight: x (…, K_local)
+    float on this device @ int8 rows wq (K_local, N), with the
+    REPLICATED global per-output-channel grid w_scale (N,). Activation
+    codes come from the pmax-global grid, partials are summed in exact
+    int32 across the axis, then rescaled once — bit-identical to the
+    single-device `int8_matmul` over the full contraction. The ONE
+    definition of the TP int8 scheme (tp_decode's token step and
+    tp_prefill share it)."""
+    xq, xs = quant_act_global(x, axis_name)
+    tot = jax.lax.psum(int8_partial(xq, wq), axis_name)
+    return (tot.astype(jnp.float32) * xs * w_scale).astype(x.dtype)
+
+
 def matmul_any(x: jax.Array, w: Any) -> jax.Array:
     """``x @ w`` that dispatches on the leaf: float weights take the
     ordinary (bf16/f32) MXU path, w8a8 dicts take the int8 path. The

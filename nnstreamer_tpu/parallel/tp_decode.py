@@ -39,8 +39,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.causal_lm import _ln
-from ..ops.int8 import (W8A8_TAG, int8_partial, is_quantized, matmul_any,
-                        quant_act_global, stack_shape)
+from ..ops.int8 import (W8A8_TAG, int8_row_sharded_matmul, is_quantized,
+                        matmul_any, stack_shape)
 from .ring import _shard_map
 
 __all__ = ["tp_shard_params", "tp_shard_cache", "make_tp_generate"]
@@ -210,15 +210,6 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         tp["pos_embed"][p][None, None, :]
     live = (jnp.arange(max_len) <= p)[None, None, None, :]
 
-    def _row_sharded_mm(g, w_l, s_l):
-        """g (B, 1, K_local) float @ int8 rows w_l (K_local, N) with the
-        replicated global grid s_l (N,): pmax-global activation codes,
-        exact int32 psum, then one rescale — bit-identical to the
-        single-device int8_matmul over the full contraction."""
-        gq, gs = quant_act_global(g, axis)
-        tot = jax.lax.psum(int8_partial(gq, w_l), axis)
-        return (tot.astype(jnp.float32) * gs * s_l).astype(g.dtype)
-
     def block(carry, layer):
         h, kc, vc = carry
         if quantized:
@@ -246,10 +237,10 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         # the Megatron pair: partial attention-out and MLP products
         # reduce across the model axis
         if quantized:
-            h = h + _row_sharded_mm(o, wo_l, wo_s)
+            h = h + int8_row_sharded_matmul(o, wo_l, wo_s, axis)
             m = _ln(h, ln2)
-            mlp = _row_sharded_mm(jax.nn.gelu(matmul_any(m, w1_l)),
-                                  w2_l, w2_s)
+            mlp = int8_row_sharded_matmul(
+                jax.nn.gelu(matmul_any(m, w1_l)), w2_l, w2_s, axis)
         else:
             h = h + jax.lax.psum(o @ wo_l, axis)
             m = _ln(h, ln2)

@@ -1,0 +1,151 @@
+"""Tensor-parallel prompt prefill over the TP mesh.
+
+`tp_decode.py` shards the steady-state decode loop by attention head;
+this module does the same for the PROMPT forward, removing the TP
+engine's v1 limitation (prefill replicated on every device + a cache
+relayout per admission). Per device: QKV projections for the LOCAL
+heads only, full-sequence causal attention over those heads, then the
+Megatron psum pair per layer — identical math to `tp_token_step`
+stretched from one token row to T rows, emitting the local-head cache
+directly in the TP layout (no relayout step, 1/n of the attention
+work per device).
+
+Exactness: greedy continuation from a TP prefill matches prefilling on
+one device and resharding (logits to float tolerance — psum order;
+w8a8 trees bit-exact via the same global-grid int32 scheme as
+tp_decode). `true_len` column masking mirrors `lm_prefill_masked` so
+serving admission (bucketed padded prompts) works sharded.
+
+The reference has no distributed anything at the filter level
+(SURVEY §2.3: stateless per-buffer invokes); this is TPU-native
+territory.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models.causal_lm import _ln
+from ..ops.int8 import int8_row_sharded_matmul, matmul_any, stack_shape
+from .ring import _shard_map
+from .tp_decode import _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS
+
+__all__ = ["make_tp_prefill"]
+
+
+def tp_prefill_seq(tp, tokens, true_len, *, n_heads: int, hn: int,
+                   max_len: int, axis: str):
+    """Per-device TP prompt forward. tokens (B, T) int32 replicated;
+    ``true_len`` scalar (traced) — real prompt length of a right-padded
+    prompt, or T. Returns (last-real-token logits (B, vocab) —
+    replicated post-psum, kc, vc (L, B, hn, max_len, hd) local-head
+    cache, pos (1,)). Shares tp_token_step's weight layout and psum
+    semantics; w8a8 trees ride the same global-grid int32 path."""
+    quantized = "wo_s" in tp
+    wq, wk, wv = tp["wq"], tp["wk"], tp["wv"]
+    wo, w1, w2 = tp["wo"], tp["w1"], tp["w2"]
+    L, D = stack_shape(wq)[0], stack_shape(wq)[1]
+    hd = D // n_heads
+    b, t = tokens.shape
+    tl = jnp.asarray(true_len).reshape(()).astype(jnp.int32)
+    x = tp["embed"][tokens] + tp["pos_embed"][:t][None]
+    # causal rows; padded columns (>= true_len) never attended
+    mask = jnp.tril(jnp.ones((t, t), bool)) & \
+        (jnp.arange(t) < tl)[None, :]
+    pad = [(0, 0), (0, 0), (0, max_len - t), (0, 0)]
+
+    def block(carry, layer):
+        h = carry
+        if quantized:
+            (wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2,
+             wo_s, w2_s) = layer
+        else:
+            wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2 = layer
+        a = _ln(h, ln1)
+        q = matmul_any(a, wq_l).reshape(b, t, hn, hd).transpose(0, 2, 1, 3)
+        k = matmul_any(a, wk_l).reshape(b, t, hn, hd).transpose(0, 2, 1, 3)
+        v = matmul_any(a, wv_l).reshape(b, t, hn, hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        s = jnp.where(mask, s, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, hn * hd)
+        if quantized:
+            h = h + int8_row_sharded_matmul(o, wo_l, wo_s, axis)
+            m = _ln(h, ln2)
+            mlp = int8_row_sharded_matmul(
+                jax.nn.gelu(matmul_any(m, w1_l)), w2_l, w2_s, axis)
+        else:
+            h = h + jax.lax.psum(o @ wo_l, axis)
+            m = _ln(h, ln2)
+            mlp = jax.lax.psum(jax.nn.gelu(m @ w1_l) @ w2_l, axis)
+        return h + mlp, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    xs = [wq, wk, wv, wo, w1, w2, tp["ln1"], tp["ln2"]]
+    if quantized:
+        xs += [tp["wo_s"], tp["w2_s"]]
+    x, (kc, vc) = jax.lax.scan(block, x, tuple(xs))
+    last = jax.lax.dynamic_index_in_dim(x, tl - 1, axis=1, keepdims=True)
+    logits = (_ln(last, tp["lnf"]) @ tp["embed"].T)[:, 0]
+    return logits, kc, vc, tl.reshape(1)
+
+
+def make_tp_prefill(n_heads: int, max_len: int, mesh, axis: str = "model"):
+    """Build the jitted TP prefill: (tp_params, tokens (B, T) int32,
+    true_len) → (logits (B, vocab), kc_tp, vc_tp (n, L·B·hn, max_len,
+    hd) head-sharded caches, pos (1,)). One executable per (T,
+    quantized); the emitted caches feed `make_tp_generate` /
+    `tp_token_step` directly — no relayout."""
+    n = mesh.shape[axis]
+    if n_heads % n:
+        raise ValueError(f"n_heads={n_heads} not divisible by {n}")
+    hn = n_heads // n
+
+    def build(quantized: bool):
+        def per_device(tp, tokens, true_len):
+            tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
+                      if k in _DEVICE_KEYS else tp[k])
+                  for k in tp}
+            logits, kc, vc, pos = tp_prefill_seq(
+                tp, tokens, true_len, n_heads=n_heads, hn=hn,
+                max_len=max_len, axis=axis)
+            L = kc.shape[0]
+            b = tokens.shape[0]
+            hd = kc.shape[-1]
+            # (L, B, hn, M, hd) → (1, L·B·hn, M, hd): this device's slice
+            # of the head-major TP transport layout
+            kc = kc.reshape(L * b * hn, max_len, hd)[None]
+            vc = vc.reshape(L * b * hn, max_len, hd)[None]
+            return logits, kc, vc, pos
+
+        param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
+                       | {k: P() for k in _REPL_KEYS})
+        if quantized:
+            param_specs |= {k: P() for k in _QSCALE_KEYS}
+        return jax.jit(_shard_map(
+            per_device, mesh,
+            in_specs=(param_specs, P(), P()),
+            out_specs=(P(), P(axis), P(axis), P())))
+
+    compiled: Dict[bool, Any] = {}
+
+    def prefill(tp_params, tokens, true_len=None):
+        if tokens.shape[1] > max_len:
+            raise ValueError(
+                f"tp_prefill: prompt length {tokens.shape[1]} exceeds "
+                f"max_len={max_len}")
+        quantized = "wo_s" in tp_params
+        if quantized not in compiled:
+            compiled[quantized] = build(quantized)
+        tl = tokens.shape[1] if true_len is None else true_len
+        with jax.default_matmul_precision("float32"):
+            return compiled[quantized](
+                tp_params, jnp.asarray(tokens),
+                jnp.asarray(tl, dtype=jnp.int32))
+
+    prefill.compiled = compiled
+    return prefill

@@ -14,19 +14,16 @@ on the replicated psum'd logits with the same fold_in(seed, consumed)
 keys, so the key schedule never sees the mesh).
 
 Executable sharing follows the module-level-kernel convention stated in
-lm_engine.py: the chunk/relayout kernels are built by lru_cached
-module functions keyed on (mesh, axis, shapes), so a second engine over
-the same mesh and model shapes compiles nothing, and the sharded KV
-stores are donated through each chunk (in-place update, no copy).
+lm_engine.py: the prefill/chunk kernels are built by lru_cached module
+functions keyed on (mesh, axis, shapes), so a second engine over the
+same mesh and model shapes compiles nothing, and the sharded KV stores
+are donated through each chunk (in-place update, no copy).
 
-v1 scope decisions:
-- prefill runs REPLICATED (every device computes the full prompt
-  forward; the resulting cache reshards head-major once per admission).
-  Real deployments would TP the prefill too; admission cost here is
-  one wasted forward per non-primary device, while the steady-state
-  decode loop — where serving time goes — is fully sharded.
-- speculative decoding is not composed with the mesh yet
-  (spec_draft raises).
+Prefill runs TENSOR-PARALLEL too (parallel/tp_prefill.py): each
+admission computes QKV for the local heads only and emits the cache
+directly in the head-major TP layout — no replicated prompt forward,
+no relayout step. v1 scope: speculative decoding is not composed with
+the mesh yet (spec_draft raises).
 
 The reference has no distributed serving of any kind (SURVEY §2.3/§2.5:
 stateless per-buffer invokes + TCP offload of whole buffers).
@@ -45,29 +42,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.int8 import stack_shape
 from ..parallel.ring import _shard_map
 from ..parallel.tp_decode import (
-    _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS, head_major_relayout,
-    tp_shard_params, tp_token_step)
+    _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS, tp_shard_params,
+    tp_token_step)
+from ..parallel.tp_prefill import make_tp_prefill
 from . import sampling
-from .lm_engine import LMEngine, _prefill_admit, _slot_insert
+from .lm_engine import LMEngine, _slot_insert
 
 __all__ = ["TPLMEngine"]
 
 
 @functools.lru_cache(maxsize=None)
-def _relayout_fn(mesh: Mesh, axis: str, n_layers: int, hn: int,
-                 max_len: int, hd: int):
-    """flat (L*H, M, hd) single-device cache → head-major TP layout
-    (n, L*hn, M, hd); the out_sharding materializes the reshard once.
-    The transform itself has ONE definition (head_major_relayout)."""
-    n = mesh.shape[axis]
-    out_sh = NamedSharding(mesh, P(axis))
-
-    @functools.partial(jax.jit, out_shardings=(out_sh, out_sh))
-    def to_tp(kc, vc):
-        return (head_major_relayout(kc, n_layers, 1, n, hn),
-                head_major_relayout(vc, n_layers, 1, n, hn))
-
-    return to_tp
+def _tp_prefill_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int):
+    """Shared TP prefill callable per (mesh, geometry) — the same
+    executable-sharing convention as _chunk_fn."""
+    return make_tp_prefill(n_heads, max_len, mesh, axis)
 
 
 @functools.lru_cache(maxsize=None)
@@ -150,9 +138,12 @@ class TPLMEngine(LMEngine):
         self.mesh, self.axis, self._n = mesh, axis, n
         super().__init__(params, n_heads, max_len, **kw)
         self._tp = tp_shard_params(params, n_heads, mesh, axis)
-        # replicated full params for the prefill path
+        # self.params stays the caller's (host/unplaced) tree — used
+        # only for shape introspection; replicating the full unsharded
+        # weights would cost n x the sharded HBM footprint, defeating
+        # the regime this engine exists for. All compute paths consume
+        # self._tp (decode chunks AND the TP prefill).
         rep = NamedSharding(mesh, P())
-        self.params = jax.device_put(params, rep)
         for name in ("_tokens", "_pos", "_skeys", "_temp", "_topk",
                      "_topp"):
             setattr(self, name, jax.device_put(
@@ -172,15 +163,15 @@ class TPLMEngine(LMEngine):
                 jax.device_put(zero(shape), dev))
 
     def _prefill_into(self, slot, padded, true_len, skey, temp, tk, tp):
-        first, kc, vc, pos = _prefill_admit(
-            self.params, jnp.asarray(padded), jnp.int32(true_len),
-            skey, temp, tk, tp,
-            n_heads=self.n_heads, max_len=self.max_len)
-        L = stack_shape(self.params["wqkv"])[0]
-        hd = self.params["embed"].shape[1] // self.n_heads
-        kc_tp, vc_tp = _relayout_fn(
-            self.mesh, self.axis, L, self.n_heads // self._n,
-            self.max_len, hd)(kc, vc)
+        # head-sharded prompt forward; the cache arrives already in the
+        # TP transport layout. First-token sampling keys match the base
+        # engine's (fold_in(seed, consumed)) on the replicated logits
+        logits, kc_tp, vc_tp, pos = _tp_prefill_fn(
+            self.mesh, self.axis, self.n_heads, self.max_len)(
+            self._tp, jnp.asarray(padded), jnp.int32(true_len))
+        first = sampling.sample_row(
+            logits[0], jax.random.fold_in(skey, jnp.int32(true_len)),
+            temp, tk, tp)
         sl = jnp.int32(slot)
         self._kc = _slot_insert(self._kc, kc_tp, sl)
         self._vc = _slot_insert(self._vc, vc_tp, sl)

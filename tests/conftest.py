@@ -27,3 +27,18 @@ def rng():
     import numpy as np
 
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_state():
+    """Full-suite runs (~950 tests, one process, one core) accumulate
+    thousands of XLA:CPU executables; past a few GB of JIT state the
+    LLVM-side compile occasionally segfaults mid-suite (observed at
+    arbitrary tests ~30 min in — jax 0.9 backend_compile_and_load, not
+    reproducible on the module alone). Dropping the executable caches
+    between modules bounds that state; modules recompile their own
+    programs, which they mostly would anyway (distinct shapes)."""
+    yield
+    import jax
+
+    jax.clear_caches()

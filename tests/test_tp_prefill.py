@@ -1,0 +1,138 @@
+"""Tensor-parallel prompt prefill (parallel/tp_prefill.py).
+
+The TP prefill must hand `make_tp_generate` exactly what a
+single-device prefill + head-major reshard would have: same greedy
+continuations (float psum tolerance on logits), same cache layout, and
+— for w8a8 trees — bit-exact caches (the global-grid int32 scheme).
+`true_len` column masking must match `lm_prefill_masked`.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nnstreamer_tpu.models import causal_lm
+from nnstreamer_tpu.parallel.tp_decode import (
+    make_tp_generate, tp_shard_cache, tp_shard_params)
+from nnstreamer_tpu.parallel.tp_prefill import make_tp_prefill
+
+V, D, H, L, MAXLEN = 71, 64, 8, 2, 64
+
+
+@pytest.fixture(scope="module")
+def params():
+    return causal_lm.init_causal_lm(
+        jax.random.PRNGKey(21), V, D, H, L, MAXLEN)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs virtual multi-device CPU")
+    return Mesh(np.array(jax.devices()[:4]), ("model",))
+
+
+def _single_generate(params, prompt, n_steps):
+    logits, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    first = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    toks, tok = [], first
+    for _ in range(n_steps):
+        lg, kc, vc, pos = causal_lm.lm_decode_step(
+            params, tok, kc, vc, pos, H)
+        tok = jnp.argmax(lg, -1)[:, None].astype(jnp.int32)
+        toks.append(np.asarray(tok[:, 0]))
+    return np.asarray(first[:, 0]), np.stack(toks, 1)
+
+
+def test_tp_prefill_logits_and_continuation(params, mesh):
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, V, (2, 9)).astype(np.int32)
+    sfirst, want = _single_generate(params, prompt, 10)
+
+    tp = tp_shard_params(params, H, mesh)
+    prefill = make_tp_prefill(H, MAXLEN, mesh)
+    logits, kc_tp, vc_tp, pos = prefill(tp, prompt)
+
+    ref_logits, _, _, ref_pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-5)
+    assert int(np.asarray(pos)[0]) == int(np.asarray(ref_pos)[0])
+
+    first = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(first[:, 0]), sfirst)
+    gen = make_tp_generate(H, MAXLEN, mesh)
+    got = np.asarray(gen(tp, first, kc_tp, vc_tp, pos, 10))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_prefill_cache_matches_resharded_single_device(params, mesh):
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, V, (1, 11)).astype(np.int32)
+    _, kc, vc, _ = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    kc_ref, vc_ref = tp_shard_cache(kc, vc, L, 1, H, mesh)
+
+    tp = tp_shard_params(params, H, mesh)
+    _, kc_tp, vc_tp, _ = make_tp_prefill(H, MAXLEN, mesh)(tp, prompt)
+    np.testing.assert_allclose(np.asarray(kc_tp), np.asarray(kc_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vc_tp), np.asarray(vc_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_prefill_true_len_matches_masked(params, mesh):
+    """A right-padded bucket prompt through the TP prefill equals
+    lm_prefill_masked: same logits row, same pos, and the continuation
+    from the garbage-padded cache stays exact (the overwrite-before-
+    visible contract)."""
+    rng = np.random.default_rng(3)
+    tl = 6
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :tl] = rng.integers(0, V, tl)
+
+    ref_logits, _, _, ref_pos = causal_lm.lm_prefill_masked(
+        params, jnp.asarray(padded), jnp.int32(tl), H, MAXLEN)
+    tp = tp_shard_params(params, H, mesh)
+    logits, kc_tp, vc_tp, pos = make_tp_prefill(H, MAXLEN, mesh)(
+        tp, padded, true_len=tl)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-5)
+    assert int(np.asarray(pos)[0]) == tl == int(np.asarray(ref_pos)[0])
+
+
+def test_tp_prefill_w8a8_bit_exact_cache_and_tokens(params, mesh):
+    """Quantized TP prefill: int8 QKV codes are the single-device codes
+    (column grids preserved), so the emitted cache is BIT-exact vs
+    resharding a single-device quantized prefill, and the greedy
+    continuation matches token-for-token."""
+    qp = causal_lm.quantize_lm_params(params)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, V, (2, 8)).astype(np.int32)
+    sfirst, want = _single_generate(qp, prompt, 9)
+
+    _, kc, vc, _ = causal_lm.lm_prefill(qp, jnp.asarray(prompt), H, MAXLEN)
+    kc_ref, vc_ref = tp_shard_cache(kc, vc, L, 2, H, mesh)
+
+    tq = tp_shard_params(qp, H, mesh)
+    prefill = make_tp_prefill(H, MAXLEN, mesh)
+    logits, kc_tp, vc_tp, pos = prefill(tq, prompt)
+    np.testing.assert_array_equal(np.asarray(kc_tp), np.asarray(kc_ref))
+    np.testing.assert_array_equal(np.asarray(vc_tp), np.asarray(vc_ref))
+
+    first = jnp.argmax(logits, -1)[:, None].astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(first[:, 0]), sfirst)
+    got = np.asarray(make_tp_generate(H, MAXLEN, mesh)(
+        tq, first, kc_tp, vc_tp, pos, 9))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_prefill_rejects_oversized_prompt(params, mesh):
+    tp = tp_shard_params(params, H, mesh)
+    prompt = np.zeros((1, MAXLEN + 1), np.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        make_tp_prefill(H, MAXLEN, mesh)(tp, prompt)
