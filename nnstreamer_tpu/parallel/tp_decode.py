@@ -52,6 +52,27 @@ _REPL_KEYS = ("embed", "pos_embed", "ln1", "ln2", "lnf")
 _QSCALE_KEYS = ("wo_s", "w2_s")
 
 
+def tp_param_specs(axis: str, quantized: bool):
+    """shard_map parameter-spec dict for a TP param tree — the ONE
+    definition every TP kernel (generate, decode chunk, verify chunk,
+    prefill) builds its in_specs from."""
+    specs = ({k: P(axis) for k in _DEVICE_KEYS}
+             | {k: P() for k in _REPL_KEYS})
+    if quantized:
+        specs |= {k: P() for k in _QSCALE_KEYS}
+    return specs
+
+
+def strip_device_leaves(tp):
+    """Inside a shard_map program: drop the leading device axis from the
+    sharded weight leaves (dict leaves included); replicated leaves pass
+    through whole. The ONE definition of the per-device view."""
+    import jax as _jax
+
+    return {k: (_jax.tree_util.tree_map(lambda a: a[0], tp[k])
+                if k in _DEVICE_KEYS else tp[k]) for k in tp}
+
+
 def _col_shard(m: np.ndarray, n: int, chunk: int) -> np.ndarray:
     """(L, K, n·chunk) → (n, L, K, chunk): contiguous column chunks per
     device — the ONE definition of the column (head/MLP-up) slicing,
@@ -186,29 +207,34 @@ def tp_shard_cache(kcache: jax.Array, vcache: jax.Array, n_layers: int,
         for c in (kcache, vcache))
 
 
-def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
-                  max_len: int, axis: str):
-    """One TP decode step on one device shard — the per-layer math BOTH
-    TP consumers share (`make_tp_generate` here and
-    `serving/tp_engine.py`'s chunk kernel), so the mask/psum/cache
-    semantics live in exactly one place.
+def tp_window_step(tp, tokens, kc, vc, p, *, n_heads: int, hn: int,
+                   max_len: int, axis: str):
+    """A W-token TP verify window on one device shard — the per-layer
+    math EVERY TP consumer shares (`make_tp_generate`,
+    `serving/tp_engine.py`'s decode-chunk AND verify-chunk kernels), so
+    the mask/psum/cache semantics live in exactly one place;
+    `tp_token_step` is the W=1 case, mirroring how `causal_lm` derives
+    its decode step from `_lm_verify_window`.
 
-    tok (B, 1) int32; kc/vc (L, B, hn, max_len, hd) = this device's
-    head shard; p scalar position. tp carries the per-device weight
-    slices (leading device axis already stripped). Returns
-    (logits (B, vocab) — replicated post-psum, kc', vc')."""
+    tokens (B, W) int32; kc/vc (L, B, hn, max_len, hd) = this device's
+    head shard; p scalar write position. Row j attends columns <= p+j
+    (its own slot included, later rows' not). Returns (logits (B, W,
+    vocab) — replicated post-psum, kc', vc'); windows past capacity
+    NaN-poison the logits (the caller cannot raise from compiled
+    code)."""
     wq, wk, wv = tp["wq"], tp["wk"], tp["wv"]
     wo, w1, w2 = tp["wo"], tp["w1"], tp["w2"]
     L, D = stack_shape(wq)[0], stack_shape(wq)[1]
     hd = D // n_heads
-    b = tok.shape[0]
+    b, w = tokens.shape
     # w8a8 trees carry the row-sharded weights' GLOBAL grids: column
     # GEMMs go through matmul_any on single-device codes; row GEMMs
     # psum exact int32 partials then rescale (see _restructure_w8a8)
     quantized = "wo_s" in tp
-    x = tp["embed"][tok[:, 0]][:, None, :] + \
-        tp["pos_embed"][p][None, None, :]
-    live = (jnp.arange(max_len) <= p)[None, None, None, :]
+    x = tp["embed"][tokens] + \
+        jax.lax.dynamic_slice_in_dim(tp["pos_embed"], p, w)[None]
+    live = (jnp.arange(max_len)[None, :] <=
+            (p + jnp.arange(w))[:, None])[None, None]   # (1,1,W,max_len)
 
     def block(carry, layer):
         h, kc, vc = carry
@@ -218,11 +244,11 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         else:
             wq_l, wk_l, wv_l, wo_l, w1_l, w2_l, ln1, ln2, li = layer
         a = _ln(h, ln1)
-        # local heads only: (B, hn, 1, hd)
-        q = matmul_any(a, wq_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
-        k = matmul_any(a, wk_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
-        v = matmul_any(a, wv_l).reshape(b, 1, hn, hd).transpose(0, 2, 1, 3)
-        # write this step's K/V at column p: update (1, B, hn, 1, hd)
+        # local heads only: (B, hn, W, hd)
+        q = matmul_any(a, wq_l).reshape(b, w, hn, hd).transpose(0, 2, 1, 3)
+        k = matmul_any(a, wk_l).reshape(b, w, hn, hd).transpose(0, 2, 1, 3)
+        v = matmul_any(a, wv_l).reshape(b, w, hn, hd).transpose(0, 2, 1, 3)
+        # write this window's K/V at columns p..p+W-1: (1, B, hn, W, hd)
         kc = jax.lax.dynamic_update_slice(kc, k[None], (li, 0, 0, p, 0))
         vc = jax.lax.dynamic_update_slice(vc, v[None], (li, 0, 0, p, 0))
         kc_l = jax.lax.dynamic_index_in_dim(
@@ -233,7 +259,7 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
         s = jnp.where(live, s, -1e30)
         o = jnp.einsum("bhqk,bhkd->bhqd",
                        jax.nn.softmax(s, axis=-1), vc_l)
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, hn * hd)
+        o = o.transpose(0, 2, 1, 3).reshape(b, w, hn * hd)
         # the Megatron pair: partial attention-out and MLP products
         # reduce across the model axis
         if quantized:
@@ -253,9 +279,20 @@ def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
     xs.append(jnp.arange(L, dtype=jnp.int32))
     (x, kc, vc), _ = jax.lax.scan(
         block, (x, kc, vc), tuple(xs), unroll=True)
-    logits = (_ln(x, tp["lnf"]) @ tp["embed"].T)[:, 0]
-    logits = jnp.where(p >= max_len, jnp.nan, logits)
+    logits = _ln(x, tp["lnf"]) @ tp["embed"].T      # (B, W, vocab)
+    logits = jnp.where(p + w > max_len, jnp.nan, logits)
     return logits, kc, vc
+
+
+def tp_token_step(tp, tok, kc, vc, p, *, n_heads: int, hn: int,
+                  max_len: int, axis: str):
+    """One TP decode step: exactly the W=1 case of `tp_window_step`
+    (one shared body — the cache-write/masking/poison contracts live in
+    one place). tok (B, 1); returns (logits (B, vocab), kc', vc')."""
+    logits, kc, vc = tp_window_step(
+        tp, tok, kc, vc, p, n_heads=n_heads, hn=hn, max_len=max_len,
+        axis=axis)
+    return logits[:, 0], kc, vc
 
 
 def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
@@ -276,9 +313,7 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
         def per_device(tp, tok0, kc, vc, pos):
             # sharded leaves arrive as the (1, ...) device slice;
             # replicated leaves (incl. the w8a8 global grids) whole
-            tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
-                      if k in _DEVICE_KEYS else tp[k])
-                  for k in tp}
+            tp = strip_device_leaves(tp)
             kc, vc = kc[0], vc[0]          # (L*B*hn, max_len, hd)
             L = stack_shape(tp["wq"])[0]
             hd = stack_shape(tp["wq"])[1] // n_heads
@@ -299,11 +334,8 @@ def make_tp_generate(n_heads: int, max_len: int, mesh: Mesh,
                 None, length=n_steps)
             return toks.T  # (B, n_steps) — identical on every device
 
-        param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
-                       | {k: P() for k in _REPL_KEYS})
-        if quantized:
-            param_specs |= {k: P() for k in _QSCALE_KEYS}
-        in_specs = (param_specs, P(), P(axis), P(axis), P())
+        in_specs = (tp_param_specs(axis, quantized),
+                    P(), P(axis), P(axis), P())
         return jax.jit(_shard_map(per_device, mesh,
                                   in_specs=in_specs, out_specs=P()),
                        donate_argnums=(2, 3))

@@ -33,7 +33,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.causal_lm import _ln
 from ..ops.int8 import int8_row_sharded_matmul, matmul_any, stack_shape
 from .ring import _shard_map
-from .tp_decode import _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS
+from .tp_decode import strip_device_leaves, tp_param_specs
 
 __all__ = ["make_tp_prefill"]
 
@@ -107,9 +107,7 @@ def make_tp_prefill(n_heads: int, max_len: int, mesh, axis: str = "model"):
 
     def build(quantized: bool):
         def per_device(tp, tokens, true_len):
-            tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
-                      if k in _DEVICE_KEYS else tp[k])
-                  for k in tp}
+            tp = strip_device_leaves(tp)
             logits, kc, vc, pos = tp_prefill_seq(
                 tp, tokens, true_len, n_heads=n_heads, hn=hn,
                 max_len=max_len, axis=axis)
@@ -122,13 +120,9 @@ def make_tp_prefill(n_heads: int, max_len: int, mesh, axis: str = "model"):
             vc = vc.reshape(L * b * hn, max_len, hd)[None]
             return logits, kc, vc, pos
 
-        param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
-                       | {k: P() for k in _REPL_KEYS})
-        if quantized:
-            param_specs |= {k: P() for k in _QSCALE_KEYS}
         return jax.jit(_shard_map(
             per_device, mesh,
-            in_specs=(param_specs, P(), P()),
+            in_specs=(tp_param_specs(axis, quantized), P(), P()),
             out_specs=(P(), P(axis), P(axis), P())))
 
     compiled: Dict[bool, Any] = {}

@@ -135,9 +135,19 @@ def _verify_chunk(params, tokens_in, kc, vc, pos, n_heads):
     strictly better), so no sampler runs here. Returns
     (carried' (S,1,1), kc, vc, pos+m, outs (S, W), m (S,)).
     """
-    w = tokens_in.shape[1]
     logits, kc, vc, pos_w = causal_lm.lm_verify_window_slots(
         params, tokens_in, kc, vc, pos, n_heads)
+    carried, pos_m, greedy, m = _accept_from_window(
+        tokens_in, logits, pos_w)
+    return carried, kc, vc, pos_m, greedy, m
+
+
+def _accept_from_window(tokens_in, logits, pos_w):
+    """Per-slot draft acceptance from a verify window's logits — ONE
+    definition shared by the single-device and TP verify chunks.
+    tokens_in (S, W); logits (S, W, V); pos_w (S, 1) post-window.
+    Returns (carried (S,1,1), pos_m = pos+m, greedy (S, W), m (S,))."""
+    w = tokens_in.shape[1]
     greedy = jnp.argmax(logits, -1).astype(jnp.int32)      # (S, W)
     # draft token j (input col j, j>=1) is confirmed iff it equals the
     # model's output at col j-1 AND every earlier draft was confirmed
@@ -145,7 +155,7 @@ def _verify_chunk(params, tokens_in, kc, vc, pos, n_heads):
     m = 1 + jnp.cumprod(ok, axis=-1).sum(-1)               # (S,) in 1..W
     pos_m = pos_w - w + m[:, None]                         # = pos + m
     carried = jnp.take_along_axis(greedy, m[:, None] - 1, axis=1)
-    return carried[:, :, None], kc, vc, pos_m, greedy, m
+    return carried[:, :, None], pos_m, greedy, m
 
 
 @dataclass
@@ -407,6 +417,12 @@ class LMEngine:
                           n_heads=self.n_heads, n_steps=n)
         return outs
 
+    def _run_verify(self, tokens_in):
+        """Device kernel hook for one speculative verify iteration —
+        the TP engine swaps in its mesh-sharded verify chunk."""
+        return _verify_chunk(self.params, tokens_in, self._kc, self._vc,
+                             self._pos, n_heads=self.n_heads)
+
     def _decode_speculative(self, active: List[int]) -> None:
         """One speculative iteration: host-drafted prompt-lookup tokens
         verified in one dispatch; per-slot acceptance rolls pos back
@@ -419,8 +435,7 @@ class LMEngine:
         tokens_in = jnp.concatenate(
             [self._tokens[:, 0], jnp.asarray(drafts)], axis=1)  # (S, 1+g)
         (self._tokens, self._kc, self._vc, self._pos, outs, m) = \
-            _verify_chunk(self.params, tokens_in, self._kc, self._vc,
-                          self._pos, n_heads=self.n_heads)
+            self._run_verify(tokens_in)
         outs = np.asarray(outs)
         m = np.asarray(m)
         for s in range(self.n_slots):

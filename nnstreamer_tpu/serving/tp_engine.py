@@ -22,8 +22,11 @@ are donated through each chunk (in-place update, no copy).
 Prefill runs TENSOR-PARALLEL too (parallel/tp_prefill.py): each
 admission computes QKV for the local heads only and emits the cache
 directly in the head-major TP layout — no replicated prompt forward,
-no relayout step. v1 scope: speculative decoding is not composed with
-the mesh yet (spec_draft raises).
+no relayout step. Speculative decoding composes with the mesh as well
+(`_tp_verify_fn`: W-token windows through the shared tp_window_step,
+acceptance on the replicated logits) — the full serving matrix
+(greedy/sampled/speculative x float/w8a8) runs single-device or
+sharded with identical outputs.
 
 The reference has no distributed serving of any kind (SURVEY §2.3/§2.5:
 stateless per-buffer invokes + TCP offload of whole buffers).
@@ -41,12 +44,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.int8 import stack_shape
 from ..parallel.ring import _shard_map
-from ..parallel.tp_decode import (
-    _DEVICE_KEYS, _QSCALE_KEYS, _REPL_KEYS, tp_shard_params,
-    tp_token_step)
+from ..parallel.tp_decode import (strip_device_leaves, tp_param_specs,
+                                  tp_shard_params, tp_token_step,
+                                  tp_window_step)
 from ..parallel.tp_prefill import make_tp_prefill
 from . import sampling
-from .lm_engine import LMEngine, _slot_insert
+from .lm_engine import LMEngine, _accept_from_window, _slot_insert
 
 __all__ = ["TPLMEngine"]
 
@@ -58,6 +61,29 @@ def _tp_prefill_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int):
     return make_tp_prefill(n_heads, max_len, mesh, axis)
 
 
+def _slot_shard_view(tp, kc, vc, n_heads, hn, max_len):
+    """Per-device preamble every slot kernel shares: strip the device
+    axis from the weight leaves and view the slot caches in the logical
+    (S, L, 1, hn, max_len, hd) layout. Paired with _slot_shard_flat."""
+    tp = strip_device_leaves(tp)
+    kc, vc = kc[:, 0], vc[:, 0]            # (S, L*hn, M, hd)
+    L = stack_shape(tp["wq"])[0]
+    hd = stack_shape(tp["wq"])[1] // n_heads
+    S = kc.shape[0]
+    kc = kc.reshape(S, L, 1, hn, max_len, hd)
+    vc = vc.reshape(S, L, 1, hn, max_len, hd)
+    return tp, (kc, vc), (L, hd)
+
+
+def _slot_shard_flat(kc, vc, L, hn, max_len, hd):
+    """Inverse of _slot_shard_view's cache reshape: back to the sharded
+    transport layout (S, 1, L*hn, max_len, hd)."""
+    S = kc.shape[0]
+    kc = kc.reshape(S, 1, L * hn, max_len, hd)
+    vc = vc.reshape(S, 1, L * hn, max_len, hd)
+    return kc, vc
+
+
 @functools.lru_cache(maxsize=None)
 def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
               n_steps: int, quantized: bool = False):
@@ -67,14 +93,9 @@ def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
     hn = n_heads // n
 
     def per_device(tp, tokens, kc, vc, pos, skeys, temp, topk, topp):
-        tp = {k: (jax.tree_util.tree_map(lambda a: a[0], tp[k])
-                  if k in _DEVICE_KEYS else tp[k]) for k in tp}
-        kc, vc = kc[:, 0], vc[:, 0]        # (S, L*hn, M, hd)
-        L = stack_shape(tp["wq"])[0]
-        hd = stack_shape(tp["wq"])[1] // n_heads
+        tp, (kc, vc), (L, hd) = _slot_shard_view(
+            tp, kc, vc, n_heads, hn, max_len)
         S = tokens.shape[0]
-        kc = kc.reshape(S, L, 1, hn, max_len, hd)
-        vc = vc.reshape(S, L, 1, hn, max_len, hd)
 
         def slot_step(tok, kc_s, vc_s, p):
             # tok (1, 1); kc_s (L, 1, hn, M, hd); psums ride vmap
@@ -103,21 +124,55 @@ def _chunk_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
 
         (tokens, kc, vc, pos), outs = jax.lax.scan(
             one, (tokens, kc, vc, pos), None, length=n_steps)
-        kc = kc.reshape(S, 1, L * hn, max_len, hd)
-        vc = vc.reshape(S, 1, L * hn, max_len, hd)
+        kc, vc = _slot_shard_flat(kc, vc, L, hn, max_len, hd)
         return tokens, kc, vc, pos, outs.T
 
     spec_dev = P(None, axis)
-    param_specs = ({k: P(axis) for k in _DEVICE_KEYS}
-                   | {k: P() for k in _REPL_KEYS})
-    if quantized:
-        param_specs |= {k: P() for k in _QSCALE_KEYS}
-    in_specs = (param_specs,
+    in_specs = (tp_param_specs(axis, quantized),
                 P(), spec_dev, spec_dev, P(), P(), P(), P(), P())
     out_specs = (P(), spec_dev, spec_dev, P(), P())
     return jax.jit(_shard_map(per_device, mesh, in_specs=in_specs,
                               out_specs=out_specs),
                    donate_argnums=(1, 2, 3, 4))
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_verify_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
+                  w: int, quantized: bool = False):
+    """Build the jitted TP verify-chunk executable: W-token windows for
+    all slots through `tp_window_step` (the same shared TP layer math
+    as the decode chunk), acceptance via the same `_accept_from_window`
+    as the single-device engine — speculative decoding composed with
+    the mesh."""
+    n = mesh.shape[axis]
+    hn = n_heads // n
+
+    def per_device(tp, tokens_in, kc, vc, pos):
+        tp, (kc, vc), (L, hd) = _slot_shard_view(
+            tp, kc, vc, n_heads, hn, max_len)
+        S = tokens_in.shape[0]
+
+        def slot_window(toks, kc_s, vc_s, p):
+            logits, kc_s, vc_s = tp_window_step(
+                tp, toks[None], kc_s, vc_s, jnp.asarray(p).reshape(()),
+                n_heads=n_heads, hn=hn, max_len=max_len, axis=axis)
+            return logits[0], kc_s, vc_s, (p.reshape(()) + w).reshape(1)
+
+        logits, kc, vc, pos_w = jax.vmap(slot_window)(
+            tokens_in, kc, vc, pos)
+        # logits replicated post-psum: acceptance agrees on every device
+        carried, pos_m, greedy, m = _accept_from_window(
+            tokens_in, logits, pos_w)
+        kc, vc = _slot_shard_flat(kc, vc, L, hn, max_len, hd)
+        return carried, kc, vc, pos_m, greedy, m
+
+    spec_dev = P(None, axis)
+    in_specs = (tp_param_specs(axis, quantized),
+                P(), spec_dev, spec_dev, P())
+    out_specs = (P(), spec_dev, spec_dev, P(), P(), P())
+    return jax.jit(_shard_map(per_device, mesh, in_specs=in_specs,
+                              out_specs=out_specs),
+                   donate_argnums=(2, 3, 4))
 
 
 class TPLMEngine(LMEngine):
@@ -126,10 +181,6 @@ class TPLMEngine(LMEngine):
 
     def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
                  mesh: Mesh, axis: str = "model", **kw) -> None:
-        if kw.get("spec_draft"):
-            raise NotImplementedError(
-                "speculative decoding is not composed with the TP mesh "
-                "yet — use spec_draft=0 (default)")
         n = mesh.shape[axis]
         if n_heads % n:
             raise ValueError(f"n_heads={n_heads} not divisible by "
@@ -188,3 +239,11 @@ class TPLMEngine(LMEngine):
                     self._pos, self._skeys, self._temp, self._topk,
                     self._topp)
         return outs
+
+    def _run_verify(self, tokens_in):
+        with jax.default_matmul_precision("float32"):
+            return _tp_verify_fn(self.mesh, self.axis, self.n_heads,
+                                 self.max_len, int(tokens_in.shape[1]),
+                                 quantized="wo_s" in self._tp)(
+                self._tp, jnp.asarray(tokens_in), self._kc, self._vc,
+                self._pos)
