@@ -728,6 +728,13 @@ def _decode_lane(params, n_heads, max_len, device) -> dict:
             med_q = _timed(generate, qparams, prompt)
             med_qp = _timed(prefill_only, qparams, prompt)
             dec_q = med_q - med_qp
+            # raw medians ALWAYS published: the speedup is a difference
+            # of two noisy medians divided by another — when a run is
+            # noisy enough to drop the derived row, these make the lane
+            # diagnosable instead of silently flaky
+            row["transformer_decode_w8a8_wall_s_median"] = round(med_q, 4)
+            row["transformer_decode_w8a8_prefill_share_s"] = \
+                round(med_qp, 4)
             if dec_q > 0:
                 row["transformer_decode_w8a8_tokens_per_s"] = \
                     round(B * G / dec_q, 1)

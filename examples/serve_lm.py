@@ -53,6 +53,12 @@ def main() -> int:
     print("engine stats:", {k: v for k, v in eng.stats.items()
                             if not k.startswith("spec")})
 
+    # live metrics: `from nnstreamer_tpu.obs import start_exporter;
+    # start_exporter(port=9464)` before running the engine exposes
+    # TTFT/per-token latency histograms, slot occupancy, and per-bucket
+    # prefill compiles at http://127.0.0.1:9464/metrics (also available
+    # as `nns-launch --metrics-port`; catalog in docs/observability.md)
+
     # speculative decoding on repetitive text: greedy output unchanged,
     # multiple tokens accepted per dispatch
     rep = np.array([5, 9, 2, 7] * 4, np.int32)

@@ -25,6 +25,9 @@ def main(argv=None) -> int:
                     help="max seconds to run (default: until EOS)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print bus messages")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="enable metrics and serve /metrics + /healthz on "
+                         "this port while the pipeline runs (0 = ephemeral)")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -56,11 +59,25 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — CLI reports, never tracebacks
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
+    exporter = None
+    if args.metrics_port is not None:
+        # started (and collection enabled) BEFORE p.start(): the element
+        # chains only get instrumented if metrics are on at start time
+        from .obs.exporter import start_exporter
+
+        try:
+            exporter = start_exporter(port=args.metrics_port)
+        except OSError as e:
+            print(f"ERROR: metrics exporter: {e}", file=sys.stderr)
+            return 1
+        print(f"metrics: {exporter.url}", file=sys.stderr)
     t0 = time.monotonic()
     try:
         p.start()
     except Exception as e:  # noqa: BLE001
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
+        if exporter is not None:
+            exporter.close()
         return 1
     try:
         ok = p.wait_eos(args.timeout)
@@ -81,6 +98,8 @@ def main(argv=None) -> int:
             return 2
     finally:
         p.stop()
+        if exporter is not None:
+            exporter.close()
     if args.verbose:
         print(f"ran {time.monotonic() - t0:.2f}s", file=sys.stderr)
     return 0

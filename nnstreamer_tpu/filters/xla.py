@@ -256,7 +256,7 @@ class XLAFilter(FilterFramework):
             return bundle
         if quant not in ("w8", "int8", "w8a8"):
             raise ValueError(f"xla-tpu: unknown quant mode {quant!r} "
-                             "(supported: w8, w8a8)")
+                             "(supported: w8, int8, w8a8)")
         key = "_w8a8_bundle" if quant == "w8a8" else "_w8_bundle"
         cached = bundle.metadata.get(key)
         if cached is None:

@@ -316,6 +316,13 @@ class Pipeline:
             from ..ops.fusion import fuse_chains
 
             self._fused_count = fuse_chains(self)
+        # live telemetry (obs subsystem): wraps element chains into the
+        # process-global registry ONLY when metrics are enabled — when
+        # they are not, chains stay the plain class methods and the hot
+        # path pays exactly nothing (the no-op fast path tests pin)
+        from ..obs.instrument import maybe_instrument_pipeline
+
+        maybe_instrument_pipeline(self)
         # start non-sources first so threads/queues are ready, then sources
         try:
             for el in self.elements.values():

@@ -186,6 +186,15 @@ def _lm_prefill(params, tokens, n_heads, max_len, mesh=None, sp_axis="sp",
             "lm_prefill: true_len= (padded-prompt masking) is a "
             "dense-attention feature; the ring/flash paths apply "
             "causality internally and cannot see it")
+    if true_len is not None and not isinstance(true_len, jax.core.Tracer):
+        # eager mirror of tp_prefill's check — only when the value is
+        # concrete (under jit it is a tracer and the caller's eager
+        # entry point has already validated it)
+        tl_v = int(true_len)
+        if not 1 <= tl_v <= t:
+            raise ValueError(
+                f"lm_prefill: true_len={tl_v} outside [1, {t}] "
+                "(padded prompt length)")
     n_layers = stack_shape(params["wqkv"])[0]
     d_model = params["embed"].shape[1]
     hd = d_model // n_heads

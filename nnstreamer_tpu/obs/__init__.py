@@ -1,0 +1,18 @@
+"""nnstreamer_tpu.obs — unified metrics & exposition subsystem.
+
+Always-on counters/gauges/histograms fed by the pipeline graph, the
+query offload layer, and the serving engines, with a stdlib HTTP
+``/metrics`` + ``/healthz`` endpoint. See docs/observability.md for
+the metric name catalog and usage.
+"""
+
+from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
+                      enable, enabled, registry)
+from .exporter import MetricsExporter, start_exporter
+from .instrument import instrument_pipeline
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS", "MetricsRegistry", "MetricsExporter",
+    "disable", "enable", "enabled", "instrument_pipeline", "registry",
+    "start_exporter",
+]

@@ -1,0 +1,102 @@
+"""HTTP exposition endpoint: ``/metrics`` + ``/healthz``, stdlib only.
+
+A daemon-threaded ``http.server`` serving the process-global (or a
+given) ``MetricsRegistry`` in Prometheus text format — the scrape
+target a production deployment points its collector at. No new
+dependencies: ``ThreadingHTTPServer`` handles concurrent scrapes and
+the GIL is irrelevant at scrape rates.
+
+    from nnstreamer_tpu.obs import start_exporter
+    exp = start_exporter(port=9464)   # also enables collection
+    ...
+    exp.close()
+
+``port=0`` binds an ephemeral port (tests); the bound port is on
+``exp.port`` and the full scrape URL on ``exp.url``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import metrics as _metrics
+
+__all__ = ["MetricsExporter", "start_exporter"]
+
+#: Prometheus text exposition content type (format 0.0.4)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serves ``registry.exposition()`` at ``/metrics`` and a liveness
+    JSON at ``/healthz`` from a daemon thread."""
+
+    def __init__(self, port: int = 9464, host: str = "127.0.0.1",
+                 registry: Optional[_metrics.MetricsRegistry] = None):
+        reg = registry if registry is not None else _metrics.registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = reg.exposition().encode("utf-8")
+                    self._reply(200, CONTENT_TYPE, body)
+                elif path == "/healthz":
+                    body = json.dumps({
+                        "status": "ok",
+                        "metrics_enabled": reg.is_enabled,
+                        "families": len(reg.names()),
+                    }).encode("utf-8")
+                    self._reply(200, "application/json", body)
+                else:
+                    self._reply(404, "text/plain",
+                                b"not found (try /metrics or /healthz)")
+
+            def _reply(self, code, ctype, body):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrape spam stays off stderr
+                pass
+
+        self.registry = reg
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"metrics-exporter:{self.port}")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def start_exporter(port: int = 9464, host: str = "127.0.0.1",
+                   registry: Optional[_metrics.MetricsRegistry] = None,
+                   enable: bool = True) -> MetricsExporter:
+    """Start the endpoint; by default also enables collection (a scrape
+    target serving a disabled registry would be all zeros — surprising
+    enough to be the wrong default)."""
+    if enable:
+        (registry if registry is not None else _metrics.registry()).enable()
+    return MetricsExporter(port=port, host=host, registry=registry)

@@ -1,0 +1,129 @@
+"""Element-chain instrumentation: wraps a pipeline's elements so every
+buffer feeds the metrics registry.
+
+One mechanism serves two consumers: ``Pipeline.start`` attaches it to
+the process-global registry when metrics are enabled (always-on
+telemetry for the exporter), and ``PipelineTracer`` attaches it to a
+private registry for a per-run report. Both see the same series:
+
+  * ``nnstpu_pipeline_buffers_total{element}`` — buffers entering chain
+  * ``nnstpu_pipeline_proctime_seconds{element}`` — chain latency
+    histogram (GstShark ``proctime`` analog)
+  * ``nnstpu_pipeline_interlatency_seconds{element}`` — source-stamp to
+    chain-entry latency (GstShark ``interlatency`` analog)
+  * ``nnstpu_pipeline_errors_total{element}`` — chain errors/exceptions
+  * ``nnstpu_pipeline_queue_depth{element}`` — queue occupancy, read at
+    collection time (zero hot-path cost)
+
+The disabled fast path is structural: when metrics are off at start
+time nothing here runs, element ``_chain_entry`` stays the plain class
+method, and the hot path pays nothing (tests/test_obs.py pins this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from .metrics import MetricsRegistry, registry as _global_registry
+
+__all__ = ["instrument_pipeline", "maybe_instrument_pipeline"]
+
+
+def _families(reg: MetricsRegistry):
+    return {
+        "bufs": reg.counter(
+            "nnstpu_pipeline_buffers_total",
+            "Buffers entering each element's chain", ("element",)),
+        "proc": reg.histogram(
+            "nnstpu_pipeline_proctime_seconds",
+            "Per-element chain processing time", ("element",)),
+        "inter": reg.histogram(
+            "nnstpu_pipeline_interlatency_seconds",
+            "Latency from source stamp to element chain entry",
+            ("element",)),
+        "errs": reg.counter(
+            "nnstpu_pipeline_errors_total",
+            "Chain errors (exceptions or FlowReturn.ERROR) per element",
+            ("element",)),
+        "qdepth": reg.gauge(
+            "nnstpu_pipeline_queue_depth",
+            "Queue element occupancy (buffers)", ("element",)),
+    }
+
+
+def _wrapped_registries(el: Any) -> list:
+    regs = el.__dict__.get("_obs_registries")
+    if regs is None:
+        regs = []
+        el._obs_registries = regs
+    return regs
+
+
+def instrument_pipeline(pipeline: Any,
+                        reg: Optional[MetricsRegistry] = None) -> None:
+    """Wrap every element of ``pipeline`` to record into ``reg`` (the
+    process-global registry by default). Idempotent per (element,
+    registry): safe across restarts and combined tracer + exporter use
+    (each consumer's wrap records to its own registry)."""
+    from ..core.buffer import Buffer
+    from ..graph.element import FlowReturn
+    from ..graph.pipeline import Queue
+
+    if reg is None:
+        reg = _global_registry()
+    fams = _families(reg)
+    for el in pipeline.elements.values():
+        regs = _wrapped_registries(el)
+        if any(r is reg for r in regs):
+            continue
+        regs.append(reg)
+        if isinstance(el, Queue):
+            # collection-time callback — queues' own locking protects
+            # len() reads well enough for a monitoring sample
+            fams["qdepth"].labels(el.name).set_function(
+                lambda _el=el: len(_el._dq))
+        if el.is_source:
+            orig_create = getattr(el, "create", None)
+            if orig_create is not None:
+                def create_stamped(_orig=orig_create):
+                    buf = _orig()
+                    if buf is not None:
+                        buf.meta.setdefault("trace_t0_ns",
+                                            time.monotonic_ns())
+                    return buf
+
+                el.create = create_stamped
+            continue
+        bufs = fams["bufs"].labels(el.name)
+        proc = fams["proc"].labels(el.name)
+        inter = fams["inter"].labels(el.name)
+        errs = fams["errs"].labels(el.name)
+        orig = el._chain_entry
+
+        def timed_chain(pad, buf, _orig=orig, _bufs=bufs, _proc=proc,
+                        _inter=inter, _errs=errs):
+            t0 = buf.meta.get("trace_t0_ns") \
+                if isinstance(buf, Buffer) else None
+            start = time.monotonic_ns()
+            if t0 is not None:
+                _inter.observe((start - t0) / 1e9)
+            _bufs.inc()
+            try:
+                ret = _orig(pad, buf)
+            except Exception:
+                _errs.inc()
+                raise
+            _proc.observe((time.monotonic_ns() - start) / 1e9)
+            if ret is FlowReturn.ERROR:
+                _errs.inc()
+            return ret
+
+        el._chain_entry = timed_chain
+
+
+def maybe_instrument_pipeline(pipeline: Any) -> None:
+    """Pipeline.start hook: attach to the global registry iff metrics
+    are enabled — the structural no-op fast path when they are not."""
+    if _global_registry().is_enabled:
+        instrument_pipeline(pipeline)

@@ -136,6 +136,15 @@ def make_tp_prefill(n_heads: int, max_len: int, mesh, axis: str = "model"):
         if quantized not in compiled:
             compiled[quantized] = build(quantized)
         tl = tokens.shape[1] if true_len is None else true_len
+        # eager true_len validation, mirroring the prompt-length check:
+        # an out-of-range value (empty prompt, or longer than the padded
+        # T) would silently emit pad-row logits and garbage cache state
+        if not isinstance(tl, jax.core.Tracer):
+            tl_v = int(tl)
+            if not 1 <= tl_v <= tokens.shape[1]:
+                raise ValueError(
+                    f"tp_prefill: true_len={tl_v} outside "
+                    f"[1, {tokens.shape[1]}] (padded prompt length)")
         with jax.default_matmul_precision("float32"):
             return compiled[quantized](
                 tp_params, jnp.asarray(tokens),

@@ -32,6 +32,7 @@ from ..core.buffer import Buffer
 from ..core.log import logger
 from ..core.types import Caps, TensorFormat
 from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..obs import metrics as _obs
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -81,6 +82,23 @@ class TensorQueryClient(Element):
         #: detectable by traffic); short gaps skip the probe so steady
         #: streams never pay the extra round trip
         self.idle_probe_s = 0.5
+        # offload telemetry (obs subsystem; message/byte counts live at
+        # the protocol layer): dials, request round trips, and the
+        # pipelined in-flight window (collection-time read, no hot cost)
+        reg = _obs.registry()
+        self._m_reconnects = reg.counter(
+            "nnstpu_query_reconnects_total",
+            "Client connection dials (first connect + reconnects)",
+            ("element",)).labels(self.name)
+        self._m_rtt = reg.histogram(
+            "nnstpu_query_roundtrip_seconds",
+            "Request submit to result round-trip latency",
+            ("element",)).labels(self.name)
+        reg.gauge(
+            "nnstpu_query_inflight_depth",
+            "Pipelined requests currently in flight",
+            ("element",)).labels(self.name).set_function(
+                lambda: len(self._pending))
 
     # -- connection ---------------------------------------------------------- #
     def _resolve_endpoints(self) -> list:
@@ -110,6 +128,7 @@ class TensorQueryClient(Element):
                 cmd, meta, _ = recv_message(sock)
                 if cmd is not Cmd.INFO_APPROVE:
                     raise ConnectionError(f"server denied connection: {meta}")
+                self._m_reconnects.inc()
                 return sock
             except (OSError, QueryProtocolError, ConnectionError) as e:
                 last = e
@@ -190,8 +209,9 @@ class TensorQueryClient(Element):
                 with self._cv:
                     # pop only AFTER the push: an EOS drain waiting on the
                     # window must not race past a result still mid-push
-                    self._pending.popleft()
+                    done = self._pending.popleft()
                     self._cv.notify_all()
+                self._m_rtt.observe(time.monotonic() - done[4])
         except (ConnectionError, OSError, QueryProtocolError) as e:
             with self._cv:
                 # SENT frames (send_message returned) are lost; entries
@@ -294,7 +314,9 @@ class TensorQueryClient(Element):
                     self._cv.wait(0.1)
                 if self._reader_error is not None:
                     return FlowReturn.ERROR
-                entry = [buf.pts, buf.duration, buf.offset, False]
+                # 5th field: submit stamp for the round-trip histogram
+                entry = [buf.pts, buf.duration, buf.offset, False,
+                         time.monotonic()]
                 self._pending.append(entry)
             try:
                 send_message(sock, Cmd.DATA, meta, payload)
@@ -348,12 +370,14 @@ class TensorQueryClient(Element):
         for attempt in range(max(int(self.max_request_retry), 1)):
             try:
                 sock = self._ensure_conn()
+                t_send = time.monotonic()
                 send_message(sock, Cmd.DATA, meta, payload)
                 cmd, rmeta, rpayload = recv_message(sock)
                 if cmd is Cmd.ERROR:
                     raise QueryProtocolError(rmeta.get("error", "server error"))
                 if cmd is not Cmd.RESULT:
                     raise QueryProtocolError(f"unexpected reply {cmd}")
+                self._m_rtt.observe(time.monotonic() - t_send)
                 out = payload_to_buffer(rmeta, rpayload)
                 out.pts, out.duration, out.offset = buf.pts, buf.duration, buf.offset
                 return self.push(out)

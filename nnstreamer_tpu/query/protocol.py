@@ -34,6 +34,7 @@ import numpy as np
 from ..core.buffer import Buffer, TensorMemory
 from ..core.meta import META_SIZE, TensorMetaInfo, unwrap_flex, wrap_flex
 from ..core.types import TensorFormat
+from ..obs import metrics as _obs
 
 MAGIC = 0x4E515250
 _HEADER = struct.Struct("<IBIQ")
@@ -60,6 +61,19 @@ class Cmd(enum.IntEnum):
 
 class QueryProtocolError(RuntimeError):
     pass
+
+
+#: wire-level telemetry shared by BOTH roles (client and server live in
+#: one process in tests and hybrid deployments): message counts by
+#: direction x command, and payload bytes by direction. Registered at
+#: import; recording is a no-op until metrics are enabled.
+_MSG_TOTAL = _obs.registry().counter(
+    "nnstpu_query_messages_total",
+    "Query protocol messages by direction and command",
+    ("direction", "cmd"))
+_BYTES_TOTAL = _obs.registry().counter(
+    "nnstpu_query_bytes_total",
+    "Query protocol payload bytes by direction", ("direction",))
 
 
 #: max bytes per wire chunk; also the granularity of receive timeouts
@@ -107,6 +121,8 @@ def recv_message(sock: socket.socket,
                  ) -> Tuple[Cmd, Dict[str, Any], bytes]:
     cmd, meta, payload = _recv_one(sock)
     if cmd is not Cmd.CHUNK_START:
+        _MSG_TOTAL.labels("recv", cmd.name).inc()
+        _BYTES_TOTAL.labels("recv").inc(len(payload))
         return cmd, meta, payload
     # chunked transfer: assemble into a preallocated buffer under a
     # per-chunk timeout
@@ -146,6 +162,8 @@ def recv_message(sock: socket.socket,
                 if got != total:
                     raise QueryProtocolError(
                         f"chunked transfer incomplete: {got}/{total} bytes")
+                _MSG_TOTAL.labels("recv", inner.name).inc()
+                _BYTES_TOTAL.labels("recv").inc(total)
                 return inner, meta, bytes(assembled)
             else:
                 raise QueryProtocolError(
@@ -156,6 +174,8 @@ def recv_message(sock: socket.socket,
 
 def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
                  payload: bytes = b"") -> None:
+    _MSG_TOTAL.labels("sent", cmd.name).inc()
+    _BYTES_TOTAL.labels("sent").inc(len(payload))
     if len(payload) <= CHUNK_SIZE:
         sock.sendall(pack_message(cmd, meta, payload))
         return

@@ -26,6 +26,7 @@ from ..core.log import logger
 from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..graph.pipeline import SourceElement
+from ..obs import metrics as _obs
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -75,6 +76,20 @@ class TensorQueryServerSrc(SourceElement):
         self._conn_seq = 0
         self._inbox: "__import__('queue').Queue" = None
         self._threads = []
+        # server-side offload telemetry (message/byte counts live at the
+        # protocol layer): accepted connections, and inbox depth read at
+        # collection time
+        reg = _obs.registry()
+        self._m_conns = reg.counter(
+            "nnstpu_query_connections_total",
+            "Client connections accepted by the server listener",
+            ("element",)).labels(self.name)
+        reg.gauge(
+            "nnstpu_query_inbox_depth",
+            "Frames queued between the server listener and its pipeline",
+            ("element",)).labels(self.name).set_function(
+                lambda: self._inbox.qsize() if self._inbox is not None
+                else 0)
 
     # -- lifecycle ---------------------------------------------------------- #
     def negotiate(self) -> Caps:
@@ -113,6 +128,7 @@ class TensorQueryServerSrc(SourceElement):
             # small RESULT write ~40 ms — measured 65 ms/frame round trips
             # on localhost vs sub-ms with it
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._m_conns.inc()
             with self._lock:
                 self._conn_seq += 1
                 cid = self._conn_seq

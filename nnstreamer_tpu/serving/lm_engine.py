@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import causal_lm
+from ..obs import metrics as _obs
 from ..ops.int8 import stack_shape
 from . import sampling
 
@@ -170,6 +171,7 @@ class _Request:
     seed: int = 0
     out: List[int] = field(default_factory=list)
     done: bool = False
+    t_submit: float = 0.0       # monotonic stamp for the TTFT histogram
 
 
 class LMEngine:
@@ -240,6 +242,59 @@ class LMEngine:
                       "tokens_out": 0, "wall_s": 0.0,
                       "spec_iterations": 0, "spec_drafted": 0,
                       "spec_accepted": 0}
+        self._init_metrics()
+
+    #: distinguishes engine kinds in the metric series; the TP engine
+    #: overrides to "tp"
+    _engine_label = "lm"
+
+    def _init_metrics(self) -> None:
+        """Register the serving metric families (obs subsystem). Handles
+        are real whether or not collection is enabled — recording is the
+        registry's cheap no-op when it is off. Depth-style gauges read
+        through weakrefs at collection time so holding them never pins a
+        retired engine's device caches."""
+        import weakref
+
+        reg = _obs.registry()
+        lbl = self._engine_label
+        self._m_streams = reg.counter(
+            "nnstpu_serving_streams_total",
+            "Streams admitted into slots / completed",
+            ("engine", "event"))
+        self._m_tokens = reg.counter(
+            "nnstpu_serving_tokens_total",
+            "Generated tokens across completed streams",
+            ("engine",)).labels(lbl)
+        self._m_ttft = reg.histogram(
+            "nnstpu_serving_ttft_seconds",
+            "Submit-to-first-token latency", ("engine",)).labels(lbl)
+        self._m_tok_lat = reg.histogram(
+            "nnstpu_serving_token_latency_seconds",
+            "Per-token decode latency (chunk wall / steps, sampled "
+            "once per chunk)", ("engine",)).labels(lbl)
+        self._m_prefills = reg.counter(
+            "nnstpu_serving_prefills_total",
+            "Prompt prefills by padded bucket length",
+            ("engine", "bucket"))
+        self._m_compiles = reg.counter(
+            "nnstpu_serving_prefill_compiles_total",
+            "First-use prefill buckets (each is one XLA compile)",
+            ("engine", "bucket"))
+        self._seen_buckets: set = set()
+        # gauges sample the MOST RECENTLY constructed engine per label
+        ref = weakref.ref(self)
+        reg.gauge(
+            "nnstpu_serving_active_slots",
+            "Slots currently occupied by a live stream",
+            ("engine",)).labels(lbl).set_function(
+                lambda: sum(r is not None for r in ref()._slot_req)
+                if ref() is not None else 0)
+        reg.gauge(
+            "nnstpu_serving_queue_depth",
+            "Requests queued awaiting a free slot",
+            ("engine",)).labels(lbl).set_function(
+                lambda: len(ref()._queue) if ref() is not None else 0)
 
     def _alloc_slot_caches(self, n_layers: int, hd: int):
         """Zero per-slot KV stores, (S, L·H, max_len, hd). Overridden by
@@ -275,7 +330,8 @@ class LMEngine:
         self._next_rid += 1
         self._queue.append(_Request(
             rid, p, max_new, eos, temperature=float(temperature),
-            top_k=int(top_k), top_p=float(top_p), seed=int(seed)))
+            top_k=int(top_k), top_p=float(top_p), seed=int(seed),
+            t_submit=time.monotonic()))
         return rid
 
     def pending(self) -> int:
@@ -320,6 +376,12 @@ class LMEngine:
             tk, tp = jnp.int32(req.top_k), jnp.float32(req.top_p)
             first = self._prefill_into(slot, padded, t, skey, temp, tk, tp)
             self.stats["prefills"] += 1
+            lbl = self._engine_label
+            self._m_prefills.labels(lbl, str(tb)).inc()
+            if tb not in self._seen_buckets:
+                self._seen_buckets.add(tb)
+                self._m_compiles.labels(lbl, str(tb)).inc()
+            self._m_streams.labels(lbl, "admitted").inc()
             sl = jnp.int32(slot)
             self._tokens = _slot_insert(
                 self._tokens, first.reshape(1, 1), sl)
@@ -328,6 +390,10 @@ class LMEngine:
             self._topk = _slot_insert(self._topk, tk, sl)
             self._topp = _slot_insert(self._topp, tp, sl)
             req.out.append(int(first))
+            # TTFT after the int() materialization: the prefill dispatch
+            # is async, so the first token only exists for the caller
+            # once that D2H read completes
+            self._m_ttft.observe(time.monotonic() - req.t_submit)
             self._pos_host[slot] = t
             self._slot_req[slot] = req
             self._retire_if_done(slot, req)
@@ -384,7 +450,9 @@ class LMEngine:
             # one per tail length (full-size chunks keep the user's
             # exact value, whatever it is)
             n = 1 << (n.bit_length() - 1)
+        t0 = time.monotonic()
         outs = np.asarray(self._run_chunk(n))  # (S, n)
+        self._m_tok_lat.observe((time.monotonic() - t0) / n)
         for s in range(self.n_slots):
             self._pos_host[s] += n  # device pos advances for EVERY slot
         self.stats["decode_steps"] += n
@@ -434,10 +502,17 @@ class LMEngine:
             drafts[s] = self._draft_tokens(self._slot_req[s], g)
         tokens_in = jnp.concatenate(
             [self._tokens[:, 0], jnp.asarray(drafts)], axis=1)  # (S, 1+g)
+        t0 = time.monotonic()
         (self._tokens, self._kc, self._vc, self._pos, outs, m) = \
             self._run_verify(tokens_in)
         outs = np.asarray(outs)
         m = np.asarray(m)
+        # per-token latency of the verify dispatch: wall over the mean
+        # ACCEPTED tokens across active slots (that is what a consumer
+        # of this stream experienced)
+        accepted = float(np.mean(m[active])) if active else 1.0
+        self._m_tok_lat.observe(
+            (time.monotonic() - t0) / max(accepted, 1.0))
         for s in range(self.n_slots):
             # unlike chunks, per-slot advance is data-dependent — the
             # mirror updates from the fetched acceptance counts
@@ -492,6 +567,8 @@ class LMEngine:
         if hit_eos or len(req.out) >= req.max_new:
             req.done = True
             self.stats["tokens_out"] += len(req.out)
+            self._m_streams.labels(self._engine_label, "completed").inc()
+            self._m_tokens.inc(len(req.out))
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None
             if req.temperature > 0.0:

@@ -179,6 +179,10 @@ class TPLMEngine(LMEngine):
     """Continuous-batching engine with the KV cache head-sharded over
     ``mesh[axis]``. Same public API and outputs as `LMEngine`."""
 
+    #: serving metrics series carry engine="tp" so single-device and
+    #: mesh-sharded engines are separable on one scrape endpoint
+    _engine_label = "tp"
+
     def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
                  mesh: Mesh, axis: str = "model", **kw) -> None:
         n = mesh.shape[axis]

@@ -1,108 +1,79 @@
 """Pipeline tracing & profiling.
 
 Reference (SURVEY §5): no in-tree tracer; users attach GstShark tracers
-(``interlatency``, ``proctime``) plus per-filter invoke stats. Here tracing
-is in-tree: a ``PipelineTracer`` wraps every element's chain to record
-per-element processing time (proctime) and source→element latency
-(interlatency), and ``device_trace`` brackets a run with jax.profiler for
-XLA/TPU timelines (xprof).
+(``interlatency``, ``proctime``) plus per-filter invoke stats. Here the
+mechanism is the obs subsystem: ``PipelineTracer`` is a thin consumer
+of a ``MetricsRegistry`` — it attaches the same element-chain
+instrumentation the live ``/metrics`` exporter uses
+(obs/instrument.py), records into a private registry, and renders a
+per-run report from its snapshot. One wrapping mechanism, two
+consumers; no parallel bookkeeping.
 
     tracer = PipelineTracer.attach(pipeline)
     pipeline.run()
     print(tracer.report())
+
+``device_trace`` brackets a run with jax.profiler for XLA/TPU
+timelines (xprof).
 """
 
 from __future__ import annotations
 
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
-from ..core.buffer import Buffer
-
-
-@dataclass
-class ElementTrace:
-    name: str
-    n: int = 0
-    total_ns: int = 0
-    max_ns: int = 0
-    # interlatency: time from buffer PTS-origin entry into pipeline to entry
-    # into this element (needs source stamping wall-clock in buf.meta)
-    inter_total_ns: int = 0
-    inter_n: int = 0
-
-    @property
-    def proctime_us(self) -> float:
-        return self.total_ns / max(self.n, 1) / 1000
-
-    @property
-    def interlatency_us(self) -> float:
-        return self.inter_total_ns / max(self.inter_n, 1) / 1000
+from ..obs.instrument import instrument_pipeline
+from ..obs.metrics import MetricsRegistry
 
 
 class PipelineTracer:
-    """Wraps element chains to collect proctime/interlatency per element."""
+    """Per-run proctime/interlatency report over a private registry."""
 
-    def __init__(self) -> None:
-        self.traces: Dict[str, ElementTrace] = {}
-        self._lock = threading.Lock()
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        #: private + always-enabled: a tracer must record even when the
+        #: process-global telemetry is off, and must not pollute it
+        self.registry = registry or MetricsRegistry(enabled=True)
 
     @classmethod
     def attach(cls, pipeline: Any) -> "PipelineTracer":
         tracer = cls()
-        for el in pipeline.elements.values():
-            tracer._wrap(el)
+        instrument_pipeline(pipeline, tracer.registry)
         return tracer
 
-    def _wrap(self, el: Any) -> None:
-        trace = self.traces.setdefault(el.name, ElementTrace(el.name))
-        if el.is_source:
-            orig_create = getattr(el, "create", None)
-            if orig_create is not None:
-                def create_stamped(_orig=orig_create):
-                    buf = _orig()
-                    if buf is not None:
-                        buf.meta.setdefault("trace_t0_ns", time.monotonic_ns())
-                    return buf
+    def _stats(self) -> Dict[str, Dict[str, float]]:
+        snap = self.registry.snapshot()
 
-                el.create = create_stamped
-            return
-        orig = el._chain_entry
+        def per_element(name):
+            out: Dict[str, Dict[str, float]] = {}
+            for s in snap.get(name, {}).get("series", []):
+                out[s["labels"]["element"]] = s
+            return out
 
-        def timed_chain(pad, buf, _orig=orig, _t=trace):
-            now = time.monotonic_ns()
-            t0 = buf.meta.get("trace_t0_ns") if isinstance(buf, Buffer) else None
-            start = time.monotonic_ns()
-            ret = _orig(pad, buf)
-            dt = time.monotonic_ns() - start
-            with self._lock:
-                _t.n += 1
-                _t.total_ns += dt
-                _t.max_ns = max(_t.max_ns, dt)
-                if t0 is not None:
-                    _t.inter_n += 1
-                    _t.inter_total_ns += now - t0
-            return ret
-
-        el._chain_entry = timed_chain
+        proc = per_element("nnstpu_pipeline_proctime_seconds")
+        inter = per_element("nnstpu_pipeline_interlatency_seconds")
+        stats: Dict[str, Dict[str, float]] = {}
+        for el in set(proc) | set(inter):
+            p = proc.get(el, {"count": 0, "sum": 0.0, "max": 0.0})
+            i = inter.get(el, {"count": 0, "sum": 0.0})
+            n = int(p["count"])
+            stats[el] = {
+                "n": n,
+                "proctime_us": p["sum"] / max(n, 1) * 1e6,
+                "max_us": p["max"] * 1e6,
+                "interlatency_us":
+                    i["sum"] / max(int(i["count"]), 1) * 1e6,
+            }
+        return stats
 
     def report(self) -> str:
         lines = [f"{'element':<24}{'bufs':>7}{'proctime(us)':>14}"
                  f"{'max(us)':>10}{'interlat(us)':>14}"]
-        for t in self.traces.values():
-            if t.n == 0 and t.inter_n == 0:
-                continue
-            lines.append(f"{t.name:<24}{t.n:>7}{t.proctime_us:>14.1f}"
-                         f"{t.max_ns / 1000:>10.1f}{t.interlatency_us:>14.1f}")
+        for name, t in self._stats().items():
+            lines.append(f"{name:<24}{t['n']:>7}{t['proctime_us']:>14.1f}"
+                         f"{t['max_us']:>10.1f}{t['interlatency_us']:>14.1f}")
         return "\n".join(lines)
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
-        return {t.name: {"n": t.n, "proctime_us": t.proctime_us,
-                         "max_us": t.max_ns / 1000,
-                         "interlatency_us": t.interlatency_us}
-                for t in self.traces.values()}
+        return self._stats()
 
 
 class device_trace:

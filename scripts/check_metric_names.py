@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Lint registered metric names against the repo naming convention.
+
+Convention (docs/observability.md): every metric is
+``nnstpu_<layer>_<name>_<unit>`` with
+
+  * layer  in {pipeline, query, serving},
+  * counters    ending in ``_total``,
+  * histograms  ending in ``_seconds``,
+  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes``.
+
+The check greps source for literal first arguments of
+``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
+calls, so drift fails CI (wired as a tier-1 test:
+tests/test_metric_names.py) the moment an off-convention name lands.
+Registrations built from non-literal names are invisible to this lint
+— keep names literal.
+
+Exit 0 when clean; exit 1 listing every violation.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
+
+LAYERS = ("pipeline", "query", "serving")
+UNIT_BY_TYPE = {
+    "counter": ("total",),
+    "histogram": ("seconds",),
+    "gauge": ("depth", "slots", "bytes"),
+}
+
+#: reg.counter("name"... — dotted call so plain functions named e.g.
+#: ``gauge()`` elsewhere don't false-positive
+_CALL_RE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+
+_NAME_RE = re.compile(
+    r"^nnstpu_(?P<layer>[a-z0-9]+)_(?P<body>[a-z0-9_]+)_(?P<unit>[a-z0-9]+)$")
+
+
+def iter_registrations(root: Path = SOURCE_ROOT):
+    """Yield (path, lineno, metric_type, name) for every literal-name
+    registry call under ``root``."""
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        # whole-file scan: registrations routinely wrap the name onto
+        # the line after the open paren (\s* spans newlines)
+        for m in _CALL_RE.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            yield path, lineno, m.group(1), m.group(2)
+
+
+def check(root: Path = SOURCE_ROOT):
+    """Return a list of violation strings (empty = clean)."""
+    problems = []
+    found = 0
+    for path, lineno, mtype, name in iter_registrations(root):
+        found += 1
+        rel = path.relative_to(REPO_ROOT) if REPO_ROOT in path.parents \
+            else path
+        where = f"{rel}:{lineno}"
+        m = _NAME_RE.match(name)
+        if m is None:
+            problems.append(
+                f"{where}: {name!r} does not match "
+                "nnstpu_<layer>_<name>_<unit>")
+            continue
+        if m.group("layer") not in LAYERS:
+            problems.append(
+                f"{where}: {name!r} layer {m.group('layer')!r} not in "
+                f"{LAYERS}")
+        units = UNIT_BY_TYPE[mtype]
+        if m.group("unit") not in units:
+            problems.append(
+                f"{where}: {name!r} is a {mtype} but unit "
+                f"{m.group('unit')!r} not in {units}")
+    if found == 0:
+        problems.append(
+            f"no metric registrations found under {root} — "
+            "lint regex out of sync with the registry API?")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(p, file=sys.stderr)
+        print(f"{len(problems)} metric naming violation(s)",
+              file=sys.stderr)
+        return 1
+    n = sum(1 for _ in iter_registrations())
+    print(f"metric names OK ({n} registrations checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Tier-1 wiring for scripts/check_metric_names.py: every registered
+metric name must follow nnstpu_<layer>_<name>_<unit>."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+SCRIPT = REPO_ROOT / "scripts" / "check_metric_names.py"
+
+
+def test_lint_passes_on_tree():
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)], capture_output=True, text=True,
+        cwd=REPO_ROOT, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metric names OK" in proc.stdout
+
+
+def test_lint_catches_violations(tmp_path):
+    """The checker actually rejects off-convention names (guards against
+    a regex rot that silently passes everything)."""
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'reg.counter("nnstpu_pipeline_stuff_seconds", "h")\n'   # counter unit
+        'reg.gauge("nnstpu_webui_queue_depth", "h")\n'          # bad layer
+        'reg.histogram("freeform_name", "h")\n')                # no convention
+    problems = lint.check(tmp_path)
+    assert len(problems) == 3
+    assert any("not in ('total',)" in p for p in problems)
+    assert any("layer 'webui'" in p for p in problems)
+
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert any("no metric registrations" in p for p in lint.check(empty))

@@ -1,0 +1,228 @@
+"""obs subsystem unit tests: registry semantics (labels, concurrency,
+histogram buckets), Prometheus text golden, the disabled no-op fast
+path, and the HTTP exporter."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from nnstreamer_tpu.obs import metrics as obs_metrics
+from nnstreamer_tpu.obs.exporter import start_exporter
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+
+class TestRegistry:
+    def test_counter_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("nnstpu_query_messages_total", "m",
+                        ("direction", "cmd"))
+        c.labels("sent", "DATA").inc()
+        c.labels("sent", "DATA").inc(2)
+        c.labels("recv", "RESULT").inc()
+        assert c.labels("sent", "DATA").value == 3
+        assert c.labels("recv", "RESULT").value == 1
+        with pytest.raises(ValueError, match="only go up"):
+            c.labels("sent", "DATA").inc(-1)
+
+    def test_labels_by_name_and_arity(self):
+        reg = MetricsRegistry()
+        c = reg.counter("nnstpu_query_messages_total", "m",
+                        ("direction", "cmd"))
+        assert c.labels(direction="sent", cmd="DATA") is \
+            c.labels("sent", "DATA")
+        with pytest.raises(ValueError, match="expected labels"):
+            c.labels("sent")
+
+    def test_reregistration_idempotent_and_conflicts(self):
+        reg = MetricsRegistry()
+        a = reg.counter("nnstpu_query_messages_total", "m", ("cmd",))
+        b = reg.counter("nnstpu_query_messages_total", "m", ("cmd",))
+        assert a is b
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("nnstpu_query_messages_total", "m", ("cmd",))
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("nnstpu_query_messages_total", "m", ("other",))
+
+    def test_gauge_set_inc_and_callback(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("nnstpu_pipeline_queue_depth", "d", ("element",))
+        g.labels("q0").set(5)
+        g.labels("q0").dec(2)
+        assert g.labels("q0").value == 3
+        state = {"depth": 7}
+        g.labels("q1").set_function(lambda: state["depth"])
+        assert g.labels("q1").value == 7
+        state["depth"] = 9
+        assert g.labels("q1").value == 9
+
+    def test_histogram_buckets_sum_count_max(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("nnstpu_serving_ttft_seconds", "t",
+                          buckets=(0.1, 1.0, 5.0))
+        for v in (0.05, 0.5, 3.0, 10.0, 1.0):  # 1.0 lands IN le="1"
+            h.observe(v)
+        child = h.labels()
+        assert child.count == 5
+        assert child.max == 10.0
+        assert abs(child.sum - 14.55) < 1e-9
+        snap = reg.snapshot()["nnstpu_serving_ttft_seconds"]["series"][0]
+        assert snap["buckets"] == {0.1: 1, 1.0: 3, 5.0: 4}
+        assert snap["count"] == 5
+
+    def test_default_buckets_log_spaced(self):
+        b = obs_metrics.DEFAULT_LATENCY_BUCKETS
+        assert b == tuple(sorted(b))
+        assert b[0] == 1e-5 and b[-1] == 50.0
+        ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+        assert max(ratios) <= 4.0  # no decade-sized holes
+
+    def test_disabled_registry_noop_then_enable(self):
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("nnstpu_query_messages_total", "m")
+        h = reg.histogram("nnstpu_serving_ttft_seconds", "t")
+        c.inc()
+        h.observe(1.0)
+        assert c.labels().value == 0
+        assert h.labels().count == 0
+        reg.enable()
+        c.inc()
+        assert c.labels().value == 1
+
+    def test_concurrent_increments_exact(self):
+        reg = MetricsRegistry()
+        c = reg.counter("nnstpu_query_messages_total", "m", ("cmd",))
+        h = reg.histogram("nnstpu_serving_ttft_seconds", "t",
+                          buckets=(1.0,))
+        n, per = 8, 2000
+
+        def worker():
+            for _ in range(per):
+                c.labels("DATA").inc()
+                h.observe(0.5)
+
+        threads = [threading.Thread(target=worker) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.labels("DATA").value == n * per
+        assert h.labels().count == n * per
+        assert h.labels()._bucket_counts[0] == n * per
+
+
+class TestExposition:
+    def test_prometheus_text_golden(self):
+        reg = MetricsRegistry()
+        c = reg.counter("nnstpu_query_messages_total", "Messages",
+                        ("direction", "cmd"))
+        c.labels("sent", "DATA").inc(3)
+        g = reg.gauge("nnstpu_pipeline_queue_depth", "Depth", ("element",))
+        g.labels("q0").set(2)
+        h = reg.histogram("nnstpu_serving_ttft_seconds", "TTFT",
+                          buckets=(0.1, 1.0, 5.0))
+        h.observe(0.05)
+        h.observe(3.0)
+        expected = """\
+# HELP nnstpu_pipeline_queue_depth Depth
+# TYPE nnstpu_pipeline_queue_depth gauge
+nnstpu_pipeline_queue_depth{element="q0"} 2
+# HELP nnstpu_query_messages_total Messages
+# TYPE nnstpu_query_messages_total counter
+nnstpu_query_messages_total{direction="sent",cmd="DATA"} 3
+# HELP nnstpu_serving_ttft_seconds TTFT
+# TYPE nnstpu_serving_ttft_seconds histogram
+nnstpu_serving_ttft_seconds_bucket{le="0.1"} 1
+nnstpu_serving_ttft_seconds_bucket{le="1"} 1
+nnstpu_serving_ttft_seconds_bucket{le="5"} 2
+nnstpu_serving_ttft_seconds_bucket{le="+Inf"} 2
+nnstpu_serving_ttft_seconds_sum 3.05
+nnstpu_serving_ttft_seconds_count 2
+"""
+        assert reg.exposition() == expected
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("nnstpu_query_messages_total", "m", ("cmd",))
+        c.labels('we"ird\\x\n').inc()
+        text = reg.exposition()
+        assert 'cmd="we\\"ird\\\\x\\n"' in text
+
+    def test_empty_registry_empty_exposition(self):
+        assert MetricsRegistry().exposition() == ""
+
+
+@pytest.fixture
+def global_metrics():
+    """Save/restore the process-global enabled flag around a test."""
+    was = obs_metrics.enabled()
+    yield obs_metrics.registry()
+    (obs_metrics.enable if was else obs_metrics.disable)()
+
+
+def _tiny_pipeline():
+    from nnstreamer_tpu.graph import Pipeline
+
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=8, height=8, num_buffers=2)
+    conv = p.add_new("tensor_converter")
+    sink = p.add_new("tensor_sink")
+    Pipeline.link(src, conv, sink)
+    return p, conv
+
+
+class TestNoopFastPath:
+    def test_disabled_leaves_chain_entry_untouched(self, global_metrics):
+        obs_metrics.disable()
+        p, conv = _tiny_pipeline()
+        p.run(timeout=30)
+        # the structural fast path: no wrapper was installed at all —
+        # _chain_entry resolves to the plain class method, zero overhead
+        assert "_chain_entry" not in conv.__dict__
+        assert "_obs_registries" not in conv.__dict__
+
+    def test_enabled_wraps_and_records(self, global_metrics):
+        obs_metrics.enable()
+        p, conv = _tiny_pipeline()
+        p.run(timeout=30)
+        assert "_chain_entry" in conv.__dict__
+        snap = obs_metrics.registry().snapshot()
+        series = snap["nnstpu_pipeline_buffers_total"]["series"]
+        by_el = {s["labels"]["element"]: s["value"] for s in series}
+        assert by_el[conv.name] >= 2
+
+    def test_restart_does_not_double_wrap(self, global_metrics):
+        obs_metrics.enable()
+        p, conv = _tiny_pipeline()
+        p.run(timeout=30)
+        wrapped = conv.__dict__["_chain_entry"]
+        p.run(timeout=30)
+        assert conv.__dict__["_chain_entry"] is wrapped
+
+
+class TestExporter:
+    def test_scrape_and_healthz(self, global_metrics):
+        reg = MetricsRegistry()
+        reg.counter("nnstpu_query_messages_total", "m", ("cmd",)) \
+            .labels("DATA").inc(4)
+        with start_exporter(port=0, registry=reg) as exp:
+            text = urllib.request.urlopen(exp.url, timeout=5) \
+                .read().decode()
+            assert 'nnstpu_query_messages_total{cmd="DATA"} 4' in text
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/healthz", timeout=5)
+                .read().decode())
+            assert health["status"] == "ok"
+            assert health["families"] == 1
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/nope", timeout=5)
+
+    def test_start_exporter_enables_collection(self, global_metrics):
+        obs_metrics.disable()
+        exp = start_exporter(port=0)
+        try:
+            assert obs_metrics.enabled()
+        finally:
+            exp.close()
