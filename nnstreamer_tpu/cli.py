@@ -28,6 +28,10 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                     help="enable metrics and serve /metrics + /healthz on "
                          "this port while the pipeline runs (0 = ephemeral)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable span tracing (obs.tracing) for the run and "
+                         "print the per-element span report at exit; combine "
+                         "with --metrics-port to browse /debug/traces live")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -71,6 +75,12 @@ def main(argv=None) -> int:
             print(f"ERROR: metrics exporter: {e}", file=sys.stderr)
             return 1
         print(f"metrics: {exporter.url}", file=sys.stderr)
+    if args.trace:
+        # like metrics: must be on BEFORE p.start() so the element
+        # chains get the span-opening wrap at instrumentation time
+        from .obs import tracing
+
+        tracing.enable()
     t0 = time.monotonic()
     try:
         p.start()
@@ -100,6 +110,10 @@ def main(argv=None) -> int:
         p.stop()
         if exporter is not None:
             exporter.close()
+        if args.trace:
+            from .obs import tracing
+
+            print(tracing.element_stats_report(), file=sys.stderr)
     if args.verbose:
         print(f"ran {time.monotonic() - t0:.2f}s", file=sys.stderr)
     return 0

@@ -1,18 +1,26 @@
-"""nnstreamer_tpu.obs — unified metrics & exposition subsystem.
+"""nnstreamer_tpu.obs — unified metrics, tracing & exposition subsystem.
 
 Always-on counters/gauges/histograms fed by the pipeline graph, the
 query offload layer, and the serving engines, with a stdlib HTTP
-``/metrics`` + ``/healthz`` endpoint. See docs/observability.md for
-the metric name catalog and usage.
+``/metrics`` + ``/healthz`` endpoint — plus span-based request tracing
+with cross-wire context propagation and tail-based retention, exposed
+at ``/debug/traces`` and ``/debug/pipeline``. See docs/observability.md
+for the metric name catalog, the span catalog, and usage.
+
+Metrics and tracing are independently switchable (``enable()`` /
+``tracing.enable()``); both are flag-check no-ops when off.
 """
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
                       enable, enabled, registry)
 from .exporter import MetricsExporter, start_exporter
 from .instrument import instrument_pipeline
+from . import tracing
+from .tracing import Span, SpanContext, SpanStore, start_span
 
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "MetricsRegistry", "MetricsExporter",
-    "disable", "enable", "enabled", "instrument_pipeline", "registry",
-    "start_exporter",
+    "Span", "SpanContext", "SpanStore", "disable", "enable", "enabled",
+    "instrument_pipeline", "registry", "start_exporter", "start_span",
+    "tracing",
 ]

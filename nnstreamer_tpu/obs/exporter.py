@@ -1,10 +1,20 @@
-"""HTTP exposition endpoint: ``/metrics`` + ``/healthz``, stdlib only.
+"""HTTP exposition endpoint: ``/metrics`` + ``/healthz`` + ``/debug``,
+stdlib only.
 
 A daemon-threaded ``http.server`` serving the process-global (or a
 given) ``MetricsRegistry`` in Prometheus text format — the scrape
-target a production deployment points its collector at. No new
-dependencies: ``ThreadingHTTPServer`` handles concurrent scrapes and
-the GIL is irrelevant at scrape rates.
+target a production deployment points its collector at — plus the
+trace-store debug surface:
+
+  * ``GET /debug/traces``            — JSON trace summaries, slowest
+    first; ``?min_ms=<float>`` keeps only completed traces at least
+    that slow
+  * ``GET /debug/traces/<trace_id>`` — the full span tree of one trace
+  * ``GET /debug/pipeline``          — live pipeline topology plus
+    per-element span stats (the DOT-dump analog)
+
+No new dependencies: ``ThreadingHTTPServer`` handles concurrent
+scrapes and the GIL is irrelevant at scrape rates.
 
     from nnstreamer_tpu.obs import start_exporter
     exp = start_exporter(port=9464)   # also enables collection
@@ -21,8 +31,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from . import metrics as _metrics
+from . import tracing as _tracing
 
 __all__ = ["MetricsExporter", "start_exporter"]
 
@@ -40,7 +52,7 @@ class MetricsExporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = reg.exposition().encode("utf-8")
                     self._reply(200, CONTENT_TYPE, body)
@@ -48,12 +60,46 @@ class MetricsExporter:
                     body = json.dumps({
                         "status": "ok",
                         "metrics_enabled": reg.is_enabled,
+                        "tracing_enabled": _tracing.enabled(),
                         "families": len(reg.names()),
                     }).encode("utf-8")
                     self._reply(200, "application/json", body)
+                elif path == "/debug/traces":
+                    try:
+                        min_ms = float(
+                            parse_qs(query).get("min_ms", ["0"])[0])
+                    except ValueError:
+                        self._reply(400, "text/plain",
+                                    b"min_ms must be a number")
+                        return
+                    self._json(200, {
+                        "tracing_enabled": _tracing.enabled(),
+                        "traces": _tracing.store().summaries(min_ms),
+                    })
+                elif path.startswith("/debug/traces/"):
+                    tid = path[len("/debug/traces/"):]
+                    tree = _tracing.store().tree(tid)
+                    if tree is None:
+                        self._json(404, {"error": f"unknown trace {tid!r}"})
+                    else:
+                        self._json(200, tree)
+                elif path == "/debug/pipeline":
+                    self._json(200, {
+                        "pipelines": [_tracing.pipeline_topology(p)
+                                      for p in _tracing.live_pipelines()],
+                        "element_spans": _tracing.element_stats(),
+                    })
                 else:
-                    self._reply(404, "text/plain",
-                                b"not found (try /metrics or /healthz)")
+                    self._reply(
+                        404, "text/plain",
+                        b"not found (try /metrics, /healthz, "
+                        b"/debug/traces, /debug/pipeline)")
+
+            def _json(self, code, obj):
+                # default=str: span attrs are caller-provided (numpy
+                # scalars, enums, ...) — render, never 500 a debug page
+                self._reply(code, "application/json",
+                            json.dumps(obj, default=str).encode("utf-8"))
 
             def _reply(self, code, ctype, body):
                 self.send_response(code)

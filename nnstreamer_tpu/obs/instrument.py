@@ -1,10 +1,13 @@
 """Element-chain instrumentation: wraps a pipeline's elements so every
-buffer feeds the metrics registry.
+buffer feeds the metrics registry — and, when tracing is on, the span
+store.
 
-One mechanism serves two consumers: ``Pipeline.start`` attaches it to
+One mechanism serves three consumers: ``Pipeline.start`` attaches it to
 the process-global registry when metrics are enabled (always-on
-telemetry for the exporter), and ``PipelineTracer`` attaches it to a
-private registry for a per-run report. Both see the same series:
+telemetry for the exporter), ``PipelineTracer`` attaches it to a
+private registry for a per-run report, and the tracing subsystem rides
+the same wrap to open a ``pipeline.element`` span per chain call. All
+metric consumers see the same series:
 
   * ``nnstpu_pipeline_buffers_total{element}`` — buffers entering chain
   * ``nnstpu_pipeline_proctime_seconds{element}`` — chain latency
@@ -15,9 +18,19 @@ private registry for a per-run report. Both see the same series:
   * ``nnstpu_pipeline_queue_depth{element}`` — queue occupancy, read at
     collection time (zero hot-path cost)
 
-The disabled fast path is structural: when metrics are off at start
-time nothing here runs, element ``_chain_entry`` stays the plain class
-method, and the hot path pays nothing (tests/test_obs.py pins this).
+Span flow (obs/tracing.py): sources stamp a ``pipeline.buffer`` root
+context onto ``Buffer.meta`` (unless the buffer already carries one —
+a serversrc frame adopted off the wire keeps its remote trace), each
+element chain opens a ``pipeline.element`` child and re-points the
+buffer context at itself (so a linear chain renders as a linear tree),
+and sink elements close the root. While a chain runs, its span is the
+thread's *current* context, so nested work (an engine ``submit``, a
+query send) joins the trace automatically.
+
+The disabled fast path is structural: when neither metrics nor tracing
+are on at start time nothing here runs, element ``_chain_entry`` stays
+the plain class method, and the hot path pays nothing
+(tests/test_obs.py pins this).
 """
 
 from __future__ import annotations
@@ -25,6 +38,7 @@ from __future__ import annotations
 import time
 from typing import Any, Optional
 
+from . import tracing as _tracing
 from .metrics import MetricsRegistry, registry as _global_registry
 
 __all__ = ["instrument_pipeline", "maybe_instrument_pipeline"]
@@ -61,9 +75,12 @@ def _wrapped_registries(el: Any) -> list:
 
 
 def instrument_pipeline(pipeline: Any,
-                        reg: Optional[MetricsRegistry] = None) -> None:
+                        reg: Optional[MetricsRegistry] = None,
+                        span_store: Optional["_tracing.SpanStore"] = None
+                        ) -> None:
     """Wrap every element of ``pipeline`` to record into ``reg`` (the
-    process-global registry by default). Idempotent per (element,
+    process-global registry by default) and, when ``span_store`` is
+    given, open per-element spans into it. Idempotent per (element,
     registry): safe across restarts and combined tracer + exporter use
     (each consumer's wrap records to its own registry)."""
     from ..core.buffer import Buffer
@@ -86,11 +103,14 @@ def instrument_pipeline(pipeline: Any,
         if el.is_source:
             orig_create = getattr(el, "create", None)
             if orig_create is not None:
-                def create_stamped(_orig=orig_create):
+                def create_stamped(_orig=orig_create, _el=el,
+                                   _spans=span_store):
                     buf = _orig()
                     if buf is not None:
                         buf.meta.setdefault("trace_t0_ns",
                                             time.monotonic_ns())
+                        if _spans is not None:
+                            _tracing.stamp_buffer(buf, _spans, _el.name)
                     return buf
 
                 el.create = create_stamped
@@ -102,18 +122,47 @@ def instrument_pipeline(pipeline: Any,
         orig = el._chain_entry
 
         def timed_chain(pad, buf, _orig=orig, _bufs=bufs, _proc=proc,
-                        _inter=inter, _errs=errs):
-            t0 = buf.meta.get("trace_t0_ns") \
-                if isinstance(buf, Buffer) else None
+                        _inter=inter, _errs=errs, _spans=span_store,
+                        _name=el.name, _sink=el.is_sink):
+            is_buf = isinstance(buf, Buffer)
+            t0 = buf.meta.get("trace_t0_ns") if is_buf else None
             start = time.monotonic_ns()
             if t0 is not None:
                 _inter.observe((start - t0) / 1e9)
             _bufs.inc()
+            span = None
+            token = None
+            if _spans is not None and is_buf:
+                parent = buf.meta.get(_tracing.CTX_META_KEY)
+                if parent is not None:
+                    span = _spans.start_span(
+                        "pipeline.element", parent=parent,
+                        attrs={"element": _name})
+                    if span.recording:
+                        # linear chains render as linear trees: the
+                        # next element parents onto THIS span
+                        buf.meta[_tracing.CTX_META_KEY] = span.context
+                        token = _tracing._set_current(span.context)
+                    else:
+                        span = None
             try:
                 ret = _orig(pad, buf)
             except Exception:
                 _errs.inc()
+                if span is not None:
+                    span.set_attribute("error", True)
                 raise
+            finally:
+                if token is not None:
+                    _tracing._reset_current(token)
+                if span is not None:
+                    span.end()
+                if _sink and is_buf:
+                    # the buffer reached a sink: close its root span
+                    # (idempotent — tee'd buffers hit several sinks)
+                    root = buf.meta.get(_tracing.ROOT_META_KEY)
+                    if root is not None:
+                        root.end()
             _proc.observe((time.monotonic_ns() - start) / 1e9)
             if ret is FlowReturn.ERROR:
                 _errs.inc()
@@ -124,6 +173,12 @@ def instrument_pipeline(pipeline: Any,
 
 def maybe_instrument_pipeline(pipeline: Any) -> None:
     """Pipeline.start hook: attach to the global registry iff metrics
-    are enabled — the structural no-op fast path when they are not."""
-    if _global_registry().is_enabled:
-        instrument_pipeline(pipeline)
+    OR tracing are enabled — the structural no-op fast path when
+    neither is. (Metrics recording into a disabled registry is itself a
+    flag-check no-op, so a tracing-only run costs no metric state.)
+    Also registers the pipeline for /debug/pipeline topology — a
+    WeakSet add, unconditionally cheap."""
+    _tracing.register_pipeline(pipeline)
+    spans = _tracing.store() if _tracing.enabled() else None
+    if _global_registry().is_enabled or spans is not None:
+        instrument_pipeline(pipeline, span_store=spans)

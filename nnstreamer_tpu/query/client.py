@@ -33,6 +33,7 @@ from ..core.log import logger
 from ..core.types import Caps, TensorFormat
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..obs import metrics as _obs
+from ..obs import tracing as _tracing
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -203,14 +204,22 @@ class TensorQueryClient(Element):
                     if not self._pending:
                         raise QueryProtocolError("unsolicited RESULT")
                     pts, duration, offset = self._pending[0][:3]
+                    span, root = self._pending[0][5], self._pending[0][6]
                 out = payload_to_buffer(rmeta, rpayload)
                 out.pts, out.duration, out.offset = pts, duration, offset
+                if span.recording:
+                    # downstream elements keep tracing inside this
+                    # request's trace (the result is its continuation)
+                    out.meta[_tracing.CTX_META_KEY] = span.context
+                    if root is not None:
+                        out.meta[_tracing.ROOT_META_KEY] = root
                 self.push(out)
                 with self._cv:
                     # pop only AFTER the push: an EOS drain waiting on the
                     # window must not race past a result still mid-push
                     done = self._pending.popleft()
                     self._cv.notify_all()
+                done[5].end()
                 self._m_rtt.observe(time.monotonic() - done[4])
         except (ConnectionError, OSError, QueryProtocolError) as e:
             with self._cv:
@@ -269,6 +278,13 @@ class TensorQueryClient(Element):
 
     def _chain_pipelined(self, buf: Buffer, depth: int) -> FlowReturn:
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        # per-request span: submit → result popped by the reader (ended
+        # there); NOOP when tracing is off, so every span touch below
+        # is a no-op method on a shared singleton
+        rspan = _tracing.start_span(
+            "query.request",
+            parent=buf.meta.get(_tracing.CTX_META_KEY),
+            attrs={"element": self.name, "pipelined": True})
         for attempt in range(max(int(self.max_request_retry), 1)):
             with self._cv:
                 if self._reader_error is not None:
@@ -314,12 +330,24 @@ class TensorQueryClient(Element):
                     self._cv.wait(0.1)
                 if self._reader_error is not None:
                     return FlowReturn.ERROR
-                # 5th field: submit stamp for the round-trip histogram
+                # 5th field: submit stamp for the round-trip histogram;
+                # 6th/7th: the request span the reader thread will close
+                # and the trace root it re-stamps onto the result buffer
                 entry = [buf.pts, buf.duration, buf.offset, False,
-                         time.monotonic()]
+                         time.monotonic(), rspan,
+                         buf.meta.get(_tracing.ROOT_META_KEY)]
                 self._pending.append(entry)
             try:
-                send_message(sock, Cmd.DATA, meta, payload)
+                if rspan.recording:
+                    # current-context window around the send so the wire
+                    # meta carries this request's context to the server
+                    tok = _tracing._set_current(rspan.context)
+                    try:
+                        send_message(sock, Cmd.DATA, meta, payload)
+                    finally:
+                        _tracing._reset_current(tok)
+                else:
+                    send_message(sock, Cmd.DATA, meta, payload)
                 with self._cv:
                     entry[3] = True  # on the wire: reader owns its fate
                     if self._reader_error is not None or self._reader_dead:
@@ -367,21 +395,37 @@ class TensorQueryClient(Element):
         if depth > 1:
             return self._chain_pipelined(buf, depth)
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
-        for attempt in range(max(int(self.max_request_retry), 1)):
-            try:
-                sock = self._ensure_conn()
-                t_send = time.monotonic()
-                send_message(sock, Cmd.DATA, meta, payload)
-                cmd, rmeta, rpayload = recv_message(sock)
-                if cmd is Cmd.ERROR:
-                    raise QueryProtocolError(rmeta.get("error", "server error"))
-                if cmd is not Cmd.RESULT:
-                    raise QueryProtocolError(f"unexpected reply {cmd}")
-                self._m_rtt.observe(time.monotonic() - t_send)
-                out = payload_to_buffer(rmeta, rpayload)
-                out.pts, out.duration, out.offset = buf.pts, buf.duration, buf.offset
-                return self.push(out)
-            except (ConnectionError, OSError, QueryProtocolError) as e:
-                log.warning("query attempt %d failed: %s", attempt + 1, e)
-                self.stop()  # drop connection, retry fresh
+        # one span per offload round trip: covers the wire send, the
+        # server-side remote-parented spans, and the result receive —
+        # NOOP (flag check only) when tracing is off
+        with _tracing.start_span(
+                "query.request",
+                parent=buf.meta.get(_tracing.CTX_META_KEY),
+                attrs={"element": self.name}) as rspan:
+            for attempt in range(max(int(self.max_request_retry), 1)):
+                try:
+                    sock = self._ensure_conn()
+                    t_send = time.monotonic()
+                    send_message(sock, Cmd.DATA, meta, payload)
+                    cmd, rmeta, rpayload = recv_message(sock)
+                    if cmd is Cmd.ERROR:
+                        raise QueryProtocolError(
+                            rmeta.get("error", "server error"))
+                    if cmd is not Cmd.RESULT:
+                        raise QueryProtocolError(f"unexpected reply {cmd}")
+                    self._m_rtt.observe(time.monotonic() - t_send)
+                    out = payload_to_buffer(rmeta, rpayload)
+                    out.pts, out.duration, out.offset = \
+                        buf.pts, buf.duration, buf.offset
+                    if rspan.recording:
+                        out.meta[_tracing.CTX_META_KEY] = rspan.context
+                        root = buf.meta.get(_tracing.ROOT_META_KEY)
+                        if root is not None:
+                            # the result buffer continues the request's
+                            # trace; the sink must still close its root
+                            out.meta[_tracing.ROOT_META_KEY] = root
+                    return self.push(out)
+                except (ConnectionError, OSError, QueryProtocolError) as e:
+                    log.warning("query attempt %d failed: %s", attempt + 1, e)
+                    self.stop()  # drop connection, retry fresh
         raise ConnectionError("tensor_query_client: request failed after retries")

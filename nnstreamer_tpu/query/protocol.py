@@ -35,6 +35,7 @@ from ..core.buffer import Buffer, TensorMemory
 from ..core.meta import META_SIZE, TensorMetaInfo, unwrap_flex, wrap_flex
 from ..core.types import TensorFormat
 from ..obs import metrics as _obs
+from ..obs import tracing as _tracing
 
 MAGIC = 0x4E515250
 _HEADER = struct.Struct("<IBIQ")
@@ -88,6 +89,15 @@ def pack_message(cmd: Cmd, meta: Dict[str, Any], payload: bytes = b"") -> bytes:
     return _HEADER.pack(MAGIC, int(cmd), len(meta_b), len(payload)) + meta_b + payload
 
 
+def _pack_frame_header(cmd: Cmd, meta: Dict[str, Any],
+                       payload_len: int) -> bytes:
+    """Header + meta only, declaring ``payload_len`` bytes to follow —
+    lets send_message stream a memoryview payload without concatenating
+    (and therefore copying) it into one bytes object first."""
+    meta_b = json.dumps(meta, separators=(",", ":")).encode("utf-8")
+    return _HEADER.pack(MAGIC, int(cmd), len(meta_b), payload_len) + meta_b
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     """Read exactly n bytes (list-accumulated; O(n) for large payloads)."""
     chunks = []
@@ -129,10 +139,21 @@ def recv_message(sock: socket.socket,
     try:
         total = int(meta.pop("chunked_total"))
         inner = Cmd(int(meta.pop("chunked_cmd")))
-    except (KeyError, ValueError) as e:
+    except (KeyError, ValueError, TypeError) as e:
+        # TypeError included: {"chunked_total": null} decodes to None
+        # and int(None) must fail the transfer, not the receive loop
         raise QueryProtocolError(f"bad CHUNK_START meta: {e}")
     if total > MAX_MESSAGE or total < 0:
         raise QueryProtocolError(f"chunked payload too large: {total}")
+    # chunked assembly is the one receive with real duration: time it
+    # as a span parented on the sender's context when one rode along
+    rspan = _tracing.NOOP_SPAN
+    if _tracing.enabled():
+        rctx = _tracing.ctx_from_wire(meta.get(_tracing.TRACE_META_KEY))
+        if rctx is not None:
+            rspan = _tracing.start_span(
+                "query.recv", parent=rctx,
+                attrs={"cmd": Cmd(inner).name, "bytes": total})
     assembled = bytearray(total)
     got = 0
     prev_timeout = sock.gettimeout()
@@ -164,10 +185,15 @@ def recv_message(sock: socket.socket,
                         f"chunked transfer incomplete: {got}/{total} bytes")
                 _MSG_TOTAL.labels("recv", inner.name).inc()
                 _BYTES_TOTAL.labels("recv").inc(total)
+                rspan.end()
                 return inner, meta, bytes(assembled)
             else:
                 raise QueryProtocolError(
                     f"unexpected {ccmd.name} inside chunked transfer")
+    except QueryProtocolError:
+        rspan.set_attribute("error", True)
+        rspan.end()
+        raise
     finally:
         sock.settimeout(prev_timeout)
 
@@ -176,16 +202,37 @@ def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
                  payload: bytes = b"") -> None:
     _MSG_TOTAL.labels("sent", cmd.name).inc()
     _BYTES_TOTAL.labels("sent").inc(len(payload))
-    if len(payload) <= CHUNK_SIZE:
-        sock.sendall(pack_message(cmd, meta, payload))
-        return
-    start = dict(meta, chunked_cmd=int(cmd), chunked_total=len(payload))
-    sock.sendall(pack_message(Cmd.CHUNK_START, start))
-    view = memoryview(payload)
-    for off in range(0, len(payload), CHUNK_SIZE):
-        sock.sendall(pack_message(Cmd.CHUNK_DATA, {"off": off},
-                                  bytes(view[off:off + CHUNK_SIZE])))
-    sock.sendall(pack_message(Cmd.CHUNK_END, {}))
+    span = _tracing.NOOP_SPAN
+    if _tracing.enabled():
+        # stamp the caller's context into the wire meta so the peer can
+        # adopt it as a remote parent; the send itself becomes a span.
+        # Disabled path: no flag set, no `trace` key, zero wire bytes
+        # added — the cross-wire format is strictly additive.
+        ctx = _tracing.current_context()
+        if ctx is not None and _tracing.TRACE_META_KEY not in meta:
+            meta = dict(meta)
+            meta[_tracing.TRACE_META_KEY] = ctx.to_wire()
+            span = _tracing.start_span(
+                "query.send", parent=ctx,
+                attrs={"cmd": cmd.name, "bytes": len(payload)})
+    try:
+        if len(payload) <= CHUNK_SIZE:
+            sock.sendall(pack_message(cmd, meta, payload))
+            return
+        start = dict(meta, chunked_cmd=int(cmd), chunked_total=len(payload))
+        sock.sendall(pack_message(Cmd.CHUNK_START, start))
+        view = memoryview(payload)
+        for off in range(0, len(payload), CHUNK_SIZE):
+            chunk = view[off:off + CHUNK_SIZE]
+            # header+meta first, then the memoryview slice straight to
+            # the socket: the payload bytes are never copied on the
+            # send side (sendall accepts buffer-protocol objects)
+            sock.sendall(_pack_frame_header(
+                Cmd.CHUNK_DATA, {"off": off}, len(chunk)))
+            sock.sendall(chunk)
+        sock.sendall(pack_message(Cmd.CHUNK_END, {}))
+    finally:
+        span.end()
 
 
 # --------------------------------------------------------------------------- #

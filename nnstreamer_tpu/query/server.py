@@ -27,6 +27,7 @@ from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..graph.pipeline import SourceElement
 from ..obs import metrics as _obs
+from ..obs import tracing as _tracing
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -152,6 +153,20 @@ class TensorQueryServerSrc(SourceElement):
                 elif cmd is Cmd.DATA:
                     buf = payload_to_buffer(meta, payload)
                     buf.meta["query_client_id"] = cid
+                    if _tracing.enabled():
+                        # adopt the client's context so one trace spans
+                        # both halves: the handling span parents every
+                        # server-side pipeline.element span and is closed
+                        # once the RESULT goes back out (send_result)
+                        rctx = _tracing.ctx_from_wire(
+                            meta.get(_tracing.TRACE_META_KEY))
+                        if rctx is not None:
+                            span = _tracing.start_span(
+                                "query.server_handle", parent=rctx,
+                                attrs={"client": cid, "element": self.name})
+                            if span.recording:
+                                buf.meta[_tracing.CTX_META_KEY] = span.context
+                                buf.meta[_tracing.ROOT_META_KEY] = span
                     self._inbox.put(buf)
                 else:
                     send_message(conn, Cmd.ERROR,
@@ -177,17 +192,30 @@ class TensorQueryServerSrc(SourceElement):
         return None
 
     def send_result(self, cid: int, buf: Buffer) -> bool:
+        span = buf.meta.get(_tracing.ROOT_META_KEY, _tracing.NOOP_SPAN)
         with self._lock:
             conn = self._conns.get(cid)
         if conn is None:
+            span.end()
             return False
         meta, payload = buffer_to_payload(buf)
+        token = None
+        if span.recording:
+            # make the handling span current so the RESULT frame carries
+            # the trace back to the client (send_message injects it);
+            # needed explicitly because the async serversink drains from
+            # its own thread, outside any instrumented chain
+            token = _tracing._set_current(span.context)
         try:
             send_message(conn, Cmd.RESULT, meta, payload)
             return True
         except OSError as e:
             log.warning("result send to client %d failed: %s", cid, e)
             return False
+        finally:
+            if token is not None:
+                _tracing._reset_current(token)
+            span.end()
 
     def stop(self) -> None:
         super().stop()

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..models import causal_lm
 from ..obs import metrics as _obs
+from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
 from . import sampling
 
@@ -172,6 +173,11 @@ class _Request:
     out: List[int] = field(default_factory=list)
     done: bool = False
     t_submit: float = 0.0       # monotonic stamp for the TTFT histogram
+    # tracing (None when tracing is off at submit time): the request
+    # span parents admission-wait / prefill / compile / decode children
+    span: Any = None            # serving.request — submit → retire
+    wait_span: Any = None       # serving.admission_wait — submit → admit
+    decode_span: Any = None     # serving.decode — admit → retire
 
 
 class LMEngine:
@@ -328,10 +334,22 @@ class LMEngine:
                 f"capacity max_len={self.max_len}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(
+        req = _Request(
             rid, p, max_new, eos, temperature=float(temperature),
             top_k=int(top_k), top_p=float(top_p), seed=int(seed),
-            t_submit=time.monotonic()))
+            t_submit=time.monotonic())
+        if _tracing.enabled():
+            # parent on the caller's current context (an instrumented
+            # element chain sets it) so an offloaded request joins the
+            # pipeline's trace; without one this roots a fresh trace
+            req.span = _tracing.start_span(
+                "serving.request", parent=_tracing.current_context(),
+                attrs={"engine": self._engine_label, "rid": rid,
+                       "prompt_len": int(p.size), "max_new": int(max_new)})
+            req.wait_span = _tracing.start_span(
+                "serving.admission_wait", parent=req.span.context,
+                attrs={"queued_behind": len(self._queue)})
+        self._queue.append(req)
         return rid
 
     def pending(self) -> int:
@@ -367,6 +385,8 @@ class LMEngine:
             if self._slot_req[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            if req.wait_span is not None:
+                req.wait_span.end()
             t = int(req.prompt.size)
             tb = self._bucket(t)
             padded = np.zeros((1, tb), np.int32)
@@ -374,11 +394,25 @@ class LMEngine:
             skey = sampling.seed_key(req.seed)
             temp = jnp.float32(req.temperature)
             tk, tp = jnp.int32(req.top_k), jnp.float32(req.top_p)
+            first_use = tb not in self._seen_buckets
+            pspan = cspan = _tracing.NOOP_SPAN
+            if req.span is not None:
+                if first_use:
+                    # the jit call returns only after trace+compile on a
+                    # new static shape; the dispatch itself is async, so
+                    # ending right after _prefill_into bounds the compile
+                    cspan = _tracing.start_span(
+                        "serving.compile", parent=req.span.context,
+                        attrs={"bucket": tb, "kernel": "prefill"})
+                pspan = _tracing.start_span(
+                    "serving.prefill", parent=req.span.context,
+                    attrs={"bucket": tb, "slot": slot})
             first = self._prefill_into(slot, padded, t, skey, temp, tk, tp)
+            cspan.end()
             self.stats["prefills"] += 1
             lbl = self._engine_label
             self._m_prefills.labels(lbl, str(tb)).inc()
-            if tb not in self._seen_buckets:
+            if first_use:
                 self._seen_buckets.add(tb)
                 self._m_compiles.labels(lbl, str(tb)).inc()
             self._m_streams.labels(lbl, "admitted").inc()
@@ -394,6 +428,11 @@ class LMEngine:
             # is async, so the first token only exists for the caller
             # once that D2H read completes
             self._m_ttft.observe(time.monotonic() - req.t_submit)
+            pspan.end()  # prefill span covers through first-token D2H
+            if req.span is not None:
+                req.decode_span = _tracing.start_span(
+                    "serving.decode", parent=req.span.context,
+                    attrs={"slot": slot})
             self._pos_host[slot] = t
             self._slot_req[slot] = req
             self._retire_if_done(slot, req)
@@ -566,6 +605,14 @@ class LMEngine:
             and req.out[-1] == req.eos
         if hit_eos or len(req.out) >= req.max_new:
             req.done = True
+            if req.decode_span is not None:
+                # tokens-per-decode-span: with the span duration this
+                # yields the request's realized per-token decode latency
+                req.decode_span.set_attribute("tokens", len(req.out) - 1)
+                req.decode_span.end()
+            if req.span is not None:
+                req.span.set_attribute("tokens", len(req.out))
+                req.span.end()
             self.stats["tokens_out"] += len(req.out)
             self._m_streams.labels(self._engine_label, "completed").inc()
             self._m_tokens.inc(len(req.out))

@@ -30,6 +30,13 @@ sharded with identical outputs.
 
 The reference has no distributed serving of any kind (SURVEY §2.3/§2.5:
 stateless per-buffer invokes + TCP offload of whole buffers).
+
+Observability rides the inherited scheduler unchanged: the
+serving.request / admission_wait / prefill / compile / decode spans
+(obs/tracing.py) are opened by LMEngine's submit/_admit/_retire_if_done
+hooks, which this class does not override — a mesh-sharded engine
+reports the same trace shape as the single-device one, with
+``engine="tp"`` in the span attrs via `_engine_label`.
 """
 
 from __future__ import annotations

@@ -13,14 +13,24 @@ consumers; no parallel bookkeeping.
     pipeline.run()
     print(tracer.report())
 
+``attach(pipeline, spans=True)`` additionally records per-element
+spans into a private ``SpanStore`` (obs/tracing.py) — the same store
+machinery behind ``/debug/traces`` — and ``span_report()`` renders the
+per-element span table. Private means private: neither the global
+metrics registry nor the global trace store sees a tracer's data.
+
 ``device_trace`` brackets a run with jax.profiler for XLA/TPU
-timelines (xprof).
+timelines (xprof). When global tracing is enabled it also opens a
+``device.xprof`` span carrying the logdir, so an XLA timeline can be
+joined to the wire-level trace that was active when profiling started
+(``trace_id`` attribute on the context manager after ``__enter__``).
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from ..obs import tracing as _tracing
 from ..obs.instrument import instrument_pipeline
 from ..obs.metrics import MetricsRegistry
 
@@ -28,15 +38,19 @@ from ..obs.metrics import MetricsRegistry
 class PipelineTracer:
     """Per-run proctime/interlatency report over a private registry."""
 
-    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 span_store: Optional[_tracing.SpanStore] = None) -> None:
         #: private + always-enabled: a tracer must record even when the
         #: process-global telemetry is off, and must not pollute it
         self.registry = registry or MetricsRegistry(enabled=True)
+        #: optional private span store (attach(spans=True))
+        self.span_store = span_store
 
     @classmethod
-    def attach(cls, pipeline: Any) -> "PipelineTracer":
-        tracer = cls()
-        instrument_pipeline(pipeline, tracer.registry)
+    def attach(cls, pipeline: Any, spans: bool = False) -> "PipelineTracer":
+        store = _tracing.SpanStore(enabled=True) if spans else None
+        tracer = cls(span_store=store)
+        instrument_pipeline(pipeline, tracer.registry, span_store=store)
         return tracer
 
     def _stats(self) -> Dict[str, Dict[str, float]]:
@@ -67,10 +81,24 @@ class PipelineTracer:
     def report(self) -> str:
         lines = [f"{'element':<24}{'bufs':>7}{'proctime(us)':>14}"
                  f"{'max(us)':>10}{'interlat(us)':>14}"]
-        for name, t in self._stats().items():
+        # sorted slowest-mean first: the _stats() source iterates a set
+        # union, and a report whose row order changes run to run cannot
+        # be diffed (tests/test_tracing.py pins the ordering)
+        rows = sorted(self._stats().items(),
+                      key=lambda kv: kv[1]["proctime_us"], reverse=True)
+        for name, t in rows:
             lines.append(f"{name:<24}{t['n']:>7}{t['proctime_us']:>14.1f}"
                          f"{t['max_us']:>10.1f}{t['interlatency_us']:>14.1f}")
         return "\n".join(lines)
+
+    def span_report(self) -> str:
+        """Per-element span table from the private store; requires
+        ``attach(pipeline, spans=True)``."""
+        if self.span_store is None:
+            raise RuntimeError(
+                "span_report needs PipelineTracer.attach(pipeline, "
+                "spans=True)")
+        return _tracing.element_stats_report(self.span_store)
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
         return self._stats()
@@ -78,14 +106,25 @@ class PipelineTracer:
 
 class device_trace:
     """Context manager: jax.profiler trace around a pipeline run (view with
-    xprof/tensorboard). SURVEY §5 'TPU build: jax.profiler/xprof'."""
+    xprof/tensorboard). SURVEY §5 'TPU build: jax.profiler/xprof'.
+
+    With global tracing enabled, the bracket is also a ``device.xprof``
+    span (parented on the caller's current span when inside one), so
+    ``trace_id`` joins the xprof logdir to a wire-level trace."""
 
     def __init__(self, logdir: str):
         self.logdir = logdir
+        self.trace_id: Optional[str] = None
+        self._span = _tracing.NOOP_SPAN
 
     def __enter__(self):
         import jax
 
+        self._span = _tracing.start_span(
+            "device.xprof", parent=_tracing.current_context(),
+            attrs={"logdir": self.logdir})
+        if self._span.recording:
+            self.trace_id = self._span.context.trace_id
         jax.profiler.start_trace(self.logdir)
         return self
 
@@ -93,3 +132,4 @@ class device_trace:
         import jax
 
         jax.profiler.stop_trace()
+        self._span.end()

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Lint registered metric names against the repo naming convention.
+"""Lint registered metric names AND span names against the repo
+naming conventions.
 
-Convention (docs/observability.md): every metric is
+Metric convention (docs/observability.md): every metric is
 ``nnstpu_<layer>_<name>_<unit>`` with
 
   * layer  in {pipeline, query, serving},
@@ -9,12 +10,16 @@ Convention (docs/observability.md): every metric is
   * histograms  ending in ``_seconds``,
   * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes``.
 
+Span convention (docs/observability.md "Tracing"): every span name is
+a literal lowercase dotted ``<layer>.<operation>`` with layer in
+{pipeline, query, serving, device} — e.g. ``serving.prefill``.
+
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
-calls, so drift fails CI (wired as a tier-1 test:
-tests/test_metric_names.py) the moment an off-convention name lands.
-Registrations built from non-literal names are invisible to this lint
-— keep names literal.
+calls and ``.start_span(...)`` / ``start_span(...)`` tracing calls, so
+drift fails CI (wired as a tier-1 test: tests/test_metric_names.py)
+the moment an off-convention name lands. Registrations built from
+non-literal names are invisible to this lint — keep names literal.
 
 Exit 0 when clean; exit 1 listing every violation.
 """
@@ -34,6 +39,8 @@ UNIT_BY_TYPE = {
     "histogram": ("seconds",),
     "gauge": ("depth", "slots", "bytes"),
 }
+#: span layers add "device" — device.xprof has no metric series
+SPAN_LAYERS = ("pipeline", "query", "serving", "device")
 
 #: reg.counter("name"... — dotted call so plain functions named e.g.
 #: ``gauge()`` elsewhere don't false-positive
@@ -42,6 +49,13 @@ _CALL_RE = re.compile(
 
 _NAME_RE = re.compile(
     r"^nnstpu_(?P<layer>[a-z0-9]+)_(?P<body>[a-z0-9_]+)_(?P<unit>[a-z0-9]+)$")
+
+#: start_span("name"... — both module-level and store-method calls;
+#: \b keeps e.g. ``restart_spanner(`` from matching
+_SPAN_CALL_RE = re.compile(r"\bstart_span\(\s*[\"']([^\"']+)[\"']")
+
+_SPAN_NAME_RE = re.compile(
+    r"^(?P<layer>[a-z]+)\.(?P<op>[a-z][a-z0-9_]*)$")
 
 
 def iter_registrations(root: Path = SOURCE_ROOT):
@@ -56,15 +70,28 @@ def iter_registrations(root: Path = SOURCE_ROOT):
             yield path, lineno, m.group(1), m.group(2)
 
 
+def iter_span_sites(root: Path = SOURCE_ROOT):
+    """Yield (path, lineno, span_name) for every literal-name
+    ``start_span`` call under ``root``."""
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _SPAN_CALL_RE.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            yield path, lineno, m.group(1)
+
+
+def _where(path: Path, lineno: int) -> str:
+    rel = path.relative_to(REPO_ROOT) if REPO_ROOT in path.parents else path
+    return f"{rel}:{lineno}"
+
+
 def check(root: Path = SOURCE_ROOT):
     """Return a list of violation strings (empty = clean)."""
     problems = []
     found = 0
     for path, lineno, mtype, name in iter_registrations(root):
         found += 1
-        rel = path.relative_to(REPO_ROOT) if REPO_ROOT in path.parents \
-            else path
-        where = f"{rel}:{lineno}"
+        where = _where(path, lineno)
         m = _NAME_RE.match(name)
         if m is None:
             problems.append(
@@ -84,6 +111,34 @@ def check(root: Path = SOURCE_ROOT):
         problems.append(
             f"no metric registrations found under {root} — "
             "lint regex out of sync with the registry API?")
+    problems += check_spans(root)
+    return problems
+
+
+def check_spans(root: Path = SOURCE_ROOT):
+    """Span-name violations under ``root``. Zero span sites is only a
+    problem for the real source tree (the metric check already guards
+    arbitrary roots; the tracing API might legitimately be absent from
+    a tree under test)."""
+    problems = []
+    found = 0
+    for path, lineno, name in iter_span_sites(root):
+        found += 1
+        where = _where(path, lineno)
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            problems.append(
+                f"{where}: span {name!r} does not match lowercase "
+                "<layer>.<operation>")
+            continue
+        if m.group("layer") not in SPAN_LAYERS:
+            problems.append(
+                f"{where}: span {name!r} layer {m.group('layer')!r} "
+                f"not in {SPAN_LAYERS}")
+    if found == 0 and root == SOURCE_ROOT:
+        problems.append(
+            f"no start_span call sites found under {root} — "
+            "lint regex out of sync with the tracing API?")
     return problems
 
 
@@ -92,11 +147,12 @@ def main() -> int:
     if problems:
         for p in problems:
             print(p, file=sys.stderr)
-        print(f"{len(problems)} metric naming violation(s)",
-              file=sys.stderr)
+        print(f"{len(problems)} naming violation(s)", file=sys.stderr)
         return 1
     n = sum(1 for _ in iter_registrations())
-    print(f"metric names OK ({n} registrations checked)")
+    ns = sum(1 for _ in iter_span_sites())
+    print(f"metric names OK ({n} registrations checked); "
+          f"span names OK ({ns} call sites checked)")
     return 0
 
 

@@ -1,5 +1,6 @@
 """Tier-1 wiring for scripts/check_metric_names.py: every registered
-metric name must follow nnstpu_<layer>_<name>_<unit>."""
+metric name must follow nnstpu_<layer>_<name>_<unit>, and every literal
+span name must follow lowercase <layer>.<operation>."""
 
 import subprocess
 import sys
@@ -15,6 +16,7 @@ def test_lint_passes_on_tree():
         cwd=REPO_ROOT, timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "metric names OK" in proc.stdout
+    assert "span names OK" in proc.stdout
 
 
 def test_lint_catches_violations(tmp_path):
@@ -38,3 +40,24 @@ def test_lint_catches_violations(tmp_path):
     empty = tmp_path / "none"
     empty.mkdir()
     assert any("no metric registrations" in p for p in lint.check(empty))
+
+
+def test_lint_catches_span_violations(tmp_path):
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad_spans.py"
+    bad.write_text(
+        'store.start_span("serving.prefill")\n'       # fine
+        'store.start_span("webui.render")\n'          # bad layer
+        'store.start_span("PipelineElement")\n'       # not dotted
+        'store.start_span("query.Recv")\n')           # uppercase op
+    problems = lint.check_spans(tmp_path)
+    assert len(problems) == 3
+    assert any("layer 'webui'" in p for p in problems)
+    assert any("'PipelineElement'" in p for p in problems)
+    # the real tree must contain literal span call sites — a regex that
+    # stops matching the tracing API shows up as this problem
+    assert lint.check_spans() == []

@@ -415,6 +415,21 @@ class TestChunkedTransfer:
             recv_message(b, chunk_timeout=2.0)
         a.close(); b.close()
 
+    def test_null_chunk_meta_rejected(self):
+        """{"chunked_total": null} decodes to None; int(None) raises
+        TypeError, which must surface as QueryProtocolError — a bad peer
+        never crashes the receive loop with a raw TypeError."""
+        from nnstreamer_tpu.query.protocol import (
+            QueryProtocolError, pack_message, recv_message)
+
+        a, b = self._pipe()
+        a.sendall(pack_message(Cmd.CHUNK_START,
+                               {"chunked_cmd": int(Cmd.DATA),
+                                "chunked_total": None}))
+        with pytest.raises(QueryProtocolError, match="bad CHUNK_START"):
+            recv_message(b, chunk_timeout=2.0)
+        a.close(); b.close()
+
     def test_incomplete_chunked_transfer_rejected(self):
         from nnstreamer_tpu.query.protocol import (
             QueryProtocolError, pack_message, recv_message)
