@@ -7,7 +7,10 @@
 
 Options: -t/--time limit, -v verbose bus messages, --list-elements,
 --inspect ELEMENT (gst-inspect-1.0 analog: pads + properties with their
-defaults, plus registered subplugin modes for filter/decoder/converter).
+defaults, plus registered subplugin modes for filter/decoder/converter),
+--metrics-port/--trace/--watchdog/--events-dump (observability: metrics
+exporter, span tracing, health watchdog, flight-recorder dump — see
+docs/observability.md).
 """
 
 from __future__ import annotations
@@ -32,6 +35,17 @@ def main(argv=None) -> int:
                     help="enable span tracing (obs.tracing) for the run and "
                          "print the per-element span report at exit; combine "
                          "with --metrics-port to browse /debug/traces live")
+    ap.add_argument("--watchdog", type=float, nargs="?", const=5.0,
+                    default=None, metavar="SECS",
+                    help="enable the health model + stall watchdog "
+                         "(obs.health) with this stall threshold in seconds "
+                         "(default 5.0 when given bare); drives real "
+                         "/healthz + /readyz verdicts on --metrics-port and "
+                         "implies the flight recorder")
+    ap.add_argument("--events-dump", metavar="PATH", default=None,
+                    help="enable the flight recorder (obs.events) and dump "
+                         "the event journal to PATH as JSON lines at exit "
+                         "('-' dumps human-readable to stderr)")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -81,6 +95,16 @@ def main(argv=None) -> int:
         from .obs import tracing
 
         tracing.enable()
+    if args.watchdog is not None or args.events_dump is not None:
+        # same start-time rule: health components and the event bridge
+        # only attach to what is built/started AFTER enable()
+        from .obs import events
+
+        events.enable()
+        if args.watchdog is not None:
+            from .obs import health
+
+            health.enable(stall_after_s=float(args.watchdog))
     t0 = time.monotonic()
     try:
         p.start()
@@ -114,6 +138,14 @@ def main(argv=None) -> int:
             from .obs import tracing
 
             print(tracing.element_stats_report(), file=sys.stderr)
+        if args.events_dump is not None:
+            from .obs import events
+
+            if args.events_dump == "-":
+                events.dump(sys.stderr)
+            else:
+                events.dump_jsonl(args.events_dump)
+                print(f"events: {args.events_dump}", file=sys.stderr)
     if args.verbose:
         print(f"ran {time.monotonic() - t0:.2f}s", file=sys.stderr)
     return 0

@@ -30,11 +30,25 @@ def _configure() -> None:
     root.setLevel(logging.WARNING)
     spec = os.environ.get("NNS_TPU_DEBUG", "")
     for part in filter(None, (p.strip() for p in spec.split(","))):
+        # an invalid level must never abort the FIRST import that
+        # triggers configuration (setLevel raises ValueError on unknown
+        # names): warn and keep the default instead
         if ":" in part:
             cat, lvl = part.split(":", 1)
-            logging.getLogger(f"{_ROOT}.{cat}").setLevel(lvl.upper())
+            try:
+                logging.getLogger(f"{_ROOT}.{cat}").setLevel(lvl.upper())
+            except (ValueError, TypeError):
+                root.warning(
+                    "NNS_TPU_DEBUG: invalid level %r for category %r "
+                    "(ignored; keeping default)", lvl, cat)
         else:
-            root.setLevel(part.upper())
+            try:
+                root.setLevel(part.upper())
+            except (ValueError, TypeError):
+                root.setLevel(logging.WARNING)
+                root.warning(
+                    "NNS_TPU_DEBUG: invalid level %r "
+                    "(ignored; falling back to WARNING)", part)
 
 
 def logger(category: str) -> logging.Logger:

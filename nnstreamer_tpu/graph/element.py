@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from ..core.buffer import Buffer
 from ..core.types import Caps
 from ..core.log import logger
+from ..obs import events as _events
 from .events import Bus, Event, EventType, Message, MessageType
 
 log = logger("element")
@@ -300,6 +301,11 @@ class Element:
 
     def post_error(self, text: str, exc: Optional[BaseException] = None) -> None:
         log.error("[%s] %s", self.name, text, exc_info=exc)
+        # flight recorder (obs/events.py, one flag check while off):
+        # recorded from an instrumented chain this carries the failing
+        # buffer's trace id via the current-context stamp
+        _events.record("pipeline.error", f"{self.name}: {text}",
+                       severity="error", element=self.name)
         if self.bus is not None:
             self.bus.post(Message(MessageType.ERROR, self.name,
                                   {"text": text, "exception": exc}))

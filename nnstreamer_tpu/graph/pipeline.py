@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Unio
 from ..core.buffer import Buffer, now_ns
 from ..core.types import Caps
 from ..core.log import logger
+from ..obs import events as _events
 from .element import Element, FlowReturn, Pad, register_element, make_element
 from .events import Bus, Event, EventType, Message, MessageType
 
@@ -175,6 +176,12 @@ class Queue(Element):
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         self._enqueue(buf)
         return FlowReturn.OK
+
+    def health_probe(self) -> Dict[str, int]:
+        """Occupancy/bound for the health watchdog's queue-dwell rule
+        (obs/health.py) — a monitoring sample, unlocked like the
+        qdepth gauge."""
+        return {"depth": len(self._dq), "bound": int(self.max_size_buffers)}
 
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
@@ -347,6 +354,10 @@ class Pipeline:
                     el.started = False
             raise
         self.running = True
+        # flight recorder (one flag check while off): state transitions
+        # bracket the journal a post-mortem dump reads
+        _events.record("pipeline.state", f"{self.name} PLAYING",
+                       pipeline=self.name)
 
     def _validate_links(self, el: Element) -> None:
         for p in el.sink_pads + el.src_pads:
@@ -367,6 +378,8 @@ class Pipeline:
                 el.stop()
                 el.started = False
         self.running = False
+        _events.record("pipeline.state", f"{self.name} stopped",
+                       pipeline=self.name)
 
     def _sink_eos(self, el: Element) -> None:
         with self._lock:

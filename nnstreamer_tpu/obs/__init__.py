@@ -1,26 +1,35 @@
-"""nnstreamer_tpu.obs — unified metrics, tracing & exposition subsystem.
+"""nnstreamer_tpu.obs — unified metrics, tracing, health & exposition.
 
 Always-on counters/gauges/histograms fed by the pipeline graph, the
 query offload layer, and the serving engines, with a stdlib HTTP
-``/metrics`` + ``/healthz`` endpoint — plus span-based request tracing
-with cross-wire context propagation and tail-based retention, exposed
-at ``/debug/traces`` and ``/debug/pipeline``. See docs/observability.md
-for the metric name catalog, the span catalog, and usage.
+``/metrics`` + ``/healthz`` + ``/readyz`` endpoint — plus span-based
+request tracing with cross-wire context propagation and tail-based
+retention (``/debug/traces``, ``/debug/pipeline``), a component health
+model with a stall watchdog driving the real ``/healthz``/``/readyz``
+verdicts, and a flight-recorder event ring (``/debug/events``). See
+docs/observability.md for the metric/span/event name catalogs and
+usage.
 
-Metrics and tracing are independently switchable (``enable()`` /
-``tracing.enable()``); both are flag-check no-ops when off.
+Metrics, tracing, health, and events are independently switchable
+(``enable()`` / ``tracing.enable()`` / ``health.enable()`` /
+``events.enable()``); each is a flag-check no-op when off.
 """
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
                       enable, enabled, registry)
 from .exporter import MetricsExporter, start_exporter
 from .instrument import instrument_pipeline
+from . import events
+from . import health
 from . import tracing
+from .events import EventRing
+from .health import Component, HealthRegistry, Status
 from .tracing import Span, SpanContext, SpanStore, start_span
 
 __all__ = [
-    "DEFAULT_LATENCY_BUCKETS", "MetricsRegistry", "MetricsExporter",
-    "Span", "SpanContext", "SpanStore", "disable", "enable", "enabled",
-    "instrument_pipeline", "registry", "start_exporter", "start_span",
-    "tracing",
+    "Component", "DEFAULT_LATENCY_BUCKETS", "EventRing",
+    "HealthRegistry", "MetricsRegistry", "MetricsExporter", "Span",
+    "SpanContext", "SpanStore", "Status", "disable", "enable",
+    "enabled", "events", "health", "instrument_pipeline", "registry",
+    "start_exporter", "start_span", "tracing",
 ]

@@ -1,17 +1,28 @@
-"""HTTP exposition endpoint: ``/metrics`` + ``/healthz`` + ``/debug``,
-stdlib only.
+"""HTTP exposition endpoint: ``/metrics`` + ``/healthz`` + ``/readyz``
++ ``/debug``, stdlib only.
 
 A daemon-threaded ``http.server`` serving the process-global (or a
 given) ``MetricsRegistry`` in Prometheus text format — the scrape
 target a production deployment points its collector at — plus the
-trace-store debug surface:
+health and debug surfaces:
 
+  * ``GET /healthz``                 — liveness: aggregate component
+    status from obs/health.py; 200 while ok/degraded, 503 on
+    stalled/failing (always 200 "ok" while health is off)
+  * ``GET /readyz``                  — readiness: 200 once every
+    registered condition (pipeline PLAYING, engine warmed, query
+    connected) holds; 503 otherwise (200 while health is off)
   * ``GET /debug/traces``            — JSON trace summaries, slowest
     first; ``?min_ms=<float>`` keeps only completed traces at least
     that slow
   * ``GET /debug/traces/<trace_id>`` — the full span tree of one trace
   * ``GET /debug/pipeline``          — live pipeline topology plus
     per-element span stats (the DOT-dump analog)
+  * ``GET /debug/events``            — the flight-recorder event ring
+    (obs/events.py), oldest first; ``?n=<int>`` keeps the newest N
+
+Routes live in a dispatch table; the 404 hint is derived from it, so
+a new endpoint can never be forgotten from the hint.
 
 No new dependencies: ``ThreadingHTTPServer`` handles concurrent
 scrapes and the GIL is irrelevant at scrape rates.
@@ -33,6 +44,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs
 
+from . import events as _events
+from . import health as _health
 from . import metrics as _metrics
 from . import tracing as _tracing
 
@@ -43,8 +56,9 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class MetricsExporter:
-    """Serves ``registry.exposition()`` at ``/metrics`` and a liveness
-    JSON at ``/healthz`` from a daemon thread."""
+    """Serves ``registry.exposition()`` at ``/metrics``, the health
+    model at ``/healthz`` + ``/readyz``, and the debug surfaces, from
+    a daemon thread."""
 
     def __init__(self, port: int = 9464, host: str = "127.0.0.1",
                  registry: Optional[_metrics.MetricsRegistry] = None):
@@ -53,47 +67,98 @@ class MetricsExporter:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 path, _, query = self.path.partition("?")
-                if path == "/metrics":
-                    body = reg.exposition().encode("utf-8")
-                    self._reply(200, CONTENT_TYPE, body)
-                elif path == "/healthz":
-                    body = json.dumps({
-                        "status": "ok",
-                        "metrics_enabled": reg.is_enabled,
-                        "tracing_enabled": _tracing.enabled(),
-                        "families": len(reg.names()),
-                    }).encode("utf-8")
-                    self._reply(200, "application/json", body)
-                elif path == "/debug/traces":
-                    try:
-                        min_ms = float(
-                            parse_qs(query).get("min_ms", ["0"])[0])
-                    except ValueError:
-                        self._reply(400, "text/plain",
-                                    b"min_ms must be a number")
+                handler = self._ROUTES.get(path)
+                if handler is not None:
+                    handler(self, query)
+                    return
+                for prefix, ph in self._PREFIX_ROUTES:
+                    if path.startswith(prefix):
+                        ph(self, path[len(prefix):], query)
                         return
-                    self._json(200, {
-                        "tracing_enabled": _tracing.enabled(),
-                        "traces": _tracing.store().summaries(min_ms),
-                    })
-                elif path.startswith("/debug/traces/"):
-                    tid = path[len("/debug/traces/"):]
-                    tree = _tracing.store().tree(tid)
-                    if tree is None:
-                        self._json(404, {"error": f"unknown trace {tid!r}"})
-                    else:
-                        self._json(200, tree)
-                elif path == "/debug/pipeline":
-                    self._json(200, {
-                        "pipelines": [_tracing.pipeline_topology(p)
-                                      for p in _tracing.live_pipelines()],
-                        "element_spans": _tracing.element_stats(),
-                    })
+                self._reply(404, "text/plain", self._HINT)
+
+            # -- routes ------------------------------------------------ #
+            def _get_metrics(self, query):
+                self._reply(200, CONTENT_TYPE,
+                            reg.exposition().encode("utf-8"))
+
+            def _get_healthz(self, query):
+                snap = _health.snapshot()
+                # liveness: degraded still serves traffic; a stalled or
+                # failing component flips the scrape to 503
+                self._json(200 if snap["ok"] else 503, {
+                    "status": snap["status"],
+                    "health_enabled": _health.enabled(),
+                    "metrics_enabled": reg.is_enabled,
+                    "tracing_enabled": _tracing.enabled(),
+                    "events_enabled": _events.enabled(),
+                    "families": len(reg.names()),
+                    "components": snap["components"],
+                })
+
+            def _get_readyz(self, query):
+                ready, conds = _health.readiness()
+                self._json(200 if ready else 503, {
+                    "ready": ready,
+                    "health_enabled": _health.enabled(),
+                    "conditions": conds,
+                })
+
+            def _get_traces(self, query):
+                try:
+                    min_ms = float(
+                        parse_qs(query).get("min_ms", ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain",
+                                b"min_ms must be a number")
+                    return
+                self._json(200, {
+                    "tracing_enabled": _tracing.enabled(),
+                    "traces": _tracing.store().summaries(min_ms),
+                })
+
+            def _get_trace(self, tid, query):
+                tree = _tracing.store().tree(tid)
+                if tree is None:
+                    self._json(404, {"error": f"unknown trace {tid!r}"})
                 else:
-                    self._reply(
-                        404, "text/plain",
-                        b"not found (try /metrics, /healthz, "
-                        b"/debug/traces, /debug/pipeline)")
+                    self._json(200, tree)
+
+            def _get_pipeline(self, query):
+                self._json(200, {
+                    "pipelines": [_tracing.pipeline_topology(p)
+                                  for p in _tracing.live_pipelines()],
+                    "element_spans": _tracing.element_stats(),
+                })
+
+            def _get_events(self, query):
+                try:
+                    n = int(parse_qs(query).get("n", ["-1"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain", b"n must be an int")
+                    return
+                ring = _events.ring()
+                self._json(200, {
+                    "events_enabled": _events.enabled(),
+                    "dropped": ring.dropped,
+                    "events": ring.snapshot(n if n >= 0 else None),
+                })
+
+            #: THE route table — the 404 hint below derives from it, so
+            #: adding an endpoint here is the whole registration
+            _ROUTES = {
+                "/metrics": _get_metrics,
+                "/healthz": _get_healthz,
+                "/readyz": _get_readyz,
+                "/debug/traces": _get_traces,
+                "/debug/pipeline": _get_pipeline,
+                "/debug/events": _get_events,
+            }
+            _PREFIX_ROUTES = (("/debug/traces/", _get_trace),)
+            _HINT = ("not found (try " + ", ".join(
+                sorted(list(_ROUTES)
+                       + [p + "<id>" for p, _ in _PREFIX_ROUTES]))
+                + ")").encode("utf-8")
 
             def _json(self, code, obj):
                 # default=str: span attrs are caller-provided (numpy

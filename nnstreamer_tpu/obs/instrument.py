@@ -27,10 +27,16 @@ and sink elements close the root. While a chain runs, its span is the
 thread's *current* context, so nested work (an engine ``submit``, a
 query send) joins the trace automatically.
 
-The disabled fast path is structural: when neither metrics nor tracing
-are on at start time nothing here runs, element ``_chain_entry`` stays
-the plain class method, and the hot path pays nothing
-(tests/test_obs.py pins this).
+When the health model (obs/health.py) is on, the same wrap stamps a
+per-element heartbeat (``Component.beat()``) plus the buffer's trace
+id per chain call, feeding the stall watchdog — and each element gets
+a health component whose probe reports pipeline run/EOS state and any
+element-specific ``health_probe()`` data (queue depth/bound).
+
+The disabled fast path is structural: when neither metrics, tracing,
+nor health are on at start time nothing here runs, element
+``_chain_entry`` stays the plain class method, and the hot path pays
+nothing (tests/test_obs.py pins this).
 """
 
 from __future__ import annotations
@@ -38,6 +44,7 @@ from __future__ import annotations
 import time
 from typing import Any, Optional
 
+from . import health as _health
 from . import tracing as _tracing
 from .metrics import MetricsRegistry, registry as _global_registry
 
@@ -76,13 +83,16 @@ def _wrapped_registries(el: Any) -> list:
 
 def instrument_pipeline(pipeline: Any,
                         reg: Optional[MetricsRegistry] = None,
-                        span_store: Optional["_tracing.SpanStore"] = None
+                        span_store: Optional["_tracing.SpanStore"] = None,
+                        health: Optional["_health.HealthRegistry"] = None
                         ) -> None:
     """Wrap every element of ``pipeline`` to record into ``reg`` (the
-    process-global registry by default) and, when ``span_store`` is
-    given, open per-element spans into it. Idempotent per (element,
-    registry): safe across restarts and combined tracer + exporter use
-    (each consumer's wrap records to its own registry)."""
+    process-global registry by default); when ``span_store`` is given,
+    open per-element spans into it; when ``health`` is given, register
+    a component per element and heartbeat it per buffer. Idempotent
+    per (element, registry): safe across restarts and combined tracer
+    + exporter use (each consumer's wrap records to its own
+    registry)."""
     from ..core.buffer import Buffer
     from ..graph.element import FlowReturn
     from ..graph.pipeline import Queue
@@ -95,6 +105,12 @@ def instrument_pipeline(pipeline: Any,
         if any(r is reg for r in regs):
             continue
         regs.append(reg)
+        comp = None
+        if health is not None:
+            comp = health.component(
+                f"element:{pipeline.name}:{el.name}", kind="element",
+                probe=_health.element_probe(pipeline, el),
+                attrs={"element": el.name, "pipeline": pipeline.name})
         if isinstance(el, Queue):
             # collection-time callback — queues' own locking protects
             # len() reads well enough for a monitoring sample
@@ -104,13 +120,18 @@ def instrument_pipeline(pipeline: Any,
             orig_create = getattr(el, "create", None)
             if orig_create is not None:
                 def create_stamped(_orig=orig_create, _el=el,
-                                   _spans=span_store):
+                                   _spans=span_store, _comp=comp):
                     buf = _orig()
                     if buf is not None:
                         buf.meta.setdefault("trace_t0_ns",
                                             time.monotonic_ns())
                         if _spans is not None:
                             _tracing.stamp_buffer(buf, _spans, _el.name)
+                        if _comp is not None:
+                            _comp.beat()
+                            ctx = buf.meta.get(_tracing.CTX_META_KEY)
+                            if ctx is not None:
+                                _comp.last_trace_id = ctx.trace_id
                     return buf
 
                 el.create = create_stamped
@@ -123,13 +144,22 @@ def instrument_pipeline(pipeline: Any,
 
         def timed_chain(pad, buf, _orig=orig, _bufs=bufs, _proc=proc,
                         _inter=inter, _errs=errs, _spans=span_store,
-                        _name=el.name, _sink=el.is_sink):
+                        _comp=comp, _name=el.name, _sink=el.is_sink):
             is_buf = isinstance(buf, Buffer)
             t0 = buf.meta.get("trace_t0_ns") if is_buf else None
             start = time.monotonic_ns()
             if t0 is not None:
                 _inter.observe((start - t0) / 1e9)
             _bufs.inc()
+            if _comp is not None:
+                # heartbeat + last-seen trace id: the watchdog's stall
+                # rule reads the beat age; its verdict event carries
+                # the trace that stopped moving
+                _comp.beat()
+                if is_buf:
+                    hctx = buf.meta.get(_tracing.CTX_META_KEY)
+                    if hctx is not None:
+                        _comp.last_trace_id = hctx.trace_id
             span = None
             token = None
             if _spans is not None and is_buf:
@@ -172,13 +202,18 @@ def instrument_pipeline(pipeline: Any,
 
 
 def maybe_instrument_pipeline(pipeline: Any) -> None:
-    """Pipeline.start hook: attach to the global registry iff metrics
-    OR tracing are enabled — the structural no-op fast path when
-    neither is. (Metrics recording into a disabled registry is itself a
-    flag-check no-op, so a tracing-only run costs no metric state.)
-    Also registers the pipeline for /debug/pipeline topology — a
-    WeakSet add, unconditionally cheap."""
+    """Pipeline.start hook: attach to the global registry iff metrics,
+    tracing, OR health are enabled — the structural no-op fast path
+    when none are. (Metrics recording into a disabled registry is
+    itself a flag-check no-op, so a tracing- or health-only run costs
+    no metric state.) Also registers the pipeline for /debug/pipeline
+    topology — a WeakSet add, unconditionally cheap."""
     _tracing.register_pipeline(pipeline)
     spans = _tracing.store() if _tracing.enabled() else None
-    if _global_registry().is_enabled or spans is not None:
-        instrument_pipeline(pipeline, span_store=spans)
+    health = _health.registry() if _health.enabled() else None
+    if health is not None:
+        # readiness: "pipeline PLAYING" flips true at the end of start
+        _health.track_pipeline(pipeline)
+    if _global_registry().is_enabled or spans is not None \
+            or health is not None:
+        instrument_pipeline(pipeline, span_store=spans, health=health)

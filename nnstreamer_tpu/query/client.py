@@ -26,12 +26,15 @@ import collections
 import socket
 import threading
 import time
+import weakref
 from typing import Any, Optional
 
 from ..core.buffer import Buffer
 from ..core.log import logger
 from ..core.types import Caps, TensorFormat
 from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..obs import events as _events
+from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
 from .protocol import (
@@ -100,6 +103,22 @@ class TensorQueryClient(Element):
             "Pipelined requests currently in flight",
             ("element",)).labels(self.name).set_function(
                 lambda: len(self._pending))
+        # health (obs/health.py): connection-liveness component (the
+        # watchdog's reconnect-storm rule reads its "reconnect" count)
+        # and the "query connected" readiness condition — the shared
+        # no-op component / a skipped registration while health is off.
+        # Weakref probes: the registry never pins a retired element.
+        ref = weakref.ref(self)
+        self._hc = _health.component(
+            f"query.client:{self.name}", kind="query",
+            probe=lambda: (lambda c: None if c is None else
+                           {"connected": c._sock is not None,
+                            "in_flight": len(c._pending)})(ref()),
+            attrs={"element": self.name})
+        _health.add_readiness(
+            f"query:{self.name}",
+            lambda: (lambda c: None if c is None
+                     else c._sock is not None)(ref()))
 
     # -- connection ---------------------------------------------------------- #
     def _resolve_endpoints(self) -> list:
@@ -130,6 +149,13 @@ class TensorQueryClient(Element):
                 if cmd is not Cmd.INFO_APPROVE:
                     raise ConnectionError(f"server denied connection: {meta}")
                 self._m_reconnects.inc()
+                self._hc.count("reconnect")  # watchdog storm-rule input
+                self._hc.beat()
+                self._hc.set_status(_health.Status.OK,
+                                    f"connected to {host}:{port}")
+                _events.record("query.connect",
+                               f"{self.name}: connected to {host}:{port}",
+                               element=self.name)
                 return sock
             except (OSError, QueryProtocolError, ConnectionError) as e:
                 last = e
@@ -151,6 +177,11 @@ class TensorQueryClient(Element):
                 except (ConnectionError, OSError) as e:
                     last = e
                     time.sleep(min(0.2 * (attempt + 1), 1.0))
+            self._hc.set_status(_health.Status.FAILED,
+                                f"connect failed: {last}")
+            _events.record("query.connect_failed",
+                           f"{self.name}: connect failed: {last}",
+                           severity="error", element=self.name)
             raise ConnectionError(f"tensor_query_client: connect failed: {last}")
         return self._sock
 
@@ -253,6 +284,9 @@ class TensorQueryClient(Element):
         Only safe with nothing in flight. stop() joins the old reader
         BEFORE the state reset — an unjoined reader could wake later and
         misread the new connection's pending window."""
+        _events.record("query.reconnect",
+                       f"{self.name}: dropping connection for redial",
+                       element=self.name)
         self.stop()
         self._reader_error = None
 
@@ -375,6 +409,8 @@ class TensorQueryClient(Element):
                             "query send failed with frames in flight")
                     return FlowReturn.ERROR
                 self._reset_conn()  # nothing else at risk: retry fresh
+        self._hc.set_status(_health.Status.FAILED,
+                            "request failed after retries")
         self.post_error("query: request failed after retries")
         return FlowReturn.ERROR
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+import weakref
 from typing import Any, Dict, Optional
 
 from ..core.buffer import Buffer
@@ -26,6 +27,8 @@ from ..core.log import logger
 from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..graph.pipeline import SourceElement
+from ..obs import events as _events
+from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
 from .protocol import (
@@ -91,6 +94,17 @@ class TensorQueryServerSrc(SourceElement):
             ("element",)).labels(self.name).set_function(
                 lambda: self._inbox.qsize() if self._inbox is not None
                 else 0)
+        # health component: connection count + inbox depth, weakref so the
+        # registry never pins a retired listener. A no-op while health is
+        # off (shared NOOP_COMPONENT, zero per-frame cost).
+        ref = weakref.ref(self)
+        self._hc = _health.component(
+            f"query.server:{self.name}", kind="query",
+            probe=lambda: (lambda s: None if s is None else
+                           {"connections": len(s._conns),
+                            "inbox_depth": s._inbox.qsize()
+                            if s._inbox is not None else 0})(ref()),
+            attrs={"element": self.name})
 
     # -- lifecycle ---------------------------------------------------------- #
     def negotiate(self) -> Caps:
@@ -130,10 +144,16 @@ class TensorQueryServerSrc(SourceElement):
             # on localhost vs sub-ms with it
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._m_conns.inc()
+            self._hc.beat()
+            self._hc.count("accept")
             with self._lock:
                 self._conn_seq += 1
                 cid = self._conn_seq
                 self._conns[cid] = conn
+            _events.record("query.accept",
+                           f"{self.name}: accepted client {cid} from "
+                           f"{addr[0]}:{addr[1]}",
+                           element=self.name, client=cid)
             t = threading.Thread(target=self._client_loop, args=(cid, conn),
                                  daemon=True, name=f"qsrv-conn{cid}")
             t.start()
@@ -151,6 +171,7 @@ class TensorQueryServerSrc(SourceElement):
                 elif cmd is Cmd.PING:
                     send_message(conn, Cmd.PONG, {})
                 elif cmd is Cmd.DATA:
+                    self._hc.beat()
                     buf = payload_to_buffer(meta, payload)
                     buf.meta["query_client_id"] = cid
                     if _tracing.enabled():
@@ -176,6 +197,9 @@ class TensorQueryServerSrc(SourceElement):
         finally:
             with self._lock:
                 self._conns.pop(cid, None)
+            _events.record("query.disconnect",
+                           f"{self.name}: client {cid} disconnected",
+                           element=self.name, client=cid)
             try:
                 conn.close()
             except OSError:

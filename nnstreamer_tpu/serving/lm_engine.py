@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import causal_lm
+from ..obs import events as _events
+from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
@@ -249,6 +251,7 @@ class LMEngine:
                       "spec_iterations": 0, "spec_drafted": 0,
                       "spec_accepted": 0}
         self._init_metrics()
+        self._init_health()
 
     #: distinguishes engine kinds in the metric series; the TP engine
     #: overrides to "tp"
@@ -302,6 +305,40 @@ class LMEngine:
             ("engine",)).labels(lbl).set_function(
                 lambda: len(ref()._queue) if ref() is not None else 0)
 
+    def _init_health(self) -> None:
+        """Register the engine's health component + warmed-readiness
+        condition (obs/health.py). The watchdog's admission-stall rule
+        reads ``oldest_wait_s`` from the probe; /readyz reads "first
+        bucket compiled" from the readiness condition. Both go through
+        weakrefs so health never pins a retired engine's caches, and
+        both are skipped entirely (shared no-op component) while health
+        is off."""
+        import weakref
+
+        lbl = self._engine_label
+        ref = weakref.ref(self)
+
+        def probe():
+            eng = ref()
+            if eng is None:
+                return None
+            oldest = min((r.t_submit for r in eng._queue), default=None)
+            return {
+                "queued": len(eng._queue),
+                "active": sum(r is not None for r in eng._slot_req),
+                "warmed": bool(eng._seen_buckets),
+                "oldest_wait_s": (time.monotonic() - oldest)
+                if oldest is not None else 0.0,
+            }
+
+        self._hc = _health.component(
+            f"serving.engine:{lbl}", kind="serving", probe=probe,
+            attrs={"engine": lbl})
+        _health.add_readiness(
+            f"engine:{lbl}",
+            lambda: (lambda e: None if e is None
+                     else bool(e._seen_buckets))(ref()))
+
     def _alloc_slot_caches(self, n_layers: int, hd: int):
         """Zero per-slot KV stores, (S, L·H, max_len, hd). Overridden by
         the mesh-sharded engine to allocate sharded-from-birth."""
@@ -324,11 +361,14 @@ class LMEngine:
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size < 1:
+            self._reject("empty prompt")
             raise ValueError("empty prompt")
         if max_new < 1:
+            self._reject("max_new must be >= 1")
             raise ValueError("max_new must be >= 1")
         if p.size + max_new - 1 > self.max_len:
             # the LAST generated token needs no cache slot, hence -1
+            self._reject("prompt + max_new exceeds cache capacity")
             raise ValueError(
                 f"prompt ({p.size}) + max_new ({max_new}) exceeds cache "
                 f"capacity max_len={self.max_len}")
@@ -352,6 +392,15 @@ class LMEngine:
         self._queue.append(req)
         return rid
 
+    def _reject(self, reason: str) -> None:
+        """Flight-recorder entry for an admission rejection — one flag
+        check while events are off."""
+        self._hc.count("rejected")
+        _events.record("serving.admission_reject",
+                       f"{self._engine_label}: {reason}",
+                       severity="warning", engine=self._engine_label,
+                       reason=reason)
+
     def pending(self) -> int:
         return len(self._queue) + sum(
             r is not None for r in self._slot_req)
@@ -359,6 +408,7 @@ class LMEngine:
     def step_iteration(self) -> bool:
         """One scheduler iteration: admit into free slots, then one
         decode chunk. Returns True while work remains."""
+        self._hc.beat()  # watchdog liveness: the scheduler is turning
         t0 = time.monotonic()
         self._admit()
         self._decode()

@@ -36,7 +36,12 @@ serving.request / admission_wait / prefill / compile / decode spans
 (obs/tracing.py) are opened by LMEngine's submit/_admit/_retire_if_done
 hooks, which this class does not override — a mesh-sharded engine
 reports the same trace shape as the single-device one, with
-``engine="tp"`` in the span attrs via `_engine_label`.
+``engine="tp"`` in the span attrs via `_engine_label`. The same holds
+for the health model (obs/health.py): `_init_health` registers a
+``serving.engine:tp`` component (admission-stall watchdog input) and a
+"first bucket compiled" readiness condition under ``engine:tp``, so
+/healthz and /readyz cover the sharded engine with zero TP-specific
+code.
 """
 
 from __future__ import annotations

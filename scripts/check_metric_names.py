@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""Lint registered metric names AND span names against the repo
-naming conventions.
+"""Lint registered metric names, span names AND flight-recorder event
+types against the repo naming conventions.
 
 Metric convention (docs/observability.md): every metric is
 ``nnstpu_<layer>_<name>_<unit>`` with
@@ -14,12 +14,20 @@ Span convention (docs/observability.md "Tracing"): every span name is
 a literal lowercase dotted ``<layer>.<operation>`` with layer in
 {pipeline, query, serving, device} — e.g. ``serving.prefill``.
 
+Event convention (docs/observability.md "Health & flight recorder"):
+every flight-recorder event type is the same lowercase dotted
+``<layer>.<event>`` shape, with layer additionally allowing {core, obs}
+(the log bridge and the obs subsystem itself emit events) — e.g.
+``pipeline.stall``, ``query.reconnect_storm``, ``core.log``.
+
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
-calls and ``.start_span(...)`` / ``start_span(...)`` tracing calls, so
-drift fails CI (wired as a tier-1 test: tests/test_metric_names.py)
-the moment an off-convention name lands. Registrations built from
-non-literal names are invisible to this lint — keep names literal.
+calls, ``.start_span(...)`` / ``start_span(...)`` tracing calls, and
+``events.record(...)`` / ``_events.record(...)`` / bare ``record(...)``
+flight-recorder calls, so drift fails CI (wired as a tier-1 test:
+tests/test_metric_names.py) the moment an off-convention name lands.
+Registrations built from non-literal names are invisible to this lint —
+keep names literal.
 
 Exit 0 when clean; exit 1 listing every violation.
 """
@@ -41,6 +49,9 @@ UNIT_BY_TYPE = {
 }
 #: span layers add "device" — device.xprof has no metric series
 SPAN_LAYERS = ("pipeline", "query", "serving", "device")
+#: event layers additionally allow "core" (the core/log.py bridge) and
+#: "obs" (the obs subsystem's own events)
+EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs")
 
 #: reg.counter("name"... — dotted call so plain functions named e.g.
 #: ``gauge()`` elsewhere don't false-positive
@@ -56,6 +67,17 @@ _SPAN_CALL_RE = re.compile(r"\bstart_span\(\s*[\"']([^\"']+)[\"']")
 
 _SPAN_NAME_RE = re.compile(
     r"^(?P<layer>[a-z]+)\.(?P<op>[a-z][a-z0-9_]*)$")
+
+#: events.record("type"... / _events.record("type"... / a bare
+#: record("type"... (module-internal call in obs/events.py). The
+#: lookbehind keeps method calls on OTHER objects — ``stats.record(``,
+#: ``._record(`` — from matching; those take no literal name anyway.
+_EVENT_CALL_RE = re.compile(
+    r"(?:(?<![\w.])record|\b(?:events|_events)\.record)"
+    r"\(\s*[\"']([^\"']+)[\"']")
+
+_EVENT_NAME_RE = re.compile(
+    r"^(?P<layer>[a-z]+)\.(?P<event>[a-z][a-z0-9_]*)$")
 
 
 def iter_registrations(root: Path = SOURCE_ROOT):
@@ -76,6 +98,16 @@ def iter_span_sites(root: Path = SOURCE_ROOT):
     for path in sorted(root.rglob("*.py")):
         text = path.read_text(encoding="utf-8")
         for m in _SPAN_CALL_RE.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            yield path, lineno, m.group(1)
+
+
+def iter_event_sites(root: Path = SOURCE_ROOT):
+    """Yield (path, lineno, event_type) for every literal-type
+    flight-recorder ``record`` call under ``root``."""
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _EVENT_CALL_RE.finditer(text):
             lineno = text.count("\n", 0, m.start()) + 1
             yield path, lineno, m.group(1)
 
@@ -112,6 +144,7 @@ def check(root: Path = SOURCE_ROOT):
             f"no metric registrations found under {root} — "
             "lint regex out of sync with the registry API?")
     problems += check_spans(root)
+    problems += check_events(root)
     return problems
 
 
@@ -142,6 +175,31 @@ def check_spans(root: Path = SOURCE_ROOT):
     return problems
 
 
+def check_events(root: Path = SOURCE_ROOT):
+    """Event-type violations under ``root``. Mirrors check_spans: zero
+    event sites only flags the real source tree."""
+    problems = []
+    found = 0
+    for path, lineno, name in iter_event_sites(root):
+        found += 1
+        where = _where(path, lineno)
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            problems.append(
+                f"{where}: event {name!r} does not match lowercase "
+                "<layer>.<event>")
+            continue
+        if m.group("layer") not in EVENT_LAYERS:
+            problems.append(
+                f"{where}: event {name!r} layer {m.group('layer')!r} "
+                f"not in {EVENT_LAYERS}")
+    if found == 0 and root == SOURCE_ROOT:
+        problems.append(
+            f"no event record call sites found under {root} — "
+            "lint regex out of sync with the events API?")
+    return problems
+
+
 def main() -> int:
     problems = check()
     if problems:
@@ -151,8 +209,10 @@ def main() -> int:
         return 1
     n = sum(1 for _ in iter_registrations())
     ns = sum(1 for _ in iter_span_sites())
+    ne = sum(1 for _ in iter_event_sites())
     print(f"metric names OK ({n} registrations checked); "
-          f"span names OK ({ns} call sites checked)")
+          f"span names OK ({ns} call sites checked); "
+          f"event names OK ({ne} call sites checked)")
     return 0
 
 

@@ -1,0 +1,520 @@
+"""obs.health + obs.events tests: the flight-recorder ring (bounds,
+trace correlation, log bridge, crash hook), the component health model
+and readiness semantics, each watchdog rule driven deterministically
+via check_now(), the end-to-end stalled-element acceptance path, the
+zero-overhead-while-disabled guarantee, and the NNS_TPU_DEBUG invalid-
+level fallback."""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core.types import Caps, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.obs import events as obs_events
+from nnstreamer_tpu.obs import health as obs_health
+from nnstreamer_tpu.obs import metrics as obs_metrics
+from nnstreamer_tpu.obs import tracing as obs_tracing
+from nnstreamer_tpu.obs.events import EventRing
+from nnstreamer_tpu.obs.health import HealthRegistry, Status
+
+
+def _tensor_caps(dims: str, types: str, rate=Fraction(30, 1)) -> Caps:
+    return Caps.tensors(TensorsConfig(
+        TensorsInfo.from_strings(dims, types), rate))
+
+
+_THRESHOLDS = ("stall_after_s", "queue_dwell_s", "reconnect_storm",
+               "reconnect_window_s", "admission_deadline_s", "interval_s")
+
+
+@pytest.fixture
+def health():
+    """Reset the process-global health registry around a test; stops
+    any watchdog thread the test started and restores the thresholds
+    (reset() keeps them — a leaked interval_s would starve the next
+    test's watchdog)."""
+    reg = obs_health.registry()
+    was = reg.is_enabled
+    saved = {k: getattr(reg, k) for k in _THRESHOLDS}
+    reg.reset()
+    yield obs_health
+    reg.reset()
+    for k, v in saved.items():
+        setattr(reg, k, v)
+    reg._enabled = was
+
+
+@pytest.fixture
+def events():
+    """Reset the process-global event ring around a test; removes the
+    log bridge + excepthook taps if the test installed them."""
+    ring = obs_events.ring()
+    was = ring.is_enabled
+    ring.reset()
+    yield obs_events
+    obs_events.disable()
+    ring.reset()
+    ring._enabled = was
+
+
+@pytest.fixture
+def tracing_off_after():
+    was = obs_tracing.enabled()
+    store = obs_tracing.store() if hasattr(obs_tracing, "store") else None
+    yield obs_tracing
+    (obs_tracing.enable if was else obs_tracing.disable)()
+    if store is not None:
+        store.reset()
+
+
+# --------------------------------------------------------------------------- #
+# Event ring
+# --------------------------------------------------------------------------- #
+
+class TestEventRing:
+    def test_disabled_records_nothing(self):
+        r = EventRing(enabled=False)
+        r.record("pipeline.state", "nope")
+        assert len(r) == 0
+        assert r.snapshot() == []
+
+    def test_enabled_records_fields(self):
+        r = EventRing(enabled=True)
+        r.record("pipeline.state", "PLAYING", pipeline="p0")
+        r.record("pipeline.error", "boom", severity="error")
+        evs = r.snapshot()
+        assert [e["seq"] for e in evs] == [0, 1]
+        assert evs[0]["type"] == "pipeline.state"
+        assert evs[0]["message"] == "PLAYING"
+        assert evs[0]["severity"] == "info"
+        assert evs[0]["attrs"] == {"pipeline": "p0"}
+        assert evs[0]["trace_id"] is None
+        assert evs[1]["severity"] == "error"
+        assert evs[1]["ts"] == pytest.approx(time.time(), abs=30)
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        r = EventRing(capacity=4, enabled=True)
+        for i in range(7):
+            r.record("pipeline.state", f"m{i}")
+        assert len(r) == 4
+        assert r.dropped == 3
+        assert [e["message"] for e in r.snapshot()] == \
+            ["m3", "m4", "m5", "m6"]
+        assert [e["message"] for e in r.snapshot(limit=2)] == ["m5", "m6"]
+
+    def test_trace_correlation(self, events, tracing_off_after):
+        events.enable()
+        obs_tracing.enable()
+        with obs_tracing.start_span("pipeline.element") as span:
+            events.record("pipeline.error", "inside a traced chain")
+        ev = events.ring().snapshot()[-1]
+        assert ev["trace_id"] == span.context.trace_id
+        assert ev["span_id"] == span.context.span_id
+        # explicit override beats the contextvar (watchdog verdicts)
+        events.record("pipeline.stall", "verdict", trace_id="feedbeef")
+        assert events.ring().snapshot()[-1]["trace_id"] == "feedbeef"
+
+    def test_log_bridge(self, events):
+        from nnstreamer_tpu.core.log import logger
+
+        events.enable()
+        logger("healthtest").warning("something smells")
+        logger("healthtest").debug("too quiet to bridge")
+        evs = [e for e in events.ring().snapshot()
+               if e["type"] == "core.log"]
+        assert len(evs) == 1
+        assert evs[0]["severity"] == "warning"
+        assert "something smells" in evs[0]["message"]
+        events.disable()
+        logger("healthtest").warning("after disable")
+        assert all("after disable" not in e["message"]
+                   for e in events.ring().snapshot())
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_pipeline_thread_crash_dumps_ring(self, events, capsys):
+        events.enable()
+
+        def die():
+            raise RuntimeError("synthetic crash")
+
+        t = threading.Thread(target=die, name="src:crash-test")
+        t.start()
+        t.join()
+        evs = [e for e in events.ring().snapshot()
+               if e["type"] == "pipeline.crash"]
+        assert len(evs) == 1
+        assert "RuntimeError" in evs[0]["message"]
+        assert evs[0]["attrs"]["thread"] == "src:crash-test"
+        assert "flight recorder" in capsys.readouterr().err
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_non_pipeline_thread_crash_ignored(self, events):
+        events.enable()
+
+        def die():
+            raise RuntimeError("not ours")
+
+        t = threading.Thread(target=die, name="user-thread")
+        t.start()
+        t.join()
+        assert not [e for e in events.ring().snapshot()
+                    if e["type"] == "pipeline.crash"]
+
+    def test_dump_jsonl(self, events, tmp_path):
+        events.enable()
+        events.record("pipeline.state", "PLAYING", pipeline="p0")
+        path = tmp_path / "events.jsonl"
+        events.dump_jsonl(str(path))
+        lines = path.read_text().strip().splitlines()
+        assert json.loads(lines[-1])["type"] == "pipeline.state"
+
+
+# --------------------------------------------------------------------------- #
+# NNS_TPU_DEBUG fallback (core/log.py)
+# --------------------------------------------------------------------------- #
+
+class TestLogLevelFallback:
+    def _reconfigure(self, monkeypatch, spec):
+        from nnstreamer_tpu.core import log as corelog
+
+        monkeypatch.setenv("NNS_TPU_DEBUG", spec)
+        monkeypatch.setattr(corelog, "_configured", False)
+        return corelog
+
+    def test_invalid_global_level_warns_and_falls_back(self, monkeypatch):
+        root = logging.getLogger("nns_tpu")
+        prev = root.level
+        corelog = self._reconfigure(monkeypatch, "bogus")
+        try:
+            corelog.logger("filter")  # first import path: must not raise
+            assert root.level == logging.WARNING
+        finally:
+            root.setLevel(prev)
+
+    def test_invalid_category_level_keeps_valid_ones(self, monkeypatch):
+        root = logging.getLogger("nns_tpu")
+        plog = logging.getLogger("nns_tpu.pipeline")
+        flog = logging.getLogger("nns_tpu.filter")
+        prev = root.level, plog.level, flog.level
+        corelog = self._reconfigure(
+            monkeypatch, "filter:bogus,pipeline:debug")
+        try:
+            corelog.logger("filter")
+            assert flog.level == logging.NOTSET  # invalid part dropped
+            assert plog.level == logging.DEBUG   # valid part applied
+        finally:
+            root.setLevel(prev[0])
+            plog.setLevel(prev[1])
+            flog.setLevel(prev[2])
+
+
+# --------------------------------------------------------------------------- #
+# Health model
+# --------------------------------------------------------------------------- #
+
+class TestHealthModel:
+    def test_disabled_returns_shared_noop(self, health):
+        reg = health.registry()
+        reg._enabled = False
+        c1 = health.component("a")
+        c2 = health.component("b")
+        assert c1 is c2 is obs_health.NOOP_COMPONENT
+        c1.beat()
+        c1.set_status(Status.FAILED, "ignored")
+        c1.count("x")
+        assert health.snapshot() == {"status": "ok", "ok": True,
+                                     "components": []}
+        assert health.readiness() == (True, {})
+
+    def test_aggregate_is_worst_component(self, health):
+        health.enable()
+        health.component("a").set_status(Status.OK)
+        health.component("b").set_status(Status.DEGRADED, "meh")
+        reg = health.registry()
+        assert reg.aggregate() is Status.DEGRADED
+        snap = health.snapshot()
+        assert snap["status"] == "degraded" and snap["ok"] is True
+        health.component("c").set_status(Status.FAILED, "dead")
+        snap = health.snapshot()
+        assert snap["status"] == "failing" and snap["ok"] is False
+        by_name = {c["name"]: c for c in snap["components"]}
+        assert by_name["c"]["detail"] == "dead"
+
+    def test_component_get_or_create_and_beat(self, health):
+        health.enable()
+        c = health.component("x", kind="element")
+        assert health.component("x") is c
+        assert c.last_beat_ns is None
+        c.beat()
+        assert c.last_beat_ns is not None
+        snap = c.snapshot()
+        assert snap["last_beat_age_s"] < 5.0
+
+    def test_readiness_semantics(self, health):
+        health.enable()
+        ready, conds = health.readiness()
+        assert ready is False and conds == {}  # nothing declared: not ready
+        health.add_readiness("a", lambda: True)
+        health.add_readiness("b", lambda: False)
+        ready, conds = health.readiness()
+        assert ready is False and conds == {"a": True, "b": False}
+        health.add_readiness("b", lambda: True)
+        ready, _ = health.readiness()
+        assert ready is True
+        # a condition returning None retires itself (weakref owner died)
+        health.add_readiness("c", lambda: None)
+        ready, conds = health.readiness()
+        assert "c" not in conds and ready is True
+        assert "c" not in health.registry()._conditions
+
+    def test_probe_retires_component(self, health):
+        health.enable(interval_s=60.0)
+        health.component("gone", kind="element", probe=lambda: None)
+        health.component("err", kind="element",
+                         probe=lambda: (_ for _ in ()).throw(RuntimeError))
+        health.check_now()
+        names = [c["name"] for c in health.snapshot()["components"]]
+        assert "gone" not in names  # None probe: retired
+        assert "err" in names       # raising probe: kept, tick skipped
+
+    def test_watchdog_thread_starts_lazily(self, health):
+        health.enable(interval_s=60.0)
+        assert "obs-health-watchdog" not in \
+            [t.name for t in threading.enumerate()]
+        health.component("first")
+        assert "obs-health-watchdog" in \
+            [t.name for t in threading.enumerate()]
+
+
+# --------------------------------------------------------------------------- #
+# Watchdog rules, driven deterministically via check_now()
+# --------------------------------------------------------------------------- #
+
+def _stall_events(events, etype):
+    return [e for e in events.ring().snapshot() if e["type"] == etype]
+
+
+class TestWatchdogRules:
+    def test_element_stall_and_recovery(self, health, events):
+        events.enable()
+        health.enable(stall_after_s=0.05, interval_s=60.0)
+        c = health.component(
+            "element:p:sink0", kind="element",
+            probe=lambda: {"running": True, "eos": False},
+            attrs={"element": "sink0"})
+        c.beat()
+        c.last_trace_id = "cafe1234"
+        time.sleep(0.1)
+        health.check_now()
+        assert c.status is Status.STALLED
+        evs = _stall_events(events, "pipeline.stall")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["element"] == "sink0"
+        assert evs[0]["attrs"]["stall_s"] > 0.05
+        assert evs[0]["trace_id"] == "cafe1234"
+        health.check_now()  # still stalled: verdict not re-recorded
+        assert len(_stall_events(events, "pipeline.stall")) == 1
+        c.beat()            # fresh beat: age back under the threshold
+        health.check_now()
+        assert c.status is Status.OK
+        assert len(_stall_events(events, "pipeline.recover")) == 1
+
+    def test_stopped_pipeline_is_not_stalled(self, health, events):
+        events.enable()
+        health.enable(stall_after_s=0.0, interval_s=60.0)
+        c = health.component(
+            "element:p:sink0", kind="element",
+            probe=lambda: {"running": False, "eos": False})
+        c.beat()
+        time.sleep(0.01)
+        health.check_now()
+        assert c.status is Status.OK
+        assert not _stall_events(events, "pipeline.stall")
+
+    def test_queue_dwell_degrades(self, health, events):
+        events.enable()
+        health.enable(stall_after_s=1000.0, queue_dwell_s=0.0,
+                      interval_s=60.0)
+        state = {"depth": 4}
+        c = health.component(
+            "element:p:q0", kind="element",
+            probe=lambda: {"running": True, "eos": False,
+                           "depth": state["depth"], "bound": 4})
+        c.beat()
+        health.check_now()           # arms full_since
+        time.sleep(0.01)
+        health.check_now()           # dwell exceeded
+        assert c.status is Status.DEGRADED
+        evs = _stall_events(events, "pipeline.queue_full")
+        assert len(evs) == 1 and evs[0]["attrs"]["depth"] == 4
+        state["depth"] = 0
+        health.check_now()
+        assert c.status is Status.OK
+        assert _stall_events(events, "pipeline.recover")
+
+    def test_reconnect_storm_degrades(self, health, events):
+        events.enable()
+        health.enable(reconnect_storm=3, reconnect_window_s=0.0,
+                      interval_s=60.0)
+        c = health.component("query.client:qc0", kind="query")
+        health.check_now()           # opens the counting window
+        c.count("reconnect", 3)
+        health.check_now()
+        assert c.status is Status.DEGRADED
+        evs = _stall_events(events, "query.reconnect_storm")
+        assert len(evs) == 1 and evs[0]["attrs"]["reconnects"] == 3
+        health.check_now()           # quiet window: recovery
+        assert c.status is Status.OK
+        assert _stall_events(events, "query.recover")
+
+    def test_reconnect_storm_never_masks_failed(self, health, events):
+        events.enable()
+        health.enable(reconnect_storm=1, reconnect_window_s=0.0,
+                      interval_s=60.0)
+        c = health.component("query.client:qc0", kind="query")
+        health.check_now()
+        c.set_status(Status.FAILED, "connect failed")
+        c.count("reconnect", 5)
+        health.check_now()
+        assert c.status is Status.FAILED  # the softer verdict lost
+        assert _stall_events(events, "query.reconnect_storm")
+
+    def test_admission_stall(self, health, events):
+        events.enable()
+        health.enable(admission_deadline_s=0.01, interval_s=60.0)
+        state = {"wait": 5.0}
+        c = health.component(
+            "serving.engine:lm", kind="serving",
+            probe=lambda: {"oldest_wait_s": state["wait"]},
+            attrs={"engine": "lm"})
+        health.check_now()
+        assert c.status is Status.STALLED
+        evs = _stall_events(events, "serving.admission_stall")
+        assert len(evs) == 1 and evs[0]["attrs"]["engine"] == "lm"
+        state["wait"] = 0.0
+        health.check_now()
+        assert c.status is Status.OK
+        assert _stall_events(events, "serving.recover")
+
+
+# --------------------------------------------------------------------------- #
+# End-to-end: injected stall caught by the real watchdog thread
+# --------------------------------------------------------------------------- #
+
+class TestStallAcceptance:
+    def test_stalled_element_reported_within_2x_threshold(
+            self, health, events, tracing_off_after):
+        """A sink that stops emitting buffers must show up STALLED —
+        with the element name, stall age, and a correlated trace id —
+        in /healthz + the event ring within 2x the watchdog threshold
+        of the stall onset."""
+        from nnstreamer_tpu.graph import Pipeline
+
+        threshold = 0.4
+        events.enable()
+        obs_tracing.enable()
+        health.enable(stall_after_s=threshold)
+        release = threading.Event()
+        sent = []
+
+        def feed():
+            if len(sent) < 2:
+                sent.append(1)
+                return np.zeros((8,), np.float32)
+            release.wait(15)   # wedge: emitted 2 buffers, then nothing
+            return None
+
+        p = Pipeline()
+        src = p.add_new("appsrc", caps=_tensor_caps("8", "float32"),
+                        callback=feed)
+        sink = p.add_new("tensor_sink")
+        Pipeline.link(src, sink)
+        p.start()
+        try:
+            # detection deadline: stall threshold + one watchdog tick,
+            # capped at the acceptance bound of 2x the threshold after
+            # the last buffer (plus the scheduling slack of this box)
+            deadline = time.monotonic() + 2 * threshold + 1.0
+            stall = None
+            while time.monotonic() < deadline:
+                evs = [e for e in events.ring().snapshot()
+                       if e["type"] == "pipeline.stall"
+                       and e["attrs"].get("element") == sink.name]
+                if evs:
+                    stall = evs[0]
+                    break
+                time.sleep(0.02)
+            assert stall is not None, "watchdog never flagged the stall"
+            assert stall["attrs"]["stall_s"] >= threshold
+            assert stall["severity"] == "warning"
+            # correlated with the trace that stopped moving
+            assert stall["trace_id"] is not None
+            snap = health.snapshot()
+            assert snap["status"] == "stalled" and snap["ok"] is False
+            stalled = [c for c in snap["components"]
+                       if c["status"] == "stalled"]
+            assert any(c["name"].endswith(sink.name) for c in stalled)
+        finally:
+            release.set()
+            p.stop()
+
+    def test_zero_overhead_when_disabled(self, health, events):
+        """The structural guarantee: with health (and metrics/tracing)
+        off, no watchdog thread exists, nothing registers, and element
+        chains stay the plain class methods."""
+        from nnstreamer_tpu.graph import Pipeline
+
+        health.registry()._enabled = False
+        was_m = obs_metrics.enabled()
+        was_t = obs_tracing.enabled()
+        obs_metrics.disable()
+        obs_tracing.disable()
+        try:
+            p = Pipeline()
+            src = p.add_new("videotestsrc", width=8, height=8,
+                            num_buffers=2)
+            conv = p.add_new("tensor_converter")
+            sink = p.add_new("tensor_sink")
+            Pipeline.link(src, conv, sink)
+            p.run(timeout=30)
+            assert "_chain_entry" not in conv.__dict__
+            assert "_obs_registries" not in conv.__dict__
+            assert "obs-health-watchdog" not in \
+                [t.name for t in threading.enumerate()]
+            assert health.snapshot()["components"] == []
+        finally:
+            (obs_metrics.enable if was_m else obs_metrics.disable)()
+            (obs_tracing.enable if was_t else obs_tracing.disable)()
+
+    def test_debug_events_endpoint(self, health, events):
+        from nnstreamer_tpu.obs.exporter import start_exporter
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+        events.enable()
+        events.record("pipeline.state", "PLAYING", pipeline="p0")
+        events.record("pipeline.error", "boom", severity="error")
+        was_m = obs_metrics.enabled()
+        try:
+            with start_exporter(port=0, registry=MetricsRegistry()) as exp:
+                body = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/debug/events",
+                    timeout=5).read().decode())
+                assert body["events_enabled"] is True
+                types = [e["type"] for e in body["events"]]
+                assert "pipeline.state" in types
+                assert "pipeline.error" in types
+                body = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/debug/events?n=1",
+                    timeout=5).read().decode())
+                assert len(body["events"]) == 1
+                assert body["events"][0]["type"] == "pipeline.error"
+        finally:
+            (obs_metrics.enable if was_m else obs_metrics.disable)()

@@ -1,6 +1,7 @@
 """Tier-1 wiring for scripts/check_metric_names.py: every registered
-metric name must follow nnstpu_<layer>_<name>_<unit>, and every literal
-span name must follow lowercase <layer>.<operation>."""
+metric name must follow nnstpu_<layer>_<name>_<unit>, every literal
+span name lowercase <layer>.<operation>, and every flight-recorder
+event type lowercase <layer>.<event>."""
 
 import subprocess
 import sys
@@ -17,6 +18,7 @@ def test_lint_passes_on_tree():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "metric names OK" in proc.stdout
     assert "span names OK" in proc.stdout
+    assert "event names OK" in proc.stdout
 
 
 def test_lint_catches_violations(tmp_path):
@@ -61,3 +63,25 @@ def test_lint_catches_span_violations(tmp_path):
     # the real tree must contain literal span call sites — a regex that
     # stops matching the tracing API shows up as this problem
     assert lint.check_spans() == []
+
+
+def test_lint_catches_event_violations(tmp_path):
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad_events.py"
+    bad.write_text(
+        '_events.record("pipeline.stall", "m")\n'     # fine
+        'record("query.reconnect_storm", "m")\n'      # fine (bare call)
+        '_events.record("webui.boom", "m")\n'         # bad layer
+        'events.record("NotDotted", "m")\n'           # not dotted
+        'self.stats.record(t0)\n')                    # not an event call
+    problems = lint.check_events(tmp_path)
+    assert len(problems) == 2
+    assert any("layer 'webui'" in p for p in problems)
+    assert any("'NotDotted'" in p for p in problems)
+    # the real tree must contain literal event call sites — a regex
+    # that stops matching the events API shows up as this problem
+    assert lint.check_events() == []

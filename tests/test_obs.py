@@ -161,6 +161,20 @@ def global_metrics():
     (obs_metrics.enable if was else obs_metrics.disable)()
 
 
+@pytest.fixture
+def global_health():
+    """Reset the process-global health registry around a test (and stop
+    any watchdog the test started)."""
+    from nnstreamer_tpu.obs import health as obs_health
+
+    reg = obs_health.registry()
+    was = reg.is_enabled
+    reg.reset()
+    yield obs_health
+    reg.reset()
+    reg._enabled = was
+
+
 def _tiny_pipeline():
     from nnstreamer_tpu.graph import Pipeline
 
@@ -218,6 +232,64 @@ class TestExporter:
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{exp.port}/nope", timeout=5)
+
+    def test_healthz_failing_component(self, global_metrics,
+                                       global_health):
+        """A FAILED component flips /healthz to 503 with status
+        "failing" and names the component in the body."""
+        obs_health = global_health
+        obs_health.enable()
+        c = obs_health.component("test:unit")
+        c.set_status(obs_health.Status.FAILED, "boom")
+        with start_exporter(port=0, registry=MetricsRegistry()) as exp:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/healthz", timeout=5)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read().decode())
+            assert body["status"] == "failing"
+            by_name = {comp["name"]: comp for comp in body["components"]}
+            assert by_name["test:unit"]["status"] == "failing"
+            assert by_name["test:unit"]["detail"] == "boom"
+
+    def test_readyz_transitions(self, global_metrics, global_health):
+        """/readyz: enabled health with zero conditions is NOT ready;
+        a started pipeline registers its PLAYING condition and flips it
+        ready; stopping flips it back."""
+        obs_health = global_health
+        obs_health.enable()
+        with start_exporter(port=0) as exp:
+            url = f"http://127.0.0.1:{exp.port}/readyz"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read().decode())["ready"] is False
+            p, _conv = _tiny_pipeline()
+            p.start()
+            try:
+                body = json.loads(
+                    urllib.request.urlopen(url, timeout=5).read().decode())
+                assert body["ready"] is True
+                assert body["conditions"][f"pipeline:{p.name}"] is True
+            finally:
+                p.stop()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read().decode())
+            assert body["conditions"][f"pipeline:{p.name}"] is False
+
+    def test_404_hint_lists_routes(self, global_metrics):
+        with start_exporter(port=0, registry=MetricsRegistry()) as exp:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/nope", timeout=5)
+            assert ei.value.code == 404
+            hint = ei.value.read().decode()
+            # derived from the dispatch table — every route shows up
+            for route in ("/metrics", "/healthz", "/readyz",
+                          "/debug/events", "/debug/traces"):
+                assert route in hint
 
     def test_start_exporter_enables_collection(self, global_metrics):
         obs_metrics.disable()
