@@ -9,7 +9,9 @@ Options: -t/--time limit, -v verbose bus messages, --list-elements,
 --inspect ELEMENT (gst-inspect-1.0 analog: pads + properties with their
 defaults, plus registered subplugin modes for filter/decoder/converter),
 --metrics-port/--trace/--watchdog/--events-dump (observability: metrics
-exporter, span tracing, health watchdog, flight-recorder dump — see
+exporter, span tracing, health watchdog, flight-recorder dump),
+--obs-push/--obs-aggregate (fleet federation: push this process's
+snapshots to an aggregator / serve the merged fleet — see
 docs/observability.md).
 """
 
@@ -46,6 +48,17 @@ def main(argv=None) -> int:
                     help="enable the flight recorder (obs.events) and dump "
                          "the event journal to PATH as JSON lines at exit "
                          "('-' dumps human-readable to stderr)")
+    ap.add_argument("--obs-push", metavar="URL", default=None,
+                    help="push metric/health/span snapshots to a fleet "
+                         "aggregator (obs.fleet): http://host:port for a "
+                         "background HTTP pusher, or the literal 'wire' to "
+                         "piggyback pushes on this pipeline's query-client "
+                         "connection only (no extra thread)")
+    ap.add_argument("--obs-aggregate", action="store_true",
+                    help="act as the fleet aggregator: accept pushes "
+                         "(OBS_PUSH frames + POST /fleet/push) and serve "
+                         "the merged fleet /metrics, /healthz, /readyz and "
+                         "/debug/fleet; requires --metrics-port")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -85,10 +98,35 @@ def main(argv=None) -> int:
 
         try:
             exporter = start_exporter(port=args.metrics_port)
-        except OSError as e:
+        except (OSError, RuntimeError) as e:
             print(f"ERROR: metrics exporter: {e}", file=sys.stderr)
             return 1
         print(f"metrics: {exporter.url}", file=sys.stderr)
+    if args.obs_aggregate:
+        if exporter is None:
+            ap.error("--obs-aggregate requires --metrics-port (the "
+                     "aggregator serves the fleet on the exporter)")
+        # fleet.* push/expiry/conflict events are the aggregator's
+        # audit trail — turn the ring on with the role
+        from .obs import events, fleet
+
+        events.enable()
+        agg = fleet.enable_aggregator()
+        print(f"fleet: aggregating as {agg.instance} "
+              f"(POST {exporter.url.rsplit('/', 1)[0]}/fleet/push)",
+              file=sys.stderr)
+    if args.obs_push is not None:
+        from .obs import fleet
+
+        url = None if args.obs_push == "wire" else args.obs_push
+        try:
+            psh = fleet.enable_push(url=url)
+        except ValueError as e:
+            print(f"ERROR: --obs-push: {e}", file=sys.stderr)
+            return 1
+        print(f"fleet: pushing as {psh.instance} "
+              f"({'query-wire piggyback' if url is None else url})",
+              file=sys.stderr)
     if args.trace:
         # like metrics: must be on BEFORE p.start() so the element
         # chains get the span-opening wrap at instrumentation time
@@ -110,6 +148,11 @@ def main(argv=None) -> int:
         p.start()
     except Exception as e:  # noqa: BLE001
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
+        if args.obs_push is not None or args.obs_aggregate:
+            from .obs import fleet
+
+            fleet.disable_push()
+            fleet.disable_aggregator()
         if exporter is not None:
             exporter.close()
         return 1
@@ -132,6 +175,11 @@ def main(argv=None) -> int:
             return 2
     finally:
         p.stop()
+        if args.obs_push is not None or args.obs_aggregate:
+            from .obs import fleet
+
+            fleet.disable_push()
+            fleet.disable_aggregator()
         if exporter is not None:
             exporter.close()
         if args.trace:

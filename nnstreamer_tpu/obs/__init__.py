@@ -12,7 +12,10 @@ usage.
 
 Metrics, tracing, health, and events are independently switchable
 (``enable()`` / ``tracing.enable()`` / ``health.enable()`` /
-``events.enable()``); each is a flag-check no-op when off.
+``events.enable()``); each is a flag-check no-op when off. The fleet
+layer (obs/fleet.py) federates all four across processes: workers
+push snapshots over the query wire or plain HTTP, and one aggregator
+re-exposes the merged fleet on its exporter.
 """
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
@@ -20,16 +23,19 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
 from .exporter import MetricsExporter, start_exporter
 from .instrument import instrument_pipeline
 from . import events
+from . import fleet
 from . import health
 from . import tracing
 from .events import EventRing
+from .fleet import FleetAggregator, FleetPusher
 from .health import Component, HealthRegistry, Status
 from .tracing import Span, SpanContext, SpanStore, start_span
 
 __all__ = [
     "Component", "DEFAULT_LATENCY_BUCKETS", "EventRing",
-    "HealthRegistry", "MetricsRegistry", "MetricsExporter", "Span",
-    "SpanContext", "SpanStore", "Status", "disable", "enable",
-    "enabled", "events", "health", "instrument_pipeline", "registry",
+    "FleetAggregator", "FleetPusher", "HealthRegistry",
+    "MetricsRegistry", "MetricsExporter", "Span", "SpanContext",
+    "SpanStore", "Status", "disable", "enable", "enabled", "events",
+    "fleet", "health", "instrument_pipeline", "registry",
     "start_exporter", "start_span", "tracing",
 ]

@@ -65,7 +65,7 @@ DEFAULT_CAPACITY = 512
 #: serversink drain, the health watchdog itself)
 _PIPELINE_THREAD_PREFIXES = (
     "src:", "q:", "batch:", "qsink:", "qclient-reader:", "qsrv-",
-    "obs-health-watchdog",
+    "obs-health-watchdog", "obs-fleet-push",
 )
 
 

@@ -20,6 +20,16 @@ health and debug surfaces:
     per-element span stats (the DOT-dump analog)
   * ``GET /debug/events``            — the flight-recorder event ring
     (obs/events.py), oldest first; ``?n=<int>`` keeps the newest N
+  * ``GET /debug/fleet``             — per-instance fleet state when
+    this process aggregates (obs/fleet.py); 503 otherwise
+  * ``POST /fleet/push``             — snapshot-push ingestion for
+    workers without a query wire; 503 unless aggregating
+
+When fleet aggregation is enabled (``--obs-aggregate``), ``/metrics``
+serves the merged fleet exposition (every instance's series with
+``instance``/``role`` labels) and ``/healthz`` / ``/readyz`` the
+worst-of-fleet rollups — checked per request, so no restart is needed
+to switch roles.
 
 Routes live in a dispatch table; the 404 hint is derived from it, so
 a new endpoint can never be forgotten from the hint.
@@ -38,6 +48,7 @@ scrapes and the GIL is irrelevant at scrape rates.
 
 from __future__ import annotations
 
+import errno
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -45,6 +56,7 @@ from typing import Optional
 from urllib.parse import parse_qs
 
 from . import events as _events
+from . import fleet as _fleet
 from . import health as _health
 from . import metrics as _metrics
 from . import tracing as _tracing
@@ -78,12 +90,20 @@ class MetricsExporter:
                 self._reply(404, "text/plain", self._HINT)
 
             # -- routes ------------------------------------------------ #
+            # /metrics, /healthz, /readyz consult the fleet aggregator
+            # per request: the process becomes (or stops being) the
+            # fleet scrape target without an exporter restart
             def _get_metrics(self, query):
-                self._reply(200, CONTENT_TYPE,
-                            reg.exposition().encode("utf-8"))
+                agg = _fleet.aggregator()
+                text = reg.exposition() if agg is None \
+                    else agg.exposition(reg)
+                self._reply(200, CONTENT_TYPE, text.encode("utf-8"))
 
             def _get_healthz(self, query):
                 snap = _health.snapshot()
+                agg = _fleet.aggregator()
+                if agg is not None:
+                    snap = agg.health_rollup(snap)
                 # liveness: degraded still serves traffic; a stalled or
                 # failing component flips the scrape to 503
                 self._json(200 if snap["ok"] else 503, {
@@ -94,15 +114,27 @@ class MetricsExporter:
                     "events_enabled": _events.enabled(),
                     "families": len(reg.names()),
                     "components": snap["components"],
+                    **({"fleet": snap["fleet"]} if "fleet" in snap else {}),
                 })
 
             def _get_readyz(self, query):
                 ready, conds = _health.readiness()
+                agg = _fleet.aggregator()
+                if agg is not None:
+                    ready, conds = agg.ready_rollup(ready, conds)
                 self._json(200 if ready else 503, {
                     "ready": ready,
                     "health_enabled": _health.enabled(),
                     "conditions": conds,
                 })
+
+            def _get_fleet(self, query):
+                agg = _fleet.aggregator()
+                if agg is None:
+                    self._json(503, {"error": "fleet aggregation is off "
+                                     "(enable with --obs-aggregate)"})
+                else:
+                    self._json(200, agg.snapshot())
 
             def _get_traces(self, query):
                 try:
@@ -144,6 +176,34 @@ class MetricsExporter:
                     "events": ring.snapshot(n if n >= 0 else None),
                 })
 
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path, _, _query = self.path.partition("?")
+                handler = self._POST_ROUTES.get(path)
+                if handler is None:
+                    self._reply(404, "text/plain", self._HINT)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    n = -1
+                if n < 0 or n > _fleet.MAX_PUSH_BYTES:
+                    self._json(413, {"error": "push body too large"})
+                    return
+                handler(self, self.rfile.read(n))
+
+            def _post_fleet_push(self, body):
+                agg = _fleet.aggregator()
+                if agg is None:
+                    self._json(503, {"error": "this process is not a "
+                                     "fleet aggregator (--obs-aggregate)"})
+                    return
+                try:
+                    agg.ingest(json.loads(body or b"{}"), via="http")
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                    return
+                self._json(200, {"ok": True})
+
             #: THE route table — the 404 hint below derives from it, so
             #: adding an endpoint here is the whole registration
             _ROUTES = {
@@ -153,11 +213,14 @@ class MetricsExporter:
                 "/debug/traces": _get_traces,
                 "/debug/pipeline": _get_pipeline,
                 "/debug/events": _get_events,
+                "/debug/fleet": _get_fleet,
             }
             _PREFIX_ROUTES = (("/debug/traces/", _get_trace),)
+            _POST_ROUTES = {"/fleet/push": _post_fleet_push}
             _HINT = ("not found (try " + ", ".join(
                 sorted(list(_ROUTES)
-                       + [p + "<id>" for p, _ in _PREFIX_ROUTES]))
+                       + [p + "<id>" for p, _ in _PREFIX_ROUTES]
+                       + [f"POST {p}" for p in _POST_ROUTES]))
                 + ")").encode("utf-8")
 
             def _json(self, code, obj):
@@ -177,10 +240,20 @@ class MetricsExporter:
                 pass
 
         self.registry = reg
-        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        try:
+            self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        except OSError as e:
+            if e.errno == errno.EADDRINUSE:
+                raise RuntimeError(
+                    f"metrics exporter: port {port} on {host} is already "
+                    f"in use — pick a free port with --metrics-port (or "
+                    f"port=0 for an ephemeral one)") from e
+            raise
         self._server.daemon_threads = True
         self.host = host
         self.port = self._server.server_address[1]
+        self._closed = False
+        self._close_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name=f"metrics-exporter:{self.port}")
@@ -191,9 +264,18 @@ class MetricsExporter:
         return f"http://{self.host}:{self.port}/metrics"
 
     def close(self) -> None:
+        """Stop serving, join the thread, release the socket. Idempotent.
+        The listening socket is closed only after the serve loop has
+        been joined — closing it under ``serve_forever`` races select()
+        on a dead fd; joining first makes the port free the moment
+        close() returns."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._server.shutdown()
-        self._server.server_close()
         self._thread.join(timeout=5)
+        self._server.server_close()
 
     def __enter__(self) -> "MetricsExporter":
         return self

@@ -1,0 +1,689 @@
+"""obs.fleet — cross-process observability for a multi-host deployment.
+
+PR 1–3 built metrics, tracing, and health as strictly single-process
+subsystems: a client pipeline offloading to a remote ``tensor_query``
+server sees only its own half of every request, and a TPU pod serving
+fleet would need one scrape target per process. This module makes the
+subsystem pod-shaped — **one scrape endpoint, one trace tree, one
+health verdict**:
+
+  * **Metric federation.** Workers periodically push compact registry
+    snapshots (plus health and exported spans) to an *aggregator*,
+    which re-exposes every instance's series on its ``/metrics`` with
+    ``instance``/``role`` labels appended. Counters and histograms are
+    cumulative per instance, so merging is last-snapshot-wins per
+    instance; ``# HELP``/``# TYPE`` are emitted exactly once per
+    family however many instances report it, and a family whose type
+    disagrees across instances is skipped with a
+    ``fleet.merge_conflict`` event instead of corrupting the scrape.
+  * **Remote span collection.** Workers export completed spans of
+    traces whose ids crossed the query wire (marked at wire
+    send/adopt time — obs/tracing.py ``mark_export``); the aggregator
+    ingests them into its span store, so ``/debug/traces/<id>``
+    renders the full cross-host tree stitched by the propagated trace
+    id.
+  * **Fleet health rollup.** Each push carries the worker's health
+    snapshot and readiness verdict. The aggregator's ``/healthz`` /
+    ``/readyz`` / ``/debug/fleet`` report worst-of-fleet status with
+    per-instance detail; a missing push heartbeat flips the instance
+    ``stalled`` (kind="fleet" watchdog rule, obs/health.py) and a
+    long-gone instance expires entirely (``fleet.expire``).
+
+Transport is dual: an ``OBS_PUSH`` frame piggybacked on an open
+``tensor_query`` connection (the client sends one ahead of a DATA
+frame when the push interval has elapsed — no extra socket, no extra
+thread), and a standalone HTTP ``POST /fleet/push`` to the
+aggregator's exporter for processes that have no query wire (a
+serving-only host, the CLI ``--obs-push URL`` path).
+
+Zero-overhead contract, same as the rest of obs: with fleet push
+disabled there are **no extra wire bytes** (``wire_frame_due`` is a
+module-global None check; no ``OBS_PUSH`` frame is ever built), **no
+background threads** (the HTTP pusher thread only exists while a URL
+push is enabled), and span export costs one attribute read in the
+span store. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from . import events as _events
+from . import health as _health
+from . import metrics as _metrics
+from . import tracing as _tracing
+from .metrics import _escape_help, _escape_label, _fmt
+
+__all__ = [
+    "FleetAggregator", "FleetPusher", "PUSH_VERSION", "aggregator",
+    "build_push", "default_instance", "disable_aggregator",
+    "disable_push", "enable_aggregator", "enable_push", "ingest_wire",
+    "push_enabled", "pusher", "wire_frame_due",
+]
+
+#: push document schema version (bump on incompatible change; the
+#: aggregator rejects unknown majors with a clear error)
+PUSH_VERSION = 1
+
+#: default seconds between pushes (CLI/API override)
+DEFAULT_INTERVAL_S = 2.0
+
+#: staleness: an instance whose last push is older than
+#: ``ttl_factor * its advertised interval`` is stale (not-ready +
+#: watchdog ``stalled``); older than ``expire_factor * interval`` it
+#: is dropped from the fleet entirely
+TTL_FACTOR = 3.0
+EXPIRE_FACTOR = 15.0
+
+#: per-push span batch bound (the store-side queue is bounded too)
+MAX_SPANS_PER_PUSH = 512
+
+#: HTTP ingestion body cap — a push is a snapshot, not a bulk upload
+MAX_PUSH_BYTES = 8 << 20
+
+
+def default_instance() -> str:
+    """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
+    unique per process on a pod without any coordination."""
+    return os.environ.get("NNSTPU_INSTANCE") \
+        or f"{socket.gethostname()}:{os.getpid()}"
+
+
+def build_push(instance: str, role: str, seq: int,
+               interval_s: float = DEFAULT_INTERVAL_S,
+               registry: Optional[_metrics.MetricsRegistry] = None,
+               health_registry: Optional[_health.HealthRegistry] = None,
+               span_store: Optional[_tracing.SpanStore] = None,
+               max_spans: int = MAX_SPANS_PER_PUSH) -> Dict[str, Any]:
+    """Assemble one push document from the given (default: process-
+    global) registries — the single source of truth for the push
+    schema, shared by the pusher, the wire piggyback, and tests."""
+    reg = registry if registry is not None else _metrics.registry()
+    hreg = health_registry if health_registry is not None \
+        else _health.registry()
+    store = span_store if span_store is not None else _tracing.store()
+    ready, conds = hreg.readiness()
+    return {
+        "v": PUSH_VERSION,
+        "instance": instance,
+        "role": role,
+        "seq": int(seq),
+        "ts": time.time(),
+        "interval_s": float(interval_s),
+        "metrics": reg.snapshot(),
+        "health": hreg.snapshot(),
+        "ready": {"ready": ready, "conditions": conds},
+        "spans": store.drain_export(max_spans),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Pusher (worker side)
+# --------------------------------------------------------------------------- #
+
+class FleetPusher:
+    """Ships this process's snapshots to an aggregator.
+
+    ``url`` (``http://host:port`` or a bare ``host:port``) starts a
+    daemon thread POSTing to ``/fleet/push`` every ``interval_s``;
+    ``url=None`` is wire-only mode — no thread, pushes ride the query
+    wire via :meth:`wire_frame` whenever the client sends anyway.
+    Both modes share one interval clock per channel, and both flip
+    span export on in the span store so wire-crossing traces queue
+    their spans for the next push.
+    """
+
+    def __init__(self, url: Optional[str] = None,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 instance: Optional[str] = None, role: str = "worker",
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 health_registry: Optional[_health.HealthRegistry] = None,
+                 span_store: Optional[_tracing.SpanStore] = None):
+        self.instance = instance or default_instance()
+        self.role = role
+        self.interval_s = max(float(interval_s), 0.05)
+        self._registry = registry
+        self._health_registry = health_registry
+        self._store = span_store if span_store is not None \
+            else _tracing.store()
+        self._host, self._port = self._parse_url(url)
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._last_wire = 0.0
+        self._http_failing = False
+        self.pushes_sent = 0
+        self.push_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._store.set_export(True)
+        if self._host is not None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"obs-fleet-push:{self.instance}")
+            self._thread.start()
+
+    @staticmethod
+    def _parse_url(url: Optional[str]) -> Tuple[Optional[str], int]:
+        if not url:
+            return None, 0
+        if "//" not in url:
+            url = "http://" + url
+        parts = urlsplit(url)
+        if parts.scheme != "http" or not parts.hostname:
+            raise ValueError(
+                f"fleet push URL must be http://host:port, got {url!r}")
+        return parts.hostname, parts.port or 9464
+
+    def _next_doc(self) -> Dict[str, Any]:
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        return build_push(self.instance, self.role, seq,
+                          interval_s=self.interval_s,
+                          registry=self._registry,
+                          health_registry=self._health_registry,
+                          span_store=self._store)
+
+    # -- HTTP channel --------------------------------------------------- #
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.push_now()
+
+    def push_now(self) -> bool:
+        """One synchronous HTTP push (the thread's tick; callable
+        directly for deterministic tests). Failures are counted and
+        journaled on state *change* only — a down aggregator must not
+        flood the event ring at push rate."""
+        if self._host is None:
+            return False
+        body = json.dumps(self._next_doc(), default=str).encode("utf-8")
+        try:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=5.0)
+            try:
+                conn.request("POST", "/fleet/push", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    raise OSError(f"aggregator replied {resp.status}")
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            self.push_errors += 1
+            if not self._http_failing:
+                self._http_failing = True
+                _events.record(
+                    "fleet.push_failed",
+                    f"{self.instance}: push to {self._host}:{self._port} "
+                    f"failed: {e}", severity="warning",
+                    instance=self.instance)
+            return False
+        self.pushes_sent += 1
+        if self._http_failing:
+            self._http_failing = False
+            _events.record("fleet.push_recovered",
+                           f"{self.instance}: pushes reaching "
+                           f"{self._host}:{self._port} again",
+                           instance=self.instance)
+        return True
+
+    # -- query-wire channel --------------------------------------------- #
+    def wire_frame(self) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        """(meta, payload) for one ``OBS_PUSH`` frame when the wire
+        interval has elapsed, else None. Called by the query client
+        immediately before a DATA send — same thread, same socket, so
+        the push never races a request frame."""
+        now = time.monotonic()
+        if now - self._last_wire < self.interval_s:
+            return None
+        self._last_wire = now
+        doc = self._next_doc()
+        meta = {"instance": doc["instance"], "role": doc["role"],
+                "seq": doc["seq"], "v": doc["v"]}
+        return meta, json.dumps(doc, default=str).encode("utf-8")
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._thread = None
+        # Final flush: a worker that lived shorter than one interval
+        # would otherwise exit without ever reporting. Best-effort —
+        # push_now() swallows a down aggregator.
+        if self._host is not None:
+            self.push_now()
+        self._store.set_export(False)
+
+
+# --------------------------------------------------------------------------- #
+# Aggregator
+# --------------------------------------------------------------------------- #
+
+class _Instance:
+    """Latest state pushed by one worker process."""
+
+    __slots__ = ("instance", "role", "seq", "ts", "interval_s",
+                 "metrics", "health", "ready", "via", "pushes",
+                 "spans_ingested", "first_mono", "last_mono")
+
+    def __init__(self, instance: str):
+        self.instance = instance
+        self.role = "worker"
+        self.seq = 0
+        self.ts = 0.0
+        self.interval_s = DEFAULT_INTERVAL_S
+        self.metrics: Dict[str, Any] = {}
+        self.health: Dict[str, Any] = {}
+        self.ready: Dict[str, Any] = {"ready": False, "conditions": {}}
+        self.via = "http"
+        self.pushes = 0
+        self.spans_ingested = 0
+        self.first_mono = time.monotonic()
+        self.last_mono = self.first_mono
+
+
+class FleetAggregator:
+    """Holds the fleet state and renders the merged views.
+
+    ``ttl_s``/``expire_after_s`` override the per-instance defaults
+    (``TTL_FACTOR`` / ``EXPIRE_FACTOR`` × the instance's advertised
+    push interval). Expiry runs lazily on every ingest and read — no
+    thread of its own; the health watchdog (when enabled) additionally
+    drives the ``stalled`` verdict between reads.
+    """
+
+    def __init__(self, ttl_s: Optional[float] = None,
+                 expire_after_s: Optional[float] = None,
+                 span_store: Optional[_tracing.SpanStore] = None,
+                 instance: Optional[str] = None, role: str = "aggregator"):
+        self.ttl_s = ttl_s
+        self.expire_after_s = expire_after_s
+        self.instance = instance or default_instance()
+        self.role = role
+        self._store = span_store if span_store is not None \
+            else _tracing.store()
+        self._lock = threading.Lock()
+        self._instances: "OrderedDict[str, _Instance]" = OrderedDict()
+        #: (instance, family) pairs already journaled as conflicts —
+        #: one event per drift, not one per scrape
+        self._conflicts: set = set()
+        self.pushes_ingested = 0
+        self.bad_pushes = 0
+
+    # -- staleness ------------------------------------------------------- #
+    def _ttl(self, rec: _Instance) -> float:
+        if self.ttl_s is not None:
+            return float(self.ttl_s)
+        return max(TTL_FACTOR * rec.interval_s, 0.5)
+
+    def _expire_after(self, rec: _Instance) -> float:
+        if self.expire_after_s is not None:
+            return float(self.expire_after_s)
+        return max(EXPIRE_FACTOR * rec.interval_s, 2.0)
+
+    def _expire_now(self) -> None:
+        now = time.monotonic()
+        dead: List[_Instance] = []
+        with self._lock:
+            for iid in list(self._instances):
+                rec = self._instances[iid]
+                if now - rec.last_mono > self._expire_after(rec):
+                    dead.append(self._instances.pop(iid))
+        for rec in dead:
+            _events.record(
+                "fleet.expire",
+                f"instance {rec.instance} expired after "
+                f"{now - rec.last_mono:.1f}s without a push",
+                severity="warning", instance=rec.instance, role=rec.role)
+
+    # -- ingestion ------------------------------------------------------- #
+    def ingest(self, doc: Any, via: str = "http") -> None:
+        """Validate and store one push document; raises ValueError on a
+        malformed document (the HTTP route maps that to 400)."""
+        if not isinstance(doc, dict):
+            self.bad_pushes += 1
+            raise ValueError("push document must be a JSON object")
+        iid = doc.get("instance")
+        if not isinstance(iid, str) or not iid:
+            self.bad_pushes += 1
+            raise ValueError("push document missing 'instance'")
+        v = doc.get("v", 0)
+        if not isinstance(v, int) or v > PUSH_VERSION:
+            self.bad_pushes += 1
+            raise ValueError(
+                f"unsupported push version {v!r} (this aggregator "
+                f"speaks v<={PUSH_VERSION})")
+        spans = doc.get("spans") or []
+        new = False
+        with self._lock:
+            rec = self._instances.get(iid)
+            if rec is None:
+                rec = _Instance(iid)
+                self._instances[iid] = rec
+                new = True
+            rec.role = str(doc.get("role") or rec.role)
+            rec.seq = int(doc.get("seq") or 0)
+            rec.ts = float(doc.get("ts") or 0.0)
+            rec.interval_s = max(
+                float(doc.get("interval_s") or DEFAULT_INTERVAL_S), 0.05)
+            metrics = doc.get("metrics")
+            if isinstance(metrics, dict):
+                rec.metrics = metrics
+            health = doc.get("health")
+            if isinstance(health, dict):
+                rec.health = health
+            ready = doc.get("ready")
+            if isinstance(ready, dict):
+                rec.ready = ready
+            rec.via = via
+            rec.pushes += 1
+            rec.last_mono = time.monotonic()
+            self.pushes_ingested += 1
+        if isinstance(spans, list) and spans:
+            rec.spans_ingested += self._store.ingest_remote(spans, iid)
+        if new:
+            self._register_health(iid)
+        _events.record(
+            "fleet.push",
+            f"push from {iid} (seq {rec.seq}, via {via}, "
+            f"{len(spans)} span(s))",
+            severity="debug", instance=iid, role=rec.role, seq=rec.seq,
+            via=via)
+        self._expire_now()
+
+    def _register_health(self, iid: str) -> None:
+        """One kind="fleet" component per instance: the watchdog's
+        missing-heartbeat rule reads the probe's push age; an expired
+        instance retires the component (probe → None). A no-op while
+        health is off."""
+        ref = weakref.ref(self)
+
+        def probe() -> Optional[Dict[str, Any]]:
+            agg = ref()
+            if agg is None:
+                return None
+            with agg._lock:
+                rec = agg._instances.get(iid)
+                if rec is None:
+                    return None
+                return {
+                    "push_age_s": time.monotonic() - rec.last_mono,
+                    "ttl_s": agg._ttl(rec),
+                    "pushes": rec.pushes,
+                    "role": rec.role,
+                }
+
+        _health.component(f"fleet:{iid}", kind="fleet", probe=probe,
+                          attrs={"instance": iid})
+
+    # -- merged exposition ------------------------------------------------ #
+    def exposition(self, local_registry: Optional[_metrics.MetricsRegistry]
+                   = None) -> str:
+        """Prometheus text for the whole fleet: the local registry's
+        series plus every live instance's pushed snapshot, each series
+        tagged with ``instance``/``role``. HELP/TYPE exactly once per
+        family; a family whose type conflicts with the first-seen
+        schema is skipped per offending instance (``fleet.merge_
+        conflict`` journaled once)."""
+        self._expire_now()
+        reg = local_registry if local_registry is not None \
+            else _metrics.registry()
+        sources: List[Tuple[str, str, Dict[str, Any]]] = [
+            (self.instance, self.role, reg.snapshot())]
+        with self._lock:
+            for rec in self._instances.values():
+                sources.append((rec.instance, rec.role, rec.metrics))
+        conflicts: List[Tuple[str, str, str, str]] = []
+        fams: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        for iid, role, snap in sources:
+            for name in sorted(snap):
+                fam = snap[name]
+                ftype = fam.get("type", "")
+                cur = fams.get(name)
+                if cur is None:
+                    cur = {"type": ftype, "help": fam.get("help", ""),
+                           "rows": []}
+                    fams[name] = cur
+                elif cur["type"] != ftype:
+                    key = (iid, name)
+                    if key not in self._conflicts:
+                        self._conflicts.add(key)
+                        conflicts.append((iid, name, ftype, cur["type"]))
+                    continue
+                for series in fam.get("series", []):
+                    labels = dict(series.get("labels") or {})
+                    labels["instance"] = iid
+                    labels["role"] = role
+                    cur["rows"].append((labels, series))
+        for iid, name, ftype, want in conflicts:
+            _events.record(
+                "fleet.merge_conflict",
+                f"{iid}: family {name} pushed as {ftype!r}, fleet has "
+                f"{want!r} — skipped", severity="warning", instance=iid,
+                family=name)
+        lines: List[str] = []
+        for name in sorted(fams):
+            fam = fams[name]
+            if not fam["rows"]:
+                continue
+            if fam["help"]:
+                lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+            lines.append(f"# TYPE {name} {fam['type']}")
+            for labels, series in fam["rows"]:
+                base = ",".join(
+                    f'{k}="{_escape_label(str(v))}"'
+                    for k, v in labels.items())
+                if fam["type"] == "histogram":
+                    # snapshot buckets are already cumulative
+                    buckets = series.get("buckets") or {}
+                    for bound in sorted(buckets, key=float):
+                        le = f'le="{_fmt(float(bound))}"'
+                        lines.append(
+                            f"{name}_bucket{{{base},{le}}} "
+                            f"{buckets[bound]}")
+                    count = series.get("count", 0)
+                    lines.append(
+                        f'{name}_bucket{{{base},le="+Inf"}} {count}')
+                    lines.append(f"{name}_sum{{{base}}} "
+                                 f"{_fmt(float(series.get('sum', 0.0)))}")
+                    lines.append(f"{name}_count{{{base}}} {count}")
+                else:
+                    lines.append(
+                        f"{name}{{{base}}} "
+                        f"{_fmt(float(series.get('value', 0.0)))}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+    # -- health / readiness rollup ---------------------------------------- #
+    def health_rollup(self, local: Dict[str, Any]) -> Dict[str, Any]:
+        """Worst-of-fleet /healthz body: the local snapshot's components
+        plus one ``fleet:<instance>`` entry per live instance carrying
+        its pushed status (stale push ⇒ ``stalled`` regardless of what
+        it last claimed)."""
+        self._expire_now()
+        now = time.monotonic()
+        worst = _health.status_from_string(local.get("status", "ok"))
+        components = list(local.get("components", []))
+        with self._lock:
+            recs = list(self._instances.values())
+        for rec in recs:
+            age = now - rec.last_mono
+            stale = age > self._ttl(rec)
+            st = "stalled" if stale \
+                else str(rec.health.get("status", "ok"))
+            s = _health.status_from_string(st)
+            if s > worst:
+                worst = s
+            components.append({
+                "name": f"fleet:{rec.instance}",
+                "kind": "fleet",
+                "status": st,
+                "detail": (f"no push for {age:.1f}s" if stale else
+                           f"last push {age:.1f}s ago (seq {rec.seq})"),
+                "role": rec.role,
+                "push_age_s": age,
+                "via": rec.via,
+                "components": len(rec.health.get("components", [])),
+            })
+        return {
+            "status": _health.status_string(worst),
+            "ok": worst <= _health.Status.DEGRADED,
+            "components": components,
+            "fleet": {"instances": len(recs)},
+        }
+
+    def ready_rollup(self, local_ready: bool,
+                     local_conds: Dict[str, bool]
+                     ) -> Tuple[bool, Dict[str, bool]]:
+        """Fleet /readyz: local readiness AND every live instance both
+        fresh and self-reporting ready."""
+        self._expire_now()
+        now = time.monotonic()
+        conds = dict(local_conds)
+        with self._lock:
+            recs = list(self._instances.values())
+        for rec in recs:
+            fresh = (now - rec.last_mono) <= self._ttl(rec)
+            conds[f"fleet:{rec.instance}"] = \
+                fresh and bool(rec.ready.get("ready"))
+        return local_ready and all(conds.values()), conds
+
+    # -- /debug/fleet ------------------------------------------------------ #
+    def snapshot(self) -> Dict[str, Any]:
+        self._expire_now()
+        now = time.monotonic()
+        with self._lock:
+            recs = list(self._instances.values())
+        instances = []
+        for rec in recs:
+            age = now - rec.last_mono
+            instances.append({
+                "instance": rec.instance,
+                "role": rec.role,
+                "seq": rec.seq,
+                "via": rec.via,
+                "pushes": rec.pushes,
+                "push_age_s": age,
+                "ttl_s": self._ttl(rec),
+                "stale": age > self._ttl(rec),
+                "interval_s": rec.interval_s,
+                "families": len(rec.metrics),
+                "spans_ingested": rec.spans_ingested,
+                "health_status": rec.health.get("status"),
+                "ready": bool(rec.ready.get("ready")),
+            })
+        return {
+            "aggregator": {"instance": self.instance, "role": self.role},
+            "pushes_ingested": self.pushes_ingested,
+            "bad_pushes": self.bad_pushes,
+            "instances": instances,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._instances.clear()
+
+
+# --------------------------------------------------------------------------- #
+# Module-global pusher + aggregator
+# --------------------------------------------------------------------------- #
+
+_PUSHER: Optional[FleetPusher] = None
+_AGGREGATOR: Optional[FleetAggregator] = None
+
+
+def pusher() -> Optional[FleetPusher]:
+    return _PUSHER
+
+
+def push_enabled() -> bool:
+    return _PUSHER is not None
+
+
+def enable_push(url: Optional[str] = None,
+                interval_s: float = DEFAULT_INTERVAL_S,
+                role: str = "worker",
+                instance: Optional[str] = None) -> FleetPusher:
+    """Start the process-global fleet pusher. ``url=None`` is wire-only
+    (pushes piggyback on query-client traffic; no thread). Replaces a
+    previous pusher. Also enables metric collection — pushing a
+    disabled registry's empty snapshot would be all gaps."""
+    global _PUSHER
+    if _PUSHER is not None:
+        _PUSHER.close()
+    _metrics.enable()
+    _PUSHER = FleetPusher(url=url, interval_s=interval_s, role=role,
+                          instance=instance)
+    return _PUSHER
+
+
+def disable_push() -> None:
+    global _PUSHER
+    if _PUSHER is not None:
+        _PUSHER.close()
+        _PUSHER = None
+
+
+def wire_frame_due() -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """THE query-client fast path: one module-global read when fleet
+    push is off — no frame, no bytes, no allocation."""
+    p = _PUSHER
+    return p.wire_frame() if p is not None else None
+
+
+def aggregator() -> Optional[FleetAggregator]:
+    return _AGGREGATOR
+
+
+def enable_aggregator(ttl_s: Optional[float] = None,
+                      expire_after_s: Optional[float] = None
+                      ) -> FleetAggregator:
+    """Turn this process into the fleet aggregator: the exporter's
+    ``/metrics``, ``/healthz``, ``/readyz`` switch to the merged fleet
+    views, ``POST /fleet/push`` and ``GET /debug/fleet`` activate, and
+    ``OBS_PUSH`` frames arriving on any serversrc are ingested."""
+    global _AGGREGATOR
+    if _AGGREGATOR is None:
+        _AGGREGATOR = FleetAggregator(ttl_s=ttl_s,
+                                      expire_after_s=expire_after_s)
+    else:
+        if ttl_s is not None:
+            _AGGREGATOR.ttl_s = ttl_s
+        if expire_after_s is not None:
+            _AGGREGATOR.expire_after_s = expire_after_s
+    return _AGGREGATOR
+
+
+def disable_aggregator() -> None:
+    global _AGGREGATOR
+    if _AGGREGATOR is not None:
+        _AGGREGATOR.close()
+        _AGGREGATOR = None
+
+
+def ingest_wire(meta: Dict[str, Any], payload: bytes) -> None:
+    """Server-side ``OBS_PUSH`` handler: decode and ingest when this
+    process aggregates, count-and-drop otherwise. Never raises into
+    the connection loop — a worker's bad push must not kill the
+    client's data stream."""
+    agg = _AGGREGATOR
+    if agg is None:
+        return
+    try:
+        agg.ingest(json.loads(payload or b"{}"), via="wire")
+    except ValueError as e:
+        _events.record("fleet.bad_push",
+                       f"undecodable wire push from "
+                       f"{meta.get('instance', '?')}: {e}",
+                       severity="warning",
+                       instance=str(meta.get("instance", "?")))

@@ -53,6 +53,12 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\"", "\\\"").replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    """HELP-line escaping (text format 0.0.4): backslash and newline
+    only — quotes are legal in help text."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt(v: float) -> str:
     if v == float("inf"):
         return "+Inf"
@@ -322,7 +328,7 @@ class MetricsRegistry:
             if not samples:
                 continue
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+                lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {fam.name} {fam.type}")
             for vals, child in samples:
                 base = ",".join(

@@ -41,6 +41,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
+from collections import deque as _deque
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
@@ -233,6 +234,18 @@ class SpanStore:
         self._sample_n = 0
         self._enabled = bool(enabled)
         self._dropped_spans = 0
+        # -- fleet span export (obs/fleet.py) --------------------------- #
+        # Off until a fleet pusher flips it on: zero cost for plain
+        # single-process tracing (one attribute read in _record against
+        # an empty set). Traces are *marked* exportable when their id
+        # crosses the query wire (or an engine opts a request in);
+        # spans of marked traces queue — bounded, drop-oldest — for the
+        # pusher to drain into the aggregator.
+        self._export_on = False
+        self._export_tids: "OrderedDict[str, None]" = OrderedDict()
+        self._export_max_tids = 4096
+        self._export_pending: "deque" = _deque(maxlen=2048)
+        self._export_dropped = 0
 
     # -- enable/disable ------------------------------------------------ #
     @property
@@ -251,6 +264,9 @@ class SpanStore:
             self._slow.clear()
             self._sample_n = 0
             self._dropped_spans = 0
+            self._export_tids.clear()
+            self._export_pending.clear()
+            self._export_dropped = 0
 
     # -- recording ----------------------------------------------------- #
     def start_span(self, name: str,
@@ -289,6 +305,11 @@ class SpanStore:
                 self._dropped_spans += 1
             else:
                 tr.spans.append(span)
+                if self._export_on and tid in self._export_tids:
+                    if len(self._export_pending) == \
+                            self._export_pending.maxlen:
+                        self._export_dropped += 1
+                    self._export_pending.append(_span_to_wire(span))
             if tr.start_ns is None or span.start_ns < tr.start_ns:
                 tr.start_ns = span.start_ns
                 tr.wall = span.wall
@@ -408,6 +429,104 @@ class SpanStore:
             el: {"n": len(v), "mean_us": sum(v) / len(v), "max_us": max(v)}
             for el, v in agg.items()
         }
+
+    # -- fleet span export/ingest (obs/fleet.py) ------------------------ #
+    def set_export(self, on: bool) -> None:
+        """Flip fleet span export. Off (the default) keeps _record's
+        extra cost at one attribute read; turning off also drops any
+        queued exports and marks."""
+        with self._lock:
+            self._export_on = bool(on)
+            if not on:
+                self._export_tids.clear()
+                self._export_pending.clear()
+
+    def mark_export(self, trace_id: Optional[str]) -> None:
+        """Mark one trace's spans for fleet export — called where a
+        trace id crosses the query wire (send injection / remote-parent
+        adoption) and by a serving engine opting a request in. LRU-
+        bounded; a no-op unless a fleet pusher enabled export."""
+        if not self._export_on or not trace_id:
+            return
+        with self._lock:
+            self._export_tids[trace_id] = None
+            self._export_tids.move_to_end(trace_id)
+            while len(self._export_tids) > self._export_max_tids:
+                self._export_tids.popitem(last=False)
+
+    def drain_export(self, max_n: int = 512) -> List[Dict[str, Any]]:
+        """Pop up to ``max_n`` queued wire-format span dicts (oldest
+        first) — the fleet pusher's per-push batch."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            while self._export_pending and len(out) < int(max_n):
+                out.append(self._export_pending.popleft())
+        return out
+
+    def ingest_remote(self, spans: List[Dict[str, Any]],
+                      instance: str) -> int:
+        """Insert pushed wire-format spans from ``instance`` into this
+        store so /debug/traces/<id> renders the cross-host tree.
+        Remote timestamps are wall-clock-derived (monotonic clocks do
+        not travel between hosts); malformed entries are skipped, never
+        raised — a peer must not 500 the aggregator. Returns the count
+        actually ingested. Works on a disabled store: the aggregator
+        exposes fleet traces without recording its own."""
+        n = 0
+        for d in spans:
+            try:
+                ctx = SpanContext(str(d["tid"]), str(d["sid"]),
+                                  d.get("par") or None)
+                span = Span.__new__(Span)
+                span._store = self
+                span.name = str(d["name"])
+                span.context = ctx
+                span.attrs = dict(d.get("attrs") or {})
+                span.attrs.setdefault("instance", instance)
+                span.wall = float(d["wall"])
+                span.start_ns = int(span.wall * 1e9)
+                span.end_ns = span.start_ns + max(int(d["dur_ns"]), 0)
+                span._token = None
+            except (KeyError, TypeError, ValueError):
+                continue
+            # bypass Span.end(): end_ns is already set, record directly
+            tid = span.context.trace_id
+            with self._lock:
+                tr = self._traces.get(tid)
+                if tr is None:
+                    tr = _Trace()
+                    self._traces[tid] = tr
+                if len(tr.spans) >= self.max_spans_per_trace:
+                    self._dropped_spans += 1
+                else:
+                    tr.spans.append(span)
+                if tr.start_ns is None or span.start_ns < tr.start_ns:
+                    tr.start_ns = span.start_ns
+                    tr.wall = span.wall
+                if tr.end_ns is None or span.end_ns > tr.end_ns:
+                    tr.end_ns = span.end_ns
+                if span.context.parent_id is None:
+                    tr.completed = True
+                    tr.root_name = span.name
+                    tr.duration_ns = span.end_ns - span.start_ns
+                    self._rank_slow(tid, tr.duration_ns)
+                self._evict_locked()
+            n += 1
+        return n
+
+
+def _span_to_wire(span: Span) -> Dict[str, Any]:
+    """Wire-format dict for one completed span: wall-clock start +
+    duration (monotonic ns never leave the host), ids, name, attrs."""
+    return {
+        "tid": span.context.trace_id,
+        "sid": span.context.span_id,
+        "par": span.context.parent_id,
+        "name": span.name,
+        "wall": span.wall,
+        "dur_ns": (span.end_ns or span.start_ns) - span.start_ns,
+        "attrs": span.attrs,
+    }
 
 
 # --------------------------------------------------------------------------- #

@@ -34,6 +34,7 @@ from ..core.log import logger
 from ..core.types import Caps, TensorFormat
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..obs import events as _events
+from ..obs import fleet as _fleet
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
@@ -41,6 +42,7 @@ from .protocol import (
     Cmd,
     QueryProtocolError,
     buffer_to_payload,
+    pack_message,
     payload_to_buffer,
     recv_message,
     send_message,
@@ -310,6 +312,17 @@ class TensorQueryClient(Element):
                 self._cv.wait(0.1)
             return self._pong
 
+    def _maybe_push_obs(self, sock: socket.socket) -> None:
+        """Piggyback one fleet ``OBS_PUSH`` frame ahead of a DATA send
+        when the push interval has elapsed (obs/fleet.py). Fleet off →
+        one module-global None check, zero wire bytes. Sent raw (no
+        tracing wrap, no reply expected) on the caller's socket and
+        thread, so it can never interleave with a request frame."""
+        frame = _fleet.wire_frame_due()
+        if frame is not None:
+            pmeta, ppayload = frame
+            sock.sendall(pack_message(Cmd.OBS_PUSH, pmeta, ppayload))
+
     def _chain_pipelined(self, buf: Buffer, depth: int) -> FlowReturn:
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
         # per-request span: submit → result popped by the reader (ended
@@ -372,6 +385,7 @@ class TensorQueryClient(Element):
                          buf.meta.get(_tracing.ROOT_META_KEY)]
                 self._pending.append(entry)
             try:
+                self._maybe_push_obs(sock)
                 if rspan.recording:
                     # current-context window around the send so the wire
                     # meta carries this request's context to the server
@@ -441,6 +455,7 @@ class TensorQueryClient(Element):
             for attempt in range(max(int(self.max_request_retry), 1)):
                 try:
                     sock = self._ensure_conn()
+                    self._maybe_push_obs(sock)
                     t_send = time.monotonic()
                     send_message(sock, Cmd.DATA, meta, payload)
                     cmd, rmeta, rpayload = recv_message(sock)

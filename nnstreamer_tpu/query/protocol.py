@@ -58,6 +58,10 @@ class Cmd(enum.IntEnum):
     CHUNK_START = 9
     CHUNK_DATA = 10
     CHUNK_END = 11
+    # fleet observability piggyback (obs/fleet.py): a client ships its
+    # metric/health/span snapshot ahead of a DATA frame; fire-and-forget
+    # (no reply frame — the data stream must not stall on telemetry)
+    OBS_PUSH = 12
 
 
 class QueryProtocolError(RuntimeError):
@@ -151,6 +155,7 @@ def recv_message(sock: socket.socket,
     if _tracing.enabled():
         rctx = _tracing.ctx_from_wire(meta.get(_tracing.TRACE_META_KEY))
         if rctx is not None:
+            _tracing.store().mark_export(rctx.trace_id)
             rspan = _tracing.start_span(
                 "query.recv", parent=rctx,
                 attrs={"cmd": Cmd(inner).name, "bytes": total})
@@ -212,6 +217,9 @@ def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
         if ctx is not None and _tracing.TRACE_META_KEY not in meta:
             meta = dict(meta)
             meta[_tracing.TRACE_META_KEY] = ctx.to_wire()
+            # the trace id now exists on two hosts: mark it so fleet
+            # push (when on) exports this side's completed spans
+            _tracing.store().mark_export(ctx.trace_id)
             span = _tracing.start_span(
                 "query.send", parent=ctx,
                 attrs={"cmd": cmd.name, "bytes": len(payload)})

@@ -28,6 +28,7 @@ from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
 from ..graph.pipeline import SourceElement
 from ..obs import events as _events
+from ..obs import fleet as _fleet
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
@@ -182,6 +183,9 @@ class TensorQueryServerSrc(SourceElement):
                         rctx = _tracing.ctx_from_wire(
                             meta.get(_tracing.TRACE_META_KEY))
                         if rctx is not None:
+                            # wire-crossing trace: mark it so fleet push
+                            # exports this half of the tree
+                            _tracing.store().mark_export(rctx.trace_id)
                             span = _tracing.start_span(
                                 "query.server_handle", parent=rctx,
                                 attrs={"client": cid, "element": self.name})
@@ -189,6 +193,10 @@ class TensorQueryServerSrc(SourceElement):
                                 buf.meta[_tracing.CTX_META_KEY] = span.context
                                 buf.meta[_tracing.ROOT_META_KEY] = span
                     self._inbox.put(buf)
+                elif cmd is Cmd.OBS_PUSH:
+                    # fleet telemetry piggyback: ingest when this process
+                    # aggregates, drop otherwise; never a reply frame
+                    _fleet.ingest_wire(meta, payload)
                 else:
                     send_message(conn, Cmd.ERROR,
                                  {"error": f"unexpected cmd {cmd}"})

@@ -386,6 +386,12 @@ class LMEngine:
                 "serving.request", parent=_tracing.current_context(),
                 attrs={"engine": self._engine_label, "rid": rid,
                        "prompt_len": int(p.size), "max_new": int(max_new)})
+            if req.span.recording and req.span.context.parent_id is not None:
+                # remote-parented request (came in over the query wire):
+                # mark the trace so fleet push exports the engine-side
+                # spans — admission/prefill/decode join the client's
+                # tree on the aggregator
+                _tracing.store().mark_export(req.span.context.trace_id)
             req.wait_span = _tracing.start_span(
                 "serving.admission_wait", parent=req.span.context,
                 attrs={"queued_behind": len(self._queue)})

@@ -41,7 +41,9 @@ for the health model (obs/health.py): `_init_health` registers a
 ``serving.engine:tp`` component (admission-stall watchdog input) and a
 "first bucket compiled" readiness condition under ``engine:tp``, so
 /healthz and /readyz cover the sharded engine with zero TP-specific
-code.
+code — and for fleet federation (obs/fleet.py): a TP worker's pushes
+carry the same engine="tp" series and remote-parented spans as any
+other instance, so the aggregator needs no sharding awareness either.
 """
 
 from __future__ import annotations

@@ -8,7 +8,11 @@ Metric convention (docs/observability.md): every metric is
   * layer  in {pipeline, query, serving},
   * counters    ending in ``_total``,
   * histograms  ending in ``_seconds``,
-  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes``.
+  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes``,
+  * label keys matching ``[a-z_][a-z0-9_]*``, never the reserved
+    ``instance``/``role`` (appended by fleet federation) or ``le``
+    (histogram encoder), and at most 8 keys per family (cardinality
+    guard).
 
 Span convention (docs/observability.md "Tracing"): every span name is
 a literal lowercase dotted ``<layer>.<operation>`` with layer in
@@ -34,6 +38,7 @@ Exit 0 when clean; exit 1 listing every violation.
 
 from __future__ import annotations
 
+import ast
 import re
 import sys
 from pathlib import Path
@@ -49,9 +54,21 @@ UNIT_BY_TYPE = {
 }
 #: span layers add "device" — device.xprof has no metric series
 SPAN_LAYERS = ("pipeline", "query", "serving", "device")
-#: event layers additionally allow "core" (the core/log.py bridge) and
-#: "obs" (the obs subsystem's own events)
-EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs")
+#: event layers additionally allow "core" (the core/log.py bridge),
+#: "obs" (the obs subsystem's own events) and "fleet" (cross-process
+#: federation: push/expiry/merge-conflict audit trail, obs/fleet.py)
+EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
+                "fleet")
+
+#: label names must be legal Prometheus label identifiers
+LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+#: labels the fleet layer/format owns: ``instance``/``role`` are
+#: appended by the aggregator to every federated series, ``le`` by the
+#: histogram encoder — a user metric declaring them would collide
+RESERVED_LABELS = frozenset({"instance", "role", "le"})
+#: cardinality guard: a family declaring more label keys than this is
+#: a combinatorial-explosion bug, not a schema
+MAX_LABEL_KEYS = 8
 
 #: reg.counter("name"... — dotted call so plain functions named e.g.
 #: ``gauge()`` elsewhere don't false-positive
@@ -90,6 +107,64 @@ def iter_registrations(root: Path = SOURCE_ROOT):
         for m in _CALL_RE.finditer(text):
             lineno = text.count("\n", 0, m.start()) + 1
             yield path, lineno, m.group(1), m.group(2)
+
+
+def iter_label_decls(root: Path = SOURCE_ROOT):
+    """Yield (path, lineno, name, labelnames) for every registry call
+    whose label tuple/list is written as literals. AST-based (unlike
+    the name greps) because label tuples routinely share lines with
+    help strings containing parens; only literal elements are visible —
+    keep label schemas literal, same rule as names."""
+    for path in sorted(root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in UNIT_BY_TYPE):
+                continue
+            name = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            if name is None:
+                continue
+            labels_node = node.args[2] if len(node.args) > 2 else None
+            if labels_node is None:
+                for kw in node.keywords:
+                    if kw.arg == "labelnames":
+                        labels_node = kw.value
+            if not isinstance(labels_node, (ast.Tuple, ast.List)):
+                continue
+            labels = [e.value for e in labels_node.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+            yield path, node.lineno, name, labels
+
+
+def check_labels(root: Path = SOURCE_ROOT):
+    """Label-name violations: illegal identifiers, reserved names, and
+    families declaring more than MAX_LABEL_KEYS keys."""
+    problems = []
+    for path, lineno, name, labels in iter_label_decls(root):
+        where = _where(path, lineno)
+        for lbl in labels:
+            if not LABEL_NAME_RE.match(lbl):
+                problems.append(
+                    f"{where}: {name!r} label {lbl!r} does not match "
+                    f"{LABEL_NAME_RE.pattern}")
+            elif lbl in RESERVED_LABELS:
+                problems.append(
+                    f"{where}: {name!r} label {lbl!r} is reserved "
+                    f"(fleet federation appends instance/role; the "
+                    f"histogram encoder owns le)")
+        if len(labels) > MAX_LABEL_KEYS:
+            problems.append(
+                f"{where}: {name!r} declares {len(labels)} label keys "
+                f"(> {MAX_LABEL_KEYS}) — cardinality guard")
+    return problems
 
 
 def iter_span_sites(root: Path = SOURCE_ROOT):
@@ -143,6 +218,7 @@ def check(root: Path = SOURCE_ROOT):
         problems.append(
             f"no metric registrations found under {root} — "
             "lint regex out of sync with the registry API?")
+    problems += check_labels(root)
     problems += check_spans(root)
     problems += check_events(root)
     return problems
@@ -208,9 +284,11 @@ def main() -> int:
         print(f"{len(problems)} naming violation(s)", file=sys.stderr)
         return 1
     n = sum(1 for _ in iter_registrations())
+    nl = sum(len(labels) for *_x, labels in iter_label_decls())
     ns = sum(1 for _ in iter_span_sites())
     ne = sum(1 for _ in iter_event_sites())
     print(f"metric names OK ({n} registrations checked); "
+          f"labels OK ({nl} label keys checked); "
           f"span names OK ({ns} call sites checked); "
           f"event names OK ({ne} call sites checked)")
     return 0

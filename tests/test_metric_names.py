@@ -17,6 +17,7 @@ def test_lint_passes_on_tree():
         cwd=REPO_ROOT, timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "metric names OK" in proc.stdout
+    assert "labels OK" in proc.stdout
     assert "span names OK" in proc.stdout
     assert "event names OK" in proc.stdout
 
@@ -42,6 +43,46 @@ def test_lint_catches_violations(tmp_path):
     empty = tmp_path / "none"
     empty.mkdir()
     assert any("no metric registrations" in p for p in lint.check(empty))
+
+
+def test_lint_catches_label_violations(tmp_path):
+    """Label-name lint: illegal identifiers, reserved fleet/encoder
+    names, and the >8-key cardinality guard."""
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    many = ", ".join(f'"k{i}"' for i in range(9))
+    bad = tmp_path / "bad_labels.py"
+    bad.write_text(
+        'reg.counter("nnstpu_query_a_total", "h", ("element",))\n'  # fine
+        'reg.counter("nnstpu_query_b_total", "h", ("Element",))\n'  # case
+        'reg.counter("nnstpu_query_c_total", "h", ("instance",))\n' # reserved
+        'reg.histogram("nnstpu_query_d_seconds", "h", ("le",))\n'   # reserved
+        'reg.gauge("nnstpu_query_e_depth", "h",\n'
+        f'          labelnames=[{many}])\n')                         # >8 keys
+    problems = lint.check_labels(tmp_path)
+    assert len(problems) == 4, problems
+    assert any("'Element'" in p for p in problems)
+    assert any("'instance'" in p and "reserved" in p for p in problems)
+    assert any("'le'" in p and "reserved" in p for p in problems)
+    assert any("cardinality guard" in p for p in problems)
+    # the real tree's label schemas must stay clean
+    assert lint.check_labels() == []
+
+
+def test_fleet_event_layer_allowed(tmp_path):
+    sys.path.insert(0, str(REPO_ROOT / "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    ok = tmp_path / "fleet_events.py"
+    ok.write_text('_events.record("fleet.push", "m")\n'
+                  '_events.record("fleet.expire", "m")\n'
+                  '_events.record("fleet.merge_conflict", "m")\n')
+    assert lint.check_events(tmp_path) == []
 
 
 def test_lint_catches_span_violations(tmp_path):

@@ -9,7 +9,7 @@ import urllib.request
 import pytest
 
 from nnstreamer_tpu.obs import metrics as obs_metrics
-from nnstreamer_tpu.obs.exporter import start_exporter
+from nnstreamer_tpu.obs.exporter import MetricsExporter, start_exporter
 from nnstreamer_tpu.obs.metrics import MetricsRegistry
 
 
@@ -298,3 +298,43 @@ class TestExporter:
             assert obs_metrics.enabled()
         finally:
             exp.close()
+
+    def test_close_joins_thread_and_releases_port(self, global_metrics):
+        """Satellite: close() must join the serving thread and free the
+        socket promptly — a rebind of the same port right after close()
+        is the observable contract."""
+        exp = start_exporter(port=0, registry=MetricsRegistry())
+        port = exp.port
+        exp.close()
+        assert not exp._thread.is_alive()
+        exp2 = MetricsExporter(port=port, registry=MetricsRegistry())
+        try:
+            assert exp2.port == port
+        finally:
+            exp2.close()
+
+    def test_close_is_idempotent(self, global_metrics):
+        exp = start_exporter(port=0, registry=MetricsRegistry())
+        exp.close()
+        exp.close()  # second close must be a no-op, not an EBADF
+
+    def test_bind_conflict_names_port_and_flag(self, global_metrics):
+        """Satellite: EADDRINUSE surfaces as a clear error naming the
+        port and the --metrics-port flag, not a raw OSError."""
+        with start_exporter(port=0, registry=MetricsRegistry()) as exp:
+            with pytest.raises(RuntimeError, match="--metrics-port") as ei:
+                MetricsExporter(port=exp.port, registry=MetricsRegistry())
+            assert str(exp.port) in str(ei.value)
+
+    def test_help_text_escaping(self):
+        """Satellite: backslashes and newlines in help text must be
+        escaped on the HELP line (quotes are legal there)."""
+        reg = MetricsRegistry()
+        reg.counter("nnstpu_query_messages_total",
+                    'messages\nby "cmd" and \\ direction').inc()
+        text = reg.exposition()
+        assert ("# HELP nnstpu_query_messages_total "
+                'messages\\nby "cmd" and \\\\ direction') in text
+        # still one line per HELP entry: the raw newline never leaks
+        assert all(ln.startswith(("#", "nnstpu_"))
+                   for ln in text.strip().splitlines())
