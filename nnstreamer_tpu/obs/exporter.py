@@ -199,7 +199,7 @@ class MetricsExporter:
                     return
                 try:
                     agg.ingest(json.loads(body or b"{}"), via="http")
-                except ValueError as e:
+                except (TypeError, ValueError) as e:
                     self._json(400, {"error": str(e)})
                     return
                 self._json(200, {"ok": True})

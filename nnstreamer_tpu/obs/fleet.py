@@ -205,7 +205,8 @@ class FleetPusher:
         flood the event ring at push rate."""
         if self._host is None:
             return False
-        body = json.dumps(self._next_doc(), default=str).encode("utf-8")
+        doc = self._next_doc()
+        body = json.dumps(doc, default=str).encode("utf-8")
         try:
             conn = http.client.HTTPConnection(
                 self._host, self._port, timeout=5.0)
@@ -219,6 +220,9 @@ class FleetPusher:
             finally:
                 conn.close()
         except (OSError, http.client.HTTPException) as e:
+            # the doc drained the span export queue — put the batch
+            # back so a briefly unreachable aggregator loses nothing
+            self._store.requeue_export(doc.get("spans") or [])
             self.push_errors += 1
             if not self._http_failing:
                 self._http_failing = True
@@ -242,11 +246,15 @@ class FleetPusher:
         """(meta, payload) for one ``OBS_PUSH`` frame when the wire
         interval has elapsed, else None. Called by the query client
         immediately before a DATA send — same thread, same socket, so
-        the push never races a request frame."""
+        the push never races a request frame. The interval gate is a
+        locked check-then-set: two query-client elements sharing the
+        process-global pusher must not both emit a frame in one
+        interval."""
         now = time.monotonic()
-        if now - self._last_wire < self.interval_s:
-            return None
-        self._last_wire = now
+        with self._seq_lock:
+            if now - self._last_wire < self.interval_s:
+                return None
+            self._last_wire = now
         doc = self._next_doc()
         meta = {"instance": doc["instance"], "role": doc["role"],
                 "seq": doc["seq"], "v": doc["v"]}
@@ -364,7 +372,26 @@ class FleetAggregator:
             raise ValueError(
                 f"unsupported push version {v!r} (this aggregator "
                 f"speaks v<={PUSH_VERSION})")
+        # Coerce every scalar into locals BEFORE touching the fleet
+        # table: a push that fails validation must leave no ghost
+        # half-mutated instance behind (one bad push would otherwise
+        # flip /readyz 503 fleet-wide until expiry), and non-scalar
+        # junk (e.g. "seq": [1]) must surface as the ValueError the
+        # HTTP route and wire handler are contracted to catch.
+        try:
+            role = str(doc.get("role")) if doc.get("role") else None
+            seq = int(doc.get("seq") or 0)
+            ts = float(doc.get("ts") or 0.0)
+            interval_s = max(
+                float(doc.get("interval_s") or DEFAULT_INTERVAL_S), 0.05)
+        except (TypeError, ValueError) as e:
+            self.bad_pushes += 1
+            raise ValueError(
+                f"malformed push field from {iid}: {e}") from e
         spans = doc.get("spans") or []
+        metrics = doc.get("metrics")
+        health = doc.get("health")
+        ready = doc.get("ready")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -372,18 +399,15 @@ class FleetAggregator:
                 rec = _Instance(iid)
                 self._instances[iid] = rec
                 new = True
-            rec.role = str(doc.get("role") or rec.role)
-            rec.seq = int(doc.get("seq") or 0)
-            rec.ts = float(doc.get("ts") or 0.0)
-            rec.interval_s = max(
-                float(doc.get("interval_s") or DEFAULT_INTERVAL_S), 0.05)
-            metrics = doc.get("metrics")
+            if role:
+                rec.role = role
+            rec.seq = seq
+            rec.ts = ts
+            rec.interval_s = interval_s
             if isinstance(metrics, dict):
                 rec.metrics = metrics
-            health = doc.get("health")
             if isinstance(health, dict):
                 rec.health = health
-            ready = doc.get("ready")
             if isinstance(ready, dict):
                 rec.ready = ready
             rec.via = via
@@ -391,7 +415,9 @@ class FleetAggregator:
             rec.last_mono = time.monotonic()
             self.pushes_ingested += 1
         if isinstance(spans, list) and spans:
-            rec.spans_ingested += self._store.ingest_remote(spans, iid)
+            ingested = self._store.ingest_remote(spans, iid)
+            with self._lock:
+                rec.spans_ingested += ingested
         if new:
             self._register_health(iid)
         _events.record(
@@ -457,8 +483,11 @@ class FleetAggregator:
                     fams[name] = cur
                 elif cur["type"] != ftype:
                     key = (iid, name)
-                    if key not in self._conflicts:
-                        self._conflicts.add(key)
+                    with self._lock:
+                        fresh = key not in self._conflicts
+                        if fresh:
+                            self._conflicts.add(key)
+                    if fresh:
                         conflicts.append((iid, name, ftype, cur["type"]))
                     continue
                 for series in fam.get("series", []):
@@ -509,11 +538,22 @@ class FleetAggregator:
         """Worst-of-fleet /healthz body: the local snapshot's components
         plus one ``fleet:<instance>`` entry per live instance carrying
         its pushed status (stale push ⇒ ``stalled`` regardless of what
-        it last claimed)."""
+        it last claimed). The kind="fleet" components _register_health
+        put in the *local* registry (for the watchdog's heartbeat rule)
+        are dropped here — this rollup is the authoritative per-instance
+        view, and keeping both would list every instance twice with
+        potentially conflicting statuses."""
         self._expire_now()
         now = time.monotonic()
-        worst = _health.status_from_string(local.get("status", "ok"))
-        components = list(local.get("components", []))
+        components = [c for c in local.get("components", [])
+                      if c.get("kind") != "fleet"]
+        # re-derive the local verdict from the surviving components so a
+        # watchdog-stalled fleet:<iid> duplicate can't leak its status in
+        worst = _health.Status.OK
+        for c in components:
+            s = _health.status_from_string(str(c.get("status", "ok")))
+            if s > worst:
+                worst = s
         with self._lock:
             recs = list(self._instances.values())
         for rec in recs:
@@ -681,7 +721,7 @@ def ingest_wire(meta: Dict[str, Any], payload: bytes) -> None:
         return
     try:
         agg.ingest(json.loads(payload or b"{}"), via="wire")
-    except ValueError as e:
+    except Exception as e:  # noqa: BLE001 — the contract in the docstring
         _events.record("fleet.bad_push",
                        f"undecodable wire push from "
                        f"{meta.get('instance', '?')}: {e}",

@@ -463,15 +463,41 @@ class SpanStore:
                 out.append(self._export_pending.popleft())
         return out
 
+    def requeue_export(self, spans: List[Dict[str, Any]]) -> None:
+        """Put a drained batch back at the FRONT of the export queue —
+        the pusher's failure path, so a briefly unreachable aggregator
+        doesn't silently lose the spans it drained. Overflow evicts the
+        newest queued entries (the requeued batch is older) and counts
+        them as export drops."""
+        if not spans:
+            return
+        with self._lock:
+            if not self._export_on:
+                return
+            free = self._export_pending.maxlen - len(self._export_pending)
+            overflow = len(spans) - free
+            if overflow > 0:
+                self._export_dropped += overflow
+            for s in reversed(spans):
+                self._export_pending.appendleft(s)
+
     def ingest_remote(self, spans: List[Dict[str, Any]],
                       instance: str) -> int:
         """Insert pushed wire-format spans from ``instance`` into this
         store so /debug/traces/<id> renders the cross-host tree.
-        Remote timestamps are wall-clock-derived (monotonic clocks do
-        not travel between hosts); malformed entries are skipped, never
-        raised — a peer must not 500 the aggregator. Returns the count
-        actually ingested. Works on a disabled store: the aggregator
-        exposes fleet traces without recording its own."""
+        Remote timestamps arrive wall-clock-derived (monotonic clocks
+        do not travel between hosts) and are rebased here into the
+        local monotonic domain — local spans carry ``monotonic_ns``
+        starts, and a trace holding both halves (aggregator tracing its
+        own side of the same request) must not mix clock domains in
+        tree() offsets or trace start/end rollups. Malformed entries
+        are skipped, never raised — a peer must not 500 the aggregator.
+        Returns the count actually ingested. Works on a disabled store:
+        the aggregator exposes fleet traces without recording its own."""
+        # one anchor per batch: local monotonic "now" minus wall "now";
+        # remote wall ns + offset lands in the local monotonic domain
+        # (to the accuracy of inter-host clock sync, the best we have)
+        offset_ns = time.monotonic_ns() - int(time.time() * 1e9)
         n = 0
         for d in spans:
             try:
@@ -484,7 +510,7 @@ class SpanStore:
                 span.attrs = dict(d.get("attrs") or {})
                 span.attrs.setdefault("instance", instance)
                 span.wall = float(d["wall"])
-                span.start_ns = int(span.wall * 1e9)
+                span.start_ns = int(span.wall * 1e9) + offset_ns
                 span.end_ns = span.start_ns + max(int(d["dur_ns"]), 0)
                 span._token = None
             except (KeyError, TypeError, ValueError):

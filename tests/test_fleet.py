@@ -288,6 +288,43 @@ class TestFederation:
             agg.ingest({"v": 99, "instance": "w"})
         assert agg.bad_pushes == 2
 
+    def test_non_scalar_fields_raise_valueerror_no_ghost(self):
+        """A push with non-scalar junk in a coerced field raises
+        ValueError (never TypeError) and leaves NO half-mutated
+        instance behind — a ghost would flip /readyz fleet-wide."""
+        agg = FleetAggregator(span_store=SpanStore())
+        for field, junk in (("seq", [1]), ("ts", {"t": 1}),
+                            ("interval_s", ["0.1"])):
+            doc = worker_push("w1:1")
+            doc[field] = junk
+            with pytest.raises(ValueError, match="malformed push field"):
+                agg.ingest(doc)
+        assert agg.bad_pushes == 3
+        assert agg.snapshot()["instances"] == []
+        assert agg.ready_rollup(True, {}) == (True, {})
+        # a later bad push must not corrupt an existing record either
+        agg.ingest(worker_push("w1:1", seq=3))
+        doc = worker_push("w1:1", seq=9)
+        doc["seq"] = [9]
+        with pytest.raises(ValueError):
+            agg.ingest(doc)
+        assert agg.snapshot()["instances"][0]["seq"] == 3
+
+    def test_ingest_wire_never_raises(self, fleet_off_after):
+        """The wire handler's contract: any junk — undecodable JSON or
+        a document whose fields are the wrong shape — is counted and
+        journaled, never raised into the server connection loop."""
+        obs_fleet.enable_aggregator(ttl_s=30.0)
+        obs_fleet.ingest_wire({"instance": "w"}, b"not json")
+        bad = worker_push("w1:1")
+        bad["seq"] = [1]
+        obs_fleet.ingest_wire({"instance": "w1:1"},
+                              json.dumps(bad).encode())
+        obs_fleet.ingest_wire({}, json.dumps(["not", "a", "dict"]).encode())
+        agg = obs_fleet.aggregator()
+        assert agg.snapshot()["instances"] == []
+        assert agg.bad_pushes >= 2
+
 
 # --------------------------------------------------------------------------- #
 # Expiry + health/readiness rollup
@@ -364,6 +401,28 @@ class TestFleetHealth:
         assert any(e["type"] == "fleet.recover"
                    for e in obs_events.ring().snapshot())
 
+    def test_rollup_components_not_duplicated(self, global_health,
+                                              fleet_off_after):
+        """/healthz lists each instance once: the rollup's authoritative
+        fleet:<iid> entry replaces the kind="fleet" watchdog component
+        _register_health put in the local registry — even when the two
+        would disagree (watchdog stalled vs rollup fresh)."""
+        obs_health.enable()
+        agg = obs_fleet.enable_aggregator(ttl_s=30.0)
+        agg.ingest(worker_push("w1:1"))
+        obs_health.check_now()
+        local = obs_health.snapshot()
+        # local registry does carry the watchdog component...
+        assert [c["name"] for c in local["components"]] == ["fleet:w1:1"]
+        # ...but force its status to disagree with the fresh rollup
+        local["components"][0]["status"] = "stalled"
+        snap = agg.health_rollup(local)
+        fleet_comps = [c for c in snap["components"]
+                       if c["name"] == "fleet:w1:1"]
+        assert len(fleet_comps) == 1
+        assert fleet_comps[0]["status"] == "ok"
+        assert snap["status"] == "ok" and snap["ok"]
+
     def test_push_events_carry_instance(self, events):
         agg = FleetAggregator(span_store=SpanStore())
         agg.ingest(worker_push("w1:1"), via="wire")
@@ -406,6 +465,35 @@ class TestRemoteSpans:
         assert [k["name"] for k in root["children"]] \
             == ["serving.request"]
 
+    def test_failed_push_requeues_drained_spans(self):
+        """push_now drains the export queue into the doc; a down
+        aggregator must not lose that batch — it goes back to the FRONT
+        so the next successful push carries it, oldest first."""
+        wstore, tid = self._worker_spans()
+        psh = FleetPusher(url="http://127.0.0.1:9", interval_s=3600,
+                          instance="w1:1", span_store=wstore)
+        try:
+            assert psh.push_now() is False  # port 9: nothing listens
+            requeued = wstore.drain_export()
+            assert [s["tid"] for s in requeued] == [tid, tid]
+            assert len(requeued) == 2
+        finally:
+            psh.close()
+
+    def test_requeue_preserves_order_ahead_of_new_spans(self):
+        store = SpanStore()
+        store.enable()
+        store.set_export(True)
+        with store.start_span("query.request") as root:
+            store.mark_export(root.context.trace_id)
+        batch = store.drain_export()
+        with store.start_span("serving.request",
+                              parent=root.context):
+            pass
+        store.requeue_export(batch)
+        names = [s["name"] for s in store.drain_export()]
+        assert names == ["query.request", "serving.request"]
+
     def test_unmarked_traces_not_exported(self):
         store = SpanStore()
         store.enable()
@@ -421,6 +509,26 @@ class TestRemoteSpans:
             store.mark_export(s.context.trace_id)  # no-op while off
         assert store.drain_export() == []
         assert store._export_on is False
+
+    def test_remote_spans_rebased_into_local_clock_domain(self):
+        """A trace holding both halves — the aggregator's own local
+        (monotonic) spans plus ingested remote (wall-derived) spans —
+        must render with one time base: offsets stay request-scale, not
+        epoch-scale (~1.7e18 ns) garbage."""
+        astore = SpanStore()
+        astore.enable()
+        with astore.start_span("query.server_handle") as local_span:
+            tid = local_span.context.trace_id
+        wire = [{"tid": tid, "sid": "remote01", "par": None,
+                 "name": "query.request", "wall": time.time() - 0.01,
+                 "dur_ns": int(20e6), "attrs": {}}]
+        assert astore.ingest_remote(wire, "w1:1") == 1
+        tree = astore.tree(tid)
+        offsets = [n["start_us"] for n in tree["tree"]]
+        # both roots within a minute of each other, not epoch-scale
+        assert all(abs(o) < 60e6 for o in offsets), offsets
+        tr = astore._traces[tid]
+        assert abs(tr.end_ns - tr.start_ns) < int(60e9)
 
     def test_malformed_remote_spans_skipped(self):
         store = SpanStore()
