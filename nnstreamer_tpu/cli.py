@@ -12,12 +12,17 @@ defaults, plus registered subplugin modes for filter/decoder/converter),
 exporter, span tracing, health watchdog, flight-recorder dump),
 --obs-push/--obs-aggregate (fleet federation: push this process's
 snapshots to an aggregator / serve the merged fleet — see
-docs/observability.md).
+docs/observability.md), --deadline-ms/--fallback (resilience: per-buffer
+deadlines + breaker-gated local degradation on every
+tensor_query_client — see docs/resilience.md). Setting the
+``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
+harness for the run (docs/resilience.md "Chaos harness").
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -59,6 +64,15 @@ def main(argv=None) -> int:
                          "(OBS_PUSH frames + POST /fleet/push) and serve "
                          "the merged fleet /metrics, /healthz, /readyz and "
                          "/debug/fleet; requires --metrics-port")
+    ap.add_argument("--deadline-ms", type=float, default=None, metavar="MS",
+                    help="stamp this per-buffer deadline budget on every "
+                         "tensor_query_client in the pipeline; expired "
+                         "buffers/requests are shed instead of processed "
+                         "(resilience.policy, docs/resilience.md)")
+    ap.add_argument("--fallback", metavar="SPEC", default=None,
+                    help="degraded-mode route for every tensor_query_client "
+                         "when its circuit breaker opens: 'passthrough' or "
+                         "a local element kind (e.g. tensor_filter)")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -90,6 +104,27 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — CLI reports, never tracebacks
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
+    if args.deadline_ms is not None or args.fallback is not None:
+        from .query.client import TensorQueryClient
+
+        clients = [el for el in p.elements.values()
+                   if isinstance(el, TensorQueryClient)]
+        if not clients:
+            ap.error("--deadline-ms/--fallback need a tensor_query_client "
+                     "in the pipeline")
+        for el in clients:
+            if args.deadline_ms is not None:
+                el.deadline_ms = float(args.deadline_ms)
+            if args.fallback is not None:
+                el.fallback = args.fallback
+    if os.environ.get("NNS_TPU_CHAOS"):
+        from .resilience import chaos
+
+        plan = chaos.plan_from_env()
+        if plan is not None:
+            chaos.install(plan)
+            print(f"chaos: fault plan installed (seed={plan.seed}, "
+                  f"{len(plan.faults)} faults)", file=sys.stderr)
     exporter = None
     if args.metrics_port is not None:
         # started (and collection enabled) BEFORE p.start(): the element

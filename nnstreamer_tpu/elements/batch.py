@@ -46,7 +46,8 @@ import numpy as np
 from ..core.buffer import Buffer, TensorMemory
 from ..core.log import logger
 from ..core.types import Caps, TensorInfo, TensorsConfig, TensorsInfo
-from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.element import (Element, FlowReturn, Pad, join_or_warn,
+                             register_element)
 from ..graph.events import Event, EventType
 
 log = logger("tensor_batch")
@@ -104,7 +105,7 @@ class TensorBatch(Element):
             self._cv.notify_all()
         w = self._worker
         if w is not None and w is not threading.current_thread():
-            w.join(timeout=5)
+            join_or_warn(w, self.name)
         self._worker = None
         self._dq.clear()
 

@@ -31,6 +31,32 @@ from .events import Bus, Event, EventType, Message, MessageType
 
 log = logger("element")
 
+def join_or_warn(t: threading.Thread, owner: str,
+                 timeout: float = 5.0) -> bool:
+    """Join a worker thread with a bounded wait; a timeout logs a
+    WARNING and records a ``pipeline.thread_leak`` event instead of
+    abandoning the thread invisibly (a leaked daemon worker keeps its
+    element state alive and can wake on a reused port/queue later).
+    Returns True when the thread actually exited."""
+    t.join(timeout=timeout)
+    if not t.is_alive():
+        return True
+    log.warning("%s: thread %r did not exit within %.1fs — leaked",
+                owner, t.name, timeout)
+    _events.record("pipeline.thread_leak",
+                   f"{owner}: thread {t.name!r} did not exit within "
+                   f"{timeout:.1f}s", severity="warning",
+                   element=owner, thread=t.name)
+    return False
+
+
+#: chaos injection point (resilience/chaos.py installs/clears this):
+#: called as ``hook(sink_element_name, buf) -> bool`` before the peer's
+#: chain; True drops the buffer (the graph's legal drop semantics —
+#: return OK without delivering), a raise rides the existing chain-error
+#: path onto the bus. Disabled cost: one global load + None check.
+CHAOS_CHAIN_HOOK = None
+
 
 class FlowReturn(enum.Enum):
     OK = "ok"
@@ -82,6 +108,9 @@ class Pad:
         if peer.eos:
             return FlowReturn.EOS
         try:
+            if CHAOS_CHAIN_HOOK is not None \
+                    and CHAOS_CHAIN_HOOK(peer.element.name, buf):
+                return FlowReturn.OK  # buffer dropped by the fault plan
             ret = peer.element._chain_entry(peer, buf)
             return ret if ret is not None else FlowReturn.OK
         except Exception as e:  # noqa: BLE001 — element errors become bus messages

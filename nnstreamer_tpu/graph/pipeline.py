@@ -26,7 +26,8 @@ from ..core.buffer import Buffer, now_ns
 from ..core.types import Caps
 from ..core.log import logger
 from ..obs import events as _events
-from .element import Element, FlowReturn, Pad, register_element, make_element
+from .element import (Element, FlowReturn, Pad, join_or_warn,
+                      register_element, make_element)
 from .events import Bus, Event, EventType, Message, MessageType
 
 log = logger("pipeline")
@@ -70,7 +71,7 @@ class SourceElement(Element):
         self._stop_flag.set()
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5)
+            join_or_warn(t, self.name)
         self._thread = None
 
     def _loop(self) -> None:
@@ -142,7 +143,7 @@ class Queue(Element):
             self._cv.notify_all()
         w = self._worker
         if w is not None and w is not threading.current_thread():
-            w.join(timeout=5)
+            join_or_warn(w, self.name)
         self._worker = None
         self._dq.clear()
 

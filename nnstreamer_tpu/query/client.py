@@ -6,7 +6,15 @@ broker-based discovery via tensor_query_hybrid when ``operation`` is set).
 
 Props: host/port (direct), or ``operation=<topic>`` + broker-host/port for
 hybrid discovery; ``sparse=true`` compresses request payloads;
-``max-request-retry`` bounds reconnect attempts.
+``max-request-retry`` is ONE shared retry budget per request (connect
+dials + resends draw from the same pool, with full-jitter exponential
+backoff between attempts — resilience/policy.py). A circuit breaker
+tracks the remote path; with ``fallback=`` set (``passthrough`` or a
+local element kind) an open breaker routes buffers to the local path
+and health reports DEGRADED instead of erroring the pipeline.
+``deadline-ms`` stamps a per-buffer deadline that is shed client-side
+when expired and travels on the wire as remaining budget;
+``drain-timeout-s`` bounds the EOS drain of pipelined results.
 
 ``async_depth=N`` (TPU-first addition, default 1 = reference-equivalent
 synchronous semantics): keep up to N requests in flight on the one TCP
@@ -32,12 +40,20 @@ from typing import Any, Optional
 from ..core.buffer import Buffer
 from ..core.log import logger
 from ..core.types import Caps, TensorFormat
-from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.element import (
+    Element,
+    FlowReturn,
+    Pad,
+    join_or_warn,
+    make_element,
+    register_element,
+)
 from ..obs import events as _events
 from ..obs import fleet as _fleet
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
+from ..resilience import policy as _rp
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -49,6 +65,23 @@ from .protocol import (
 )
 
 log = logger("query")
+
+
+class _FallbackTap(Element):
+    """Internal sink for a client's fallback element: whatever the
+    fallback produces is forwarded out of the hosting client's src pad,
+    so downstream sees one stream whether frames went remote or local.
+    Built only by TensorQueryClient — never registered."""
+
+    ELEMENT_NAME = "fallback_tap"
+
+    def __init__(self, owner: "TensorQueryClient"):
+        super().__init__(name=f"{owner.name}.fallback_tap")
+        self.add_sink_pad(template=Caps.any_tensors())
+        self._owner = owner
+
+    def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        return self._owner.push(buf)
 
 
 @register_element
@@ -65,6 +98,24 @@ class TensorQueryClient(Element):
         self.max_request_retry = 3
         self.timeout_s = 10.0
         self.async_depth = 1  # >1: pipelined requests (see module doc)
+        # resilience knobs (resilience/policy.py). max_request_retry is
+        # a single SHARED RetryBudget per request — connect dials and
+        # request resends draw from one pool instead of multiplying.
+        self.retry_base_s = 0.05    # backoff: first-retry jitter cap
+        self.retry_max_s = 1.0      # backoff: ceiling for later retries
+        self.breaker_threshold = 5  # consecutive failures to open
+        self.breaker_reset_s = 5.0  # open→half-open cooldown
+        #: local degradation when the remote path is down: "passthrough"
+        #: forwards input buffers unchanged; any registered element kind
+        #: (e.g. a local tensor_filter) processes them instead. Unset →
+        #: failures keep today's error semantics.
+        self.fallback: Any = None
+        #: stamp this per-buffer deadline budget (ms) on ingress when
+        #: upstream didn't already attach one; 0 = no deadline
+        self.deadline_ms = 0.0
+        #: EOS drain patience for pipelined in-flight results
+        #: (was a hardcoded 60 s)
+        self.drain_timeout_s = 60.0
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
         self.add_src_pad(template=Caps.any_tensors())
@@ -88,6 +139,18 @@ class TensorQueryClient(Element):
         #: detectable by traffic); short gaps skip the probe so steady
         #: streams never pay the extra round trip
         self.idle_probe_s = 0.5
+        # breaker guarding the remote path; it only GATES sends when a
+        # fallback is configured (without one, refusing to try would
+        # just turn retry errors into faster errors) but it always
+        # tracks state for the gauge/events
+        self._breaker = _rp.CircuitBreaker(
+            f"query:{self.name}",
+            failure_threshold=int(self.breaker_threshold),
+            reset_s=float(self.breaker_reset_s))
+        self._fallback_el: Optional[Element] = None
+        self._fallback_tap: Optional[_FallbackTap] = None
+        self._fb_active = False      # fallback carried the last buffer
+        self._last_deadline: Optional[_rp.Deadline] = None
         # offload telemetry (obs subsystem; message/byte counts live at
         # the protocol layer): dials, request round trips, and the
         # pipelined in-flight window (collection-time read, no hot cost)
@@ -169,27 +232,49 @@ class TensorQueryClient(Element):
         raise ConnectionError(f"no reachable server: {last}")
 
     def _ensure_conn(self) -> socket.socket:
+        """Dial once if unconnected. Retry ownership lives with the
+        caller's RetryBudget: the nested per-call retry loop that used
+        to run here multiplied with chain()'s into retry² dials per
+        frame — now both draw from one budget in _chain_sync."""
         if self._sock is None:
-            retries = int(self.max_request_retry)
-            last: Optional[Exception] = None
-            for attempt in range(max(retries, 1)):
-                try:
-                    self._sock = self._connect()
-                    return self._sock
-                except (ConnectionError, OSError) as e:
-                    last = e
-                    time.sleep(min(0.2 * (attempt + 1), 1.0))
-            self._hc.set_status(_health.Status.FAILED,
-                                f"connect failed: {last}")
-            _events.record("query.connect_failed",
-                           f"{self.name}: connect failed: {last}",
-                           severity="error", element=self.name)
-            raise ConnectionError(f"tensor_query_client: connect failed: {last}")
+            self._sock = self._connect()
         return self._sock
+
+    def _retry_policy(self) -> "_rp.RetryPolicy":
+        """Backoff from the current props (full jitter — reconnecting
+        clients decorrelate instead of re-arriving in waves)."""
+        return _rp.RetryPolicy(base_s=float(self.retry_base_s),
+                               max_s=float(self.retry_max_s))
 
     def start(self) -> None:
         self._caps_out_sent = False
         self._reader_error = None
+        if self.fallback and self._fallback_el is None \
+                and self.fallback != "passthrough":
+            self._build_fallback()
+
+    def _build_fallback(self) -> None:
+        """Materialize the ``fallback=`` property: a callable becomes a
+        local tensor_filter wrapping it, a string names a registered
+        element kind. Its output feeds a tap that forwards out of this
+        client's src pad."""
+        fb = self.fallback
+        if callable(fb):
+            el = make_element("tensor_filter", f"{self.name}.fallback",
+                              model=fb)
+        else:
+            el = make_element(str(fb).strip(), f"{self.name}.fallback")
+        if not el.sink_pads or not el.src_pads:
+            raise ValueError(
+                f"fallback element {fb!r} must have sink and src pads")
+        tap = _FallbackTap(self)
+        el.src_pads[0].link(tap.sink_pads[0])
+        el.bus = tap.bus = self.bus
+        el.start()
+        self._fallback_el, self._fallback_tap = el, tap
+        caps = self.sink_pad.caps
+        if caps is not None:
+            el.on_caps(el.sink_pads[0], caps)
 
     def stop(self) -> None:
         if self._sock is not None:
@@ -206,7 +291,7 @@ class TensorQueryClient(Element):
             self._sock = None
         r = self._reader
         if r is not None and r is not threading.current_thread():
-            r.join(timeout=5)
+            join_or_warn(r, self.name)
         self._reader = None
         with self._cv:
             self._pending.clear()
@@ -215,6 +300,10 @@ class TensorQueryClient(Element):
     # -- negotiation --------------------------------------------------------- #
     def on_caps(self, pad: Pad, caps: Caps) -> None:
         pad.caps = caps
+        if self._fallback_el is not None:
+            # the local fallback negotiates the same input the remote
+            # path would have seen
+            self._fallback_el.on_caps(self._fallback_el.sink_pads[0], caps)
         # result stream is shape-dynamic from the client's viewpoint: declare
         # flexible; static caps could be fetched from the server in future
         self.send_caps_all(Caps.tensors(format=TensorFormat.FLEXIBLE))
@@ -325,6 +414,8 @@ class TensorQueryClient(Element):
 
     def _chain_pipelined(self, buf: Buffer, depth: int) -> FlowReturn:
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        dl = _rp.deadline_of(buf)
+        retry = self._retry_policy()
         # per-request span: submit → result popped by the reader (ended
         # there); NOOP when tracing is off, so every span touch below
         # is a no-op method on a shared singleton
@@ -333,6 +424,10 @@ class TensorQueryClient(Element):
             parent=buf.meta.get(_tracing.CTX_META_KEY),
             attrs={"element": self.name, "pipelined": True})
         for attempt in range(max(int(self.max_request_retry), 1)):
+            if dl is not None and dl.expired():
+                rspan.end()
+                return self._shed(buf, f"deadline expired after "
+                                       f"{attempt} attempt(s)")
             with self._cv:
                 if self._reader_error is not None:
                     return FlowReturn.ERROR  # in-flight loss, on the bus
@@ -346,11 +441,13 @@ class TensorQueryClient(Element):
                 self._reset_conn()  # clean close between streams: redial
             if self._sock is None:
                 try:
-                    # single dial per outer attempt: the sync path's
-                    # _ensure_conn retry loop would multiply with this one
+                    # single dial per outer attempt (same no-multiply
+                    # rule the sync path now gets from its RetryBudget)
                     self._sock = self._connect()
+                    self._breaker.record_success()
                 except (ConnectionError, OSError):
-                    time.sleep(min(0.2 * (attempt + 1), 1.0))
+                    self._breaker.record_failure()
+                    retry.sleep(attempt)
                     continue
             sock = self._sock
             fresh = self._reader is None
@@ -386,6 +483,11 @@ class TensorQueryClient(Element):
                 self._pending.append(entry)
             try:
                 self._maybe_push_obs(sock)
+                if dl is not None:
+                    # wire form is REMAINING ms, re-anchored on the
+                    # server's own clock — recomputed per attempt so
+                    # retries don't resurrect spent budget
+                    meta[_rp.WIRE_KEY] = dl.to_wire()
                 if rspan.recording:
                     # current-context window around the send so the wire
                     # meta carries this request's context to the server
@@ -423,28 +525,106 @@ class TensorQueryClient(Element):
                             "query send failed with frames in flight")
                     return FlowReturn.ERROR
                 self._reset_conn()  # nothing else at risk: retry fresh
+        rspan.end()
+        if self.fallback:
+            return self._route_fallback(buf, "request failed after retries")
         self._hc.set_status(_health.Status.FAILED,
                             "request failed after retries")
         self.post_error("query: request failed after retries")
         return FlowReturn.ERROR
 
-    def _drain_pending(self, timeout: float = 60.0) -> None:
+    def _drain_pending(self, timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            timeout = float(self.drain_timeout_s)
+        dl = self._last_deadline
+        if dl is not None:
+            # results for past-deadline requests are worthless; don't
+            # out-wait the work's own budget
+            timeout = min(timeout, max(dl.remaining_s(), 0.0))
         deadline = time.monotonic() + timeout
         with self._cv:
             while self._pending and self._reader_error is None \
                     and time.monotonic() < deadline:
                 self._cv.wait(0.2)
+            abandoned = len(self._pending)
+        if abandoned and self._reader_error is None:
+            log.warning("%s: EOS drain gave up with %d result(s) still "
+                        "pending after %.1fs", self.name, abandoned, timeout)
+            _events.record("query.drain_abandoned",
+                           f"{self.name}: EOS drain gave up with "
+                           f"{abandoned} result(s) pending",
+                           severity="warning", element=self.name,
+                           pending=abandoned)
 
     def on_eos(self) -> None:
         # all in-flight results must be pushed before EOS propagates
         self._drain_pending()
 
+    # -- degraded paths -------------------------------------------------------- #
+    def _shed(self, buf: Buffer, why: str) -> FlowReturn:
+        """Drop a past-deadline buffer (the graph's legal drop: return
+        OK without pushing) — sending it would spend wire and server
+        time on a result nobody can use."""
+        self._hc.count("shed")
+        _rp.record_shed("query", f"{self.name}: shed buffer ({why})",
+                        element=self.name)
+        return FlowReturn.OK
+
+    def _route_fallback(self, buf: Buffer, why: str) -> FlowReturn:
+        """Degraded mode: hand the buffer to the local fallback element
+        (or pass it through) instead of the dead remote path. Health
+        goes DEGRADED — visibly impaired, not failed: /healthz stays
+        200 and the pipeline keeps flowing."""
+        self._fb_active = True
+        self._hc.set_status(_health.Status.DEGRADED,
+                            f"fallback active: {why}")
+        _rp.record_fallback(self.name, f"{self.name}: {why} — buffer "
+                                       f"routed to local fallback",
+                            reason=why)
+        el = self._fallback_el
+        if el is None:  # passthrough
+            return self.push(buf)
+        ret = el._chain_entry(el.sink_pads[0], buf)
+        return ret if ret is not None else FlowReturn.OK
+
+    def _remote_restored(self) -> None:
+        """A remote round trip succeeded after fallback traffic: the
+        breaker probe closed the circuit, so un-degrade."""
+        self._fb_active = False
+        self._hc.set_status(_health.Status.OK, "remote path restored")
+        _events.record("query.remote_restored",
+                       f"{self.name}: remote path restored after fallback",
+                       element=self.name)
+
     # -- dataflow ------------------------------------------------------------- #
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
+        # deadline: adopt upstream's, or stamp this element's budget
+        dl = _rp.deadline_of(buf)
+        if dl is None and float(self.deadline_ms or 0) > 0:
+            dl = _rp.Deadline.after_ms(float(self.deadline_ms))
+            _rp.set_deadline(buf, dl)
+        if dl is not None:
+            self._last_deadline = dl
+            if dl.expired():
+                return self._shed(buf, "deadline expired before send")
+        # breaker gate — only with a fallback to route to (without one,
+        # refusing to try would just fail faster than trying)
+        if self.fallback and not self._breaker.allow():
+            return self._route_fallback(buf, "breaker open")
         depth = int(self.async_depth or 1)
         if depth > 1:
             return self._chain_pipelined(buf, depth)
+        return self._chain_sync(buf, dl)
+
+    def _chain_sync(self, buf: Buffer,
+                    dl: Optional["_rp.Deadline"]) -> Optional[FlowReturn]:
         meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        # ONE retry budget for the whole request: connect dials and
+        # request resends draw from the same max_request_retry pool
+        # (previously chain x _ensure_conn multiplied into retry² dials)
+        budget = _rp.RetryBudget(self.max_request_retry, site="query")
+        retry = self._retry_policy()
+        last: Optional[Exception] = None
         # one span per offload round trip: covers the wire send, the
         # server-side remote-parented spans, and the result receive —
         # NOOP (flag check only) when tracing is off
@@ -452,10 +632,19 @@ class TensorQueryClient(Element):
                 "query.request",
                 parent=buf.meta.get(_tracing.CTX_META_KEY),
                 attrs={"element": self.name}) as rspan:
-            for attempt in range(max(int(self.max_request_retry), 1)):
+            while budget.take():
+                if dl is not None and dl.expired():
+                    return self._shed(
+                        buf, f"deadline expired after {budget.used - 1} "
+                             f"attempt(s)")
                 try:
                     sock = self._ensure_conn()
                     self._maybe_push_obs(sock)
+                    if dl is not None:
+                        # wire form is REMAINING ms (re-anchored on the
+                        # server's clock); recomputed per attempt so a
+                        # retry doesn't resurrect spent budget
+                        meta[_rp.WIRE_KEY] = dl.to_wire()
                     t_send = time.monotonic()
                     send_message(sock, Cmd.DATA, meta, payload)
                     cmd, rmeta, rpayload = recv_message(sock)
@@ -465,6 +654,9 @@ class TensorQueryClient(Element):
                     if cmd is not Cmd.RESULT:
                         raise QueryProtocolError(f"unexpected reply {cmd}")
                     self._m_rtt.observe(time.monotonic() - t_send)
+                    self._breaker.record_success()
+                    if self._fb_active:
+                        self._remote_restored()
                     out = payload_to_buffer(rmeta, rpayload)
                     out.pts, out.duration, out.offset = \
                         buf.pts, buf.duration, buf.offset
@@ -477,6 +669,19 @@ class TensorQueryClient(Element):
                             out.meta[_tracing.ROOT_META_KEY] = root
                     return self.push(out)
                 except (ConnectionError, OSError, QueryProtocolError) as e:
-                    log.warning("query attempt %d failed: %s", attempt + 1, e)
+                    last = e
+                    self._breaker.record_failure()
+                    log.warning("query attempt %d/%d failed: %s",
+                                budget.used, budget.attempts, e)
                     self.stop()  # drop connection, retry fresh
+                    if not budget.exhausted:
+                        retry.sleep(budget.used - 1)
+        if self.fallback:
+            return self._route_fallback(
+                buf, f"request failed after retries: {last}")
+        self._hc.set_status(_health.Status.FAILED,
+                            f"request failed after retries: {last}")
+        _events.record("query.connect_failed",
+                       f"{self.name}: request failed after retries: {last}",
+                       severity="error", element=self.name)
         raise ConnectionError("tensor_query_client: request failed after retries")

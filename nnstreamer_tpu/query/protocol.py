@@ -81,6 +81,13 @@ _BYTES_TOTAL = _obs.registry().counter(
     "Query protocol payload bytes by direction", ("direction",))
 
 
+#: chaos injection point (resilience/chaos.py installs/clears this):
+#: called as ``hook(direction, cmd, meta, payload) -> payload|None`` at
+#: the top of send_message ("send") and per received frame ("recv");
+#: None return drops the frame, a raise propagates into the caller's
+#: normal error handling. Disabled cost: one global load + None check.
+CHAOS_HOOK = None
+
 #: max bytes per wire chunk; also the granularity of receive timeouts
 CHUNK_SIZE = 1 << 20
 #: a chunk that doesn't arrive within this window fails the transfer —
@@ -134,6 +141,12 @@ def recv_message(sock: socket.socket,
                  chunk_timeout: float = CHUNK_TIMEOUT
                  ) -> Tuple[Cmd, Dict[str, Any], bytes]:
     cmd, meta, payload = _recv_one(sock)
+    if CHAOS_HOOK is not None:
+        payload = CHAOS_HOOK("recv", cmd, meta, payload)
+        if payload is None:
+            # frame dropped by the fault plan: deliver the next one —
+            # from the caller's view the frame simply never arrived
+            return recv_message(sock, chunk_timeout)
     if cmd is not Cmd.CHUNK_START:
         _MSG_TOTAL.labels("recv", cmd.name).inc()
         _BYTES_TOTAL.labels("recv").inc(len(payload))
@@ -205,6 +218,10 @@ def recv_message(sock: socket.socket,
 
 def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
                  payload: bytes = b"") -> None:
+    if CHAOS_HOOK is not None:
+        payload = CHAOS_HOOK("send", cmd, meta, payload)
+        if payload is None:
+            return  # frame silently eaten by the installed fault plan
     _MSG_TOTAL.labels("sent", cmd.name).inc()
     _BYTES_TOTAL.labels("sent").inc(len(payload))
     span = _tracing.NOOP_SPAN

@@ -25,13 +25,20 @@ from typing import Any, Dict, Optional
 from ..core.buffer import Buffer
 from ..core.log import logger
 from ..core.types import Caps, TensorsConfig, TensorsInfo
-from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..graph.element import (
+    Element,
+    FlowReturn,
+    Pad,
+    join_or_warn,
+    register_element,
+)
 from ..graph.pipeline import SourceElement
 from ..obs import events as _events
 from ..obs import fleet as _fleet
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
+from ..resilience import policy as _rp
 from .protocol import (
     Cmd,
     QueryProtocolError,
@@ -175,6 +182,14 @@ class TensorQueryServerSrc(SourceElement):
                     self._hc.beat()
                     buf = payload_to_buffer(meta, payload)
                     buf.meta["query_client_id"] = cid
+                    dms = meta.get(_rp.WIRE_KEY)
+                    if dms is not None:
+                        # re-anchor the remaining budget on THIS host's
+                        # monotonic clock (never compare peer clocks);
+                        # downstream elements/engines shed if it expires
+                        dl = _rp.Deadline.from_wire(dms)
+                        if dl is not None:
+                            _rp.set_deadline(buf, dl)
                     if _tracing.enabled():
                         # adopt the client's context so one trace spans
                         # both halves: the handling span parents every
@@ -267,6 +282,15 @@ class TensorQueryServerSrc(SourceElement):
                 c.close()
             except OSError:
                 pass
+        # join the accept/connection workers: an accept still inside its
+        # (timeout-bounded) syscall keeps the kernel LISTEN socket alive
+        # past close(), so returning before it exits races an immediate
+        # rebind of the same port with EADDRINUSE (server restart)
+        cur = threading.current_thread()
+        for t in self._threads:
+            if t is not cur:
+                join_or_warn(t, self.name, timeout=2.0)
+        self._threads = []
 
 
 @register_element

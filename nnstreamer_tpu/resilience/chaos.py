@@ -1,0 +1,261 @@
+"""Deterministic chaos injection — seeded fault plans for the wire and
+the graph.
+
+Testing the resilience policies used to require hand-rolled socket
+games (kill a server mid-recv, hope the timing lands). This harness
+makes faults first-class and REPRODUCIBLE: a :class:`FaultPlan` is a
+seeded schedule of drop/delay/corrupt/disconnect faults, fired either
+on the Nth matching call or probabilistically from a per-fault PRNG —
+the same seed always yields the same schedule, independent of wall
+clock and (per target) of thread interleaving.
+
+Injection points (the hosting modules own the hook variables so this
+module is never imported on the hot path):
+
+* ``query.protocol.CHAOS_HOOK`` — called at the top of
+  ``send_message`` (target ``"send"``) and after each frame in
+  ``recv_message`` (target ``"recv"``); returning ``None`` drops the
+  frame, raising propagates into the caller's error handling.
+* ``graph.element.CHAOS_CHAIN_HOOK`` — called by ``Pad.push`` before
+  the peer's chain (target ``"chain:<element-name>"``); truthy return
+  drops the buffer (the graph's legal drop semantics).
+
+Both hooks are module globals that are ``None`` unless a plan is
+installed — the disabled cost is one global load + ``is None`` check,
+the same zero-overhead contract as tracing. Enable via
+:func:`install`, or the ``NNS_TPU_CHAOS`` environment variable (a JSON
+plan, honored by ``nns-launch``; see :func:`plan_from_env`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.log import logger
+from ..obs import events as _events
+from ..obs import metrics as _obs
+
+log = logger("chaos")
+
+#: environment variable carrying a JSON fault plan (nns-launch honors it)
+ENV_VAR = "NNS_TPU_CHAOS"
+
+KINDS = ("drop", "delay", "corrupt", "disconnect")
+
+_INJECTED_TOTAL = _obs.registry().counter(
+    "nnstpu_chaos_injected_total",
+    "Faults fired by the installed fault plan", ("kind",))
+
+
+@dataclass
+class Fault:
+    """One fault rule inside a :class:`FaultPlan`.
+
+    ``target`` is ``"send"`` / ``"recv"`` (the query wire; ``cmd``
+    optionally restricts to one command name, e.g. ``"DATA"`` so the
+    INFO handshake survives) or ``"chain:<element>"`` (a specific sink
+    element; bare ``"chain"`` matches every element). Fire selection:
+    ``nth`` (an int or collection of ints, 1-based call numbers within
+    the matching stream) is exact; otherwise ``p`` draws per matching
+    call from the fault's own seeded PRNG. ``max_fires`` caps total
+    fires without disturbing the draw sequence.
+    """
+
+    kind: str
+    target: str = "send"
+    cmd: Optional[str] = None
+    nth: Any = None
+    p: float = 0.0
+    delay_s: float = 0.01
+    max_fires: Optional[int] = None
+    nth_set: frozenset = field(init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {KINDS})")
+        if self.nth is None:
+            self.nth_set = frozenset()
+        elif isinstance(self.nth, int):
+            self.nth_set = frozenset({self.nth})
+        else:
+            self.nth_set = frozenset(int(n) for n in self.nth)
+
+    def matches(self, target: str, cmd: Optional[str]) -> bool:
+        if self.target == "chain":
+            if not target.startswith("chain:"):
+                return False
+        elif self.target != target:
+            return False
+        return self.cmd is None or self.cmd == cmd
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults.
+
+    Each fault owns a PRNG seeded from ``(seed, fault_index)`` and a
+    counter of *matching* calls, so its fire schedule is a pure function
+    of the plan and the per-target call sequence — two plans built from
+    the same spec make identical decisions (the determinism test pins
+    this). ``fired`` is an audit log of every injection.
+    """
+
+    def __init__(self, faults: List[Fault], seed: int = 0):
+        self.seed = int(seed)
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._counts = [0] * len(self.faults)
+        self._fires = [0] * len(self.faults)
+        # per-fault PRNG, seeded from (seed, index) mixed into one int
+        # (tuple seeding is deprecated); large odd multiplier keeps
+        # nearby seeds from producing overlapping streams
+        self._rngs = [random.Random(self.seed * 1_000_003 + i)
+                      for i in range(len(self.faults))]
+        self.fired: List[Dict[str, Any]] = []
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "FaultPlan":
+        """Build from a JSON-shaped dict:
+        ``{"seed": 7, "faults": [{"kind": "drop", "target": "send",
+        "cmd": "DATA", "p": 0.1}, ...]}``."""
+        faults = [Fault(**f) for f in spec.get("faults", ())]
+        return cls(faults, seed=int(spec.get("seed", 0)))
+
+    def decide(self, target: str, cmd: Optional[str] = None) -> List[Fault]:
+        """Advance the schedule one call at ``target``; returns the
+        faults that fire on this call (usually zero or one)."""
+        hits: List[Fault] = []
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if not f.matches(target, cmd):
+                    continue
+                self._counts[i] += 1
+                n = self._counts[i]
+                if f.nth_set:
+                    fire = n in f.nth_set
+                elif f.p > 0.0:
+                    # always draw so capped faults keep the sequence
+                    fire = self._rngs[i].random() < f.p
+                else:
+                    fire = False
+                if fire and (f.max_fires is None
+                             or self._fires[i] < f.max_fires):
+                    self._fires[i] += 1
+                    self.fired.append({"kind": f.kind, "target": target,
+                                       "cmd": cmd, "call": n})
+                    hits.append(f)
+        return hits
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def _corrupt(payload: bytes) -> bytes:
+    """Deterministically damage a payload (first byte inverted) — enough
+    to fail deserialization/checksums without hiding which frame it was."""
+    if not payload:
+        return payload
+    return bytes([payload[0] ^ 0xFF]) + payload[1:]
+
+
+def _fire(f: Fault, target: str, detail: str) -> None:
+    _INJECTED_TOTAL.labels(f.kind).inc()
+    log.warning("chaos: injected %s at %s (%s)", f.kind, target, detail)
+    _events.record("chaos.inject",
+                   f"injected {f.kind} at {target} ({detail})",
+                   severity="warning", kind=f.kind, target=target)
+
+
+def _wire_hook(direction: str, cmd: Any, meta: Dict[str, Any],
+               payload: bytes) -> Optional[bytes]:
+    """Installed as ``protocol.CHAOS_HOOK``. Returns the (possibly
+    corrupted) payload, or None to drop the frame; raises
+    ConnectionError for an injected disconnect."""
+    plan = _ACTIVE
+    if plan is None:
+        return payload
+    name = getattr(cmd, "name", str(cmd))
+    for f in plan.decide(direction, name):
+        _fire(f, direction, f"cmd={name}")
+        if f.kind == "delay":
+            time.sleep(f.delay_s)
+        elif f.kind == "disconnect":
+            raise ConnectionError(
+                f"chaos: injected disconnect ({direction} {name})")
+        elif f.kind == "corrupt":
+            payload = _corrupt(payload)
+        elif f.kind == "drop":
+            return None
+    return payload
+
+
+def _chain_hook(element: str, buf: Any) -> bool:
+    """Installed as ``element.CHAOS_CHAIN_HOOK``. True drops the
+    buffer; delay sleeps in the pushing thread; disconnect/corrupt
+    raise (the graph turns that into a bus error)."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    target = f"chain:{element}"
+    drop = False
+    for f in plan.decide(target):
+        _fire(f, target, f"pts={buf.pts}")
+        if f.kind == "delay":
+            time.sleep(f.delay_s)
+        elif f.kind == "drop":
+            drop = True
+        else:
+            raise RuntimeError(f"chaos: injected {f.kind} at {target}")
+    return drop
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate a plan: point the protocol and graph hook globals at
+    this module. Imports are lazy — an idle chaos module never touches
+    the hot-path modules."""
+    global _ACTIVE
+    from ..graph import element as _element
+    from ..query import protocol as _protocol
+
+    _ACTIVE = plan
+    _protocol.CHAOS_HOOK = _wire_hook
+    _element.CHAOS_CHAIN_HOOK = _chain_hook
+    _events.record("chaos.install",
+                   f"fault plan installed (seed={plan.seed}, "
+                   f"{len(plan.faults)} faults)", seed=plan.seed)
+    return plan
+
+
+def uninstall() -> None:
+    """Deactivate: hooks back to None (the zero-overhead state)."""
+    global _ACTIVE
+    from ..graph import element as _element
+    from ..query import protocol as _protocol
+
+    _protocol.CHAOS_HOOK = None
+    _element.CHAOS_CHAIN_HOOK = None
+    _ACTIVE = None
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Parse :data:`ENV_VAR` into a plan (None when unset/invalid —
+    a malformed plan is reported, never fatal: chaos must not be able
+    to take a pipeline down by typo)."""
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return None
+    try:
+        return FaultPlan.from_spec(json.loads(raw))
+    except (ValueError, TypeError, KeyError) as e:
+        log.warning("%s ignored (bad plan: %s)", ENV_VAR, e)
+        return None

@@ -55,6 +55,7 @@ from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
+from ..resilience import policy as _rp
 from . import sampling
 
 
@@ -175,6 +176,9 @@ class _Request:
     out: List[int] = field(default_factory=list)
     done: bool = False
     t_submit: float = 0.0       # monotonic stamp for the TTFT histogram
+    #: resilience.policy.Deadline (or None): checked at submit and again
+    #: at admission — expired work is shed, not prefilled
+    deadline: Any = None
     # tracing (None when tracing is off at submit time): the request
     # span parents admission-wait / prefill / compile / decode children
     span: Any = None            # serving.request — submit → retire
@@ -350,14 +354,20 @@ class LMEngine:
 
     def submit(self, prompt: Sequence[int], max_new: int,
                eos: Optional[int] = None, *, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> int:
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               deadline: Any = None) -> int:
         """Queue a generation request; returns its request id.
 
         ``temperature``/``top_k``/``top_p`` select the decoding mode per
         request (defaults = greedy, bit-identical to the pre-sampling
         engine). ``seed`` fixes the request's PRNG stream: the sampled
         output is reproducible and independent of batch composition
-        (serving/sampling.py key schedule).
+        (serving/sampling.py key schedule). ``deadline`` (a
+        resilience.policy.Deadline) enables load shedding: a request
+        whose deadline has already expired — at submit or later while
+        still queued at admission — finishes empty immediately
+        (``resilience.shed`` event + counter) instead of occupying a
+        slot behind the admission-stall watchdog.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size < 1:
@@ -377,7 +387,12 @@ class LMEngine:
         req = _Request(
             rid, p, max_new, eos, temperature=float(temperature),
             top_k=int(top_k), top_p=float(top_p), seed=int(seed),
-            t_submit=time.monotonic())
+            t_submit=time.monotonic(), deadline=deadline)
+        if deadline is not None and deadline.expired():
+            # shed at the door: the caller's budget is already spent,
+            # so queueing would only delay everyone behind it
+            self._shed_request(req, "deadline expired at submit")
+            return rid
         if _tracing.enabled():
             # parent on the caller's current context (an instrumented
             # element chain sets it) so an offloaded request joins the
@@ -397,6 +412,23 @@ class LMEngine:
                 attrs={"queued_behind": len(self._queue)})
         self._queue.append(req)
         return rid
+
+    def _shed_request(self, req: "_Request", why: str) -> None:
+        """Deadline load shedding: finish the request EMPTY right now —
+        spending prefill + decode on a result whose deadline has passed
+        starves requests that can still meet theirs."""
+        self._hc.count("shed")
+        self._m_streams.labels(self._engine_label, "shed").inc()
+        _rp.record_shed(
+            "serving", f"{self._engine_label}: rid {req.rid} shed ({why})",
+            engine=self._engine_label, rid=req.rid)
+        if req.wait_span is not None:
+            req.wait_span.end()
+        if req.span is not None:
+            req.span.set_attribute("shed", True)
+            req.span.end()
+        req.done = True
+        self._finished[req.rid] = req.out  # empty: the budget was spent
 
     def _reject(self, reason: str) -> None:
         """Flight-recorder entry for an admission rejection — one flag
@@ -441,6 +473,14 @@ class LMEngine:
             if self._slot_req[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            while req is not None and req.deadline is not None \
+                    and req.deadline.expired():
+                # expired while queued: shed and give the slot to the
+                # next request that can still meet its deadline
+                self._shed_request(req, "deadline expired in queue")
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                continue
             if req.wait_span is not None:
                 req.wait_span.end()
             t = int(req.prompt.size)

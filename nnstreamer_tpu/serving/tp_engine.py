@@ -44,6 +44,9 @@ for the health model (obs/health.py): `_init_health` registers a
 code — and for fleet federation (obs/fleet.py): a TP worker's pushes
 carry the same engine="tp" series and remote-parented spans as any
 other instance, so the aggregator needs no sharding awareness either.
+Deadline load shedding (resilience/policy.py) is inherited the same
+way: submit/_admit shed past-deadline requests before any sharded
+prefill is dispatched, emitting ``resilience.shed`` with engine="tp".
 """
 
 from __future__ import annotations

@@ -5,10 +5,11 @@ types against the repo naming conventions.
 Metric convention (docs/observability.md): every metric is
 ``nnstpu_<layer>_<name>_<unit>`` with
 
-  * layer  in {pipeline, query, serving},
+  * layer  in {pipeline, query, serving, resilience, chaos},
   * counters    ending in ``_total``,
   * histograms  ending in ``_seconds``,
-  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes``,
+  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes`` /
+    ``_state``,
   * label keys matching ``[a-z_][a-z0-9_]*``, never the reserved
     ``instance``/``role`` (appended by fleet federation) or ``le``
     (histogram encoder), and at most 8 keys per family (cardinality
@@ -23,6 +24,14 @@ every flight-recorder event type is the same lowercase dotted
 ``<layer>.<event>`` shape, with layer additionally allowing {core, obs}
 (the log bridge and the obs subsystem itself emit events) — e.g.
 ``pipeline.stall``, ``query.reconnect_storm``, ``core.log``.
+
+Resilience placement (docs/resilience.md): the ``resilience``/``chaos``
+metric + event layers belong to nnstreamer_tpu/resilience/ — every
+CircuitBreaker/RetryPolicy/FaultPlan series is registered there (other
+modules record through its helpers), and conversely the resilience
+package never registers under another layer's name. check_resilience
+enforces both directions so policy telemetry can't drift into ad-hoc
+per-module names.
 
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
@@ -46,19 +55,27 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parents[1]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
-LAYERS = ("pipeline", "query", "serving")
+LAYERS = ("pipeline", "query", "serving", "resilience", "chaos")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
-    "gauge": ("depth", "slots", "bytes"),
+    # _state: enumerated-condition gauges (e.g. breaker 0/1/2)
+    "gauge": ("depth", "slots", "bytes", "state"),
 }
 #: span layers add "device" — device.xprof has no metric series
 SPAN_LAYERS = ("pipeline", "query", "serving", "device")
 #: event layers additionally allow "core" (the core/log.py bridge),
-#: "obs" (the obs subsystem's own events) and "fleet" (cross-process
-#: federation: push/expiry/merge-conflict audit trail, obs/fleet.py)
+#: "obs" (the obs subsystem's own events), "fleet" (cross-process
+#: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
+#: and "resilience"/"chaos" (fault-policy decisions + injected faults,
+#: nnstreamer_tpu/resilience/)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
-                "fleet")
+                "fleet", "resilience", "chaos")
+
+#: layers OWNED by the resilience package: registrations under these
+#: names must live in RESILIENCE_DIR and vice versa (see module doc)
+RESILIENCE_LAYERS = frozenset({"resilience", "chaos"})
+RESILIENCE_DIR = "resilience"
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -221,6 +238,33 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_labels(root)
     problems += check_spans(root)
     problems += check_events(root)
+    problems += check_resilience(root)
+    return problems
+
+
+def check_resilience(root: Path = SOURCE_ROOT):
+    """Placement lint for the fault-policy telemetry: every metric in
+    the ``resilience``/``chaos`` layers is registered under
+    nnstreamer_tpu/resilience/ (breaker/retry/shed/fallback series are
+    the policy objects' own — other modules go through their helpers),
+    and the resilience package registers under no other layer."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_pkg = RESILIENCE_DIR in path.parts
+        if layer in RESILIENCE_LAYERS and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the {layer!r} "
+                f"layer outside nnstreamer_tpu/{RESILIENCE_DIR}/ — "
+                f"record through resilience.policy/chaos helpers instead")
+        elif in_pkg and layer not in RESILIENCE_LAYERS:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/{RESILIENCE_DIR}/ must use a layer in "
+                f"{sorted(RESILIENCE_LAYERS)}, not {layer!r}")
     return problems
 
 
