@@ -1136,6 +1136,111 @@ def _serving_lane(device) -> dict:
         return {}
 
 
+def _serving_paged_lane(device) -> dict:
+    """Paged KV cache (serving/kv_cache.py) vs contiguous slot caches on
+    the SAME memory budget: the contiguous baseline runs slots_equiv
+    slots (its cache is slots_equiv x max_len), the paged engine runs
+    4x the slots on a page pool of exactly slots_equiv * max_len / ps
+    pages. A shared-prefix workload (the regime radix sharing targets —
+    e.g. a common system prompt) lets paging fit the extra concurrency:
+    the prefix is resident once and every admission past the first is
+    charged only its suffix. Greedy results are bit-identical to the
+    contiguous engine (tests/test_kv_paging.py), so speedup here is
+    pure admission concurrency, not numerics."""
+    import traceback
+
+    try:
+        import jax
+
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.serving import LMEngine
+
+        V, D, H, L = _LM_DIMS
+        max_len, chunk, ps = 1024, 16, 64
+        slots_equiv, paged_slots = 8, 32
+        n_reqs, prefix_len = 64, 128
+        plens, gens = (160, 192, 224, 256), (32, 64, 96, 128)
+        if device.platform == "cpu" and \
+                os.environ.get("BENCH_LM_PAGED_FULL", "0") != "1":
+            # full-size decode on host CPU is minutes; tiny validation shape
+            V, D, H, L = 512, 64, 4, 2
+            max_len, chunk, ps = 128, 8, 8
+            slots_equiv, paged_slots = 4, 8
+            n_reqs, prefix_len = 16, 32
+            plens, gens = (40, 48, 56, 64), (8, 16)
+        kv_pages = slots_equiv * max_len // ps  # 8-slot-equivalent pool
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, max_len)
+
+        rng = np.random.default_rng(7)
+        prefix = rng.integers(0, V, prefix_len).astype(np.int32)
+        # two admission waves over the slot count; the second wave is
+        # sorted longest-budget-first so slots freeing early (short
+        # first-wave requests) pick up the long tail — complementary
+        # pairing keeps every slot chain near-equal, so waste_frac
+        # measures paging overhead, not workload raggedness (that is
+        # the lm_serving lane's subject)
+        wave = [gens[i % len(gens)] for i in range(n_reqs // 2)]
+        budgets = wave + sorted(wave, reverse=True)
+        reqs = []
+        for i, g in enumerate(budgets):
+            p = plens[i % len(plens)]
+            suffix = rng.integers(0, V, p - prefix_len).astype(np.int32)
+            reqs.append((np.concatenate([prefix, suffix]), g))
+
+        def run_requests(n_slots, **eng_kw):
+            eng = LMEngine(params, H, max_len, n_slots=n_slots,
+                           chunk=chunk, **eng_kw)
+            for p, g in reqs:
+                eng.submit(np.ascontiguousarray(p), max_new=g)
+            t0 = time.monotonic()
+            res = eng.run()
+            wall = time.monotonic() - t0
+            toks = sum(len(v) for v in res.values())
+            return toks / wall, res, eng
+
+        _mark("paged serving lane warmup (compiles) starting")
+        run_requests(paged_slots, kv_page_size=ps, kv_pages=kv_pages)
+        run_requests(slots_equiv)
+        _mark("paged serving lane paged run starting")
+        paged_tps, paged_res, paged_eng = run_requests(
+            paged_slots, kv_page_size=ps, kv_pages=kv_pages)
+        _mark("paged serving lane contiguous baseline starting")
+        base_tps, base_res, base_eng = run_requests(slots_equiv)
+        kv = paged_eng.kv_stats
+        pstats, bstats = paged_eng.stats, base_eng.stats
+        row = {
+            "lm_serving_paged_config":
+                f"d{D} L{L} V{V} page{ps} pool{kv_pages} "
+                f"slots{paged_slots} vs contiguous slots{slots_equiv} "
+                f"(same KV bytes) chunk{chunk} reqs{n_reqs} "
+                f"prefix{prefix_len} prompts{min(plens)}-{max(plens)} "
+                f"gen{min(gens)}-{max(gens)} greedy",
+            "lm_serving_paged_tokens_per_s": round(paged_tps, 1),
+            "lm_serving_paged_baseline_tokens_per_s": round(base_tps, 1),
+            "lm_serving_paged_speedup": round(paged_tps / base_tps, 3),
+            # greedy paged == greedy contiguous is an invariant, not a
+            # tolerance — a False here is a correctness regression
+            "lm_serving_paged_exact": paged_res == base_res,
+            "lm_serving_paged_waste_frac": round(
+                pstats["wasted_slot_steps"]
+                / max(1, paged_slots * pstats["decode_steps"]), 3),
+            "lm_serving_paged_baseline_waste_frac": round(
+                bstats["wasted_slot_steps"]
+                / max(1, slots_equiv * bstats["decode_steps"]), 3),
+            "lm_serving_paged_prefix_hit_rate": round(
+                kv["hit_tokens"] / max(1, kv["prompt_tokens"]), 3),
+            "lm_serving_paged_pages_peak": kv["pages_peak"],
+            "lm_serving_paged_evictions": kv["evictions"],
+            "lm_serving_paged_cow_copies": kv["cow_copies"],
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -1491,6 +1596,9 @@ def main() -> None:
             if os.environ.get("BENCH_LM_SERVING", "1") != "0":
                 _mark("continuous-batching serving lane starting")
                 result.update(_serving_lane(device))
+            if os.environ.get("BENCH_LM_PAGED", "1") != "0":
+                _mark("paged-KV serving lane starting")
+                result.update(_serving_paged_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if flops and result.get("adaptive_batch16_fps_median"):

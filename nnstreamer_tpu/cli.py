@@ -14,7 +14,10 @@ exporter, span tracing, health watchdog, flight-recorder dump),
 snapshots to an aggregator / serve the merged fleet — see
 docs/observability.md), --deadline-ms/--fallback (resilience: per-buffer
 deadlines + breaker-gated local degradation on every
-tensor_query_client — see docs/resilience.md). Setting the
+tensor_query_client — see docs/resilience.md),
+--kv-page-size/--kv-pages (serving: paged KV cache geometry for any
+LMEngine the pipeline constructs, exported via the NNS_LM_KV_* env —
+see docs/performance.md "Paged KV cache"). Setting the
 ``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
 harness for the run (docs/resilience.md "Chaos harness").
 """
@@ -73,6 +76,13 @@ def main(argv=None) -> int:
                     help="degraded-mode route for every tensor_query_client "
                          "when its circuit breaker opens: 'passthrough' or "
                          "a local element kind (e.g. tensor_filter)")
+    ap.add_argument("--kv-page-size", type=int, default=None, metavar="TOK",
+                    help="enable the paged KV cache on every LMEngine built "
+                         "during the run: tokens per page (must divide the "
+                         "engine max_len; sets NNS_LM_KV_PAGE_SIZE)")
+    ap.add_argument("--kv-pages", type=int, default=None, metavar="N",
+                    help="KV page-pool size shared by all slots (sets "
+                         "NNS_LM_KV_PAGES; needs --kv-page-size)")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -96,6 +106,20 @@ def main(argv=None) -> int:
         return inspect_element(args.inspect)
     if not args.pipeline:
         ap.error("pipeline description required")
+    if args.kv_pages is not None and args.kv_page_size is None:
+        ap.error("--kv-pages needs --kv-page-size (paging is off without "
+                 "a page size)")
+    if args.kv_page_size is not None:
+        if args.kv_page_size < 1:
+            ap.error("--kv-page-size must be >= 1")
+        if args.kv_pages is not None and args.kv_pages < 1:
+            ap.error("--kv-pages must be >= 1")
+        # env transport, not direct wiring: engines are constructed deep
+        # inside tensor_filter instances during p.start(), and LMEngine
+        # reads NNS_LM_KV_* at __init__ when no explicit kwarg is given
+        os.environ["NNS_LM_KV_PAGE_SIZE"] = str(args.kv_page_size)
+        if args.kv_pages is not None:
+            os.environ["NNS_LM_KV_PAGES"] = str(args.kv_pages)
 
     from .graph.parse import parse_pipeline
 

@@ -450,6 +450,163 @@ def lm_decode_step_slots(params: Dict[str, jax.Array], tokens: jax.Array,
         params, tokens[:, :, 0], kcaches, vcaches, poss, n_heads)
 
 
+# --------------------------------------------------------------------------- #
+# Paged KV cache execution forms (serving/kv_cache.py page pools)
+#
+# The paged kernels do NOT reimplement attention. Each step GATHERS a
+# slot's pages into the exact flat per-slot cache layout the contiguous
+# kernels consume, runs the ONE shared `_lm_verify_window` body, and
+# SCATTERS back only the pages the step could have touched. Exactness
+# paged-vs-contiguous is therefore by construction, not by a parallel
+# implementation (tests/test_kv_paging.py pins it bit-for-bit).
+#
+# Static-shape discipline: `page_size` and the table width B (the
+# pages-per-slot bound — a slot's view is B·page_size tokens, its
+# effective max_len) are baked into the executable, so paging adds no
+# new compile axis beyond the buckets the engine already has. The
+# gathered view is a transient of S·B·page_size tokens — the engine
+# sizes B to the slot-equivalent budget, which is what keeps "hundreds
+# of queued requests" from meaning "hundreds of resident caches".
+# --------------------------------------------------------------------------- #
+
+
+def _paged_view(pool, table):
+    """Gather one slot's pages into a contiguous flat cache view.
+
+    pool: (n_pages+1, L·H, ps, hd); table: (B,) int32 page ids. Returns
+    (L·H, B·ps, hd) — exactly the single-slot transport layout with
+    max_len = B·ps, so `_lm_verify_window` runs on it unchanged (it
+    reads capacity from the cache shape). Table rows past the request's
+    allocation hold the null page (id 0): their zeros are garbage the
+    causal `live` mask never attends.
+    """
+    pages = pool[table]                              # (B, LH, ps, hd)
+    b, lh, ps, hd = pages.shape
+    return pages.transpose(1, 0, 2, 3).reshape(lh, b * ps, hd)
+
+
+#: (pool, tables (S, B)) -> (S, L·H, B·ps, hd) — one batched gather
+paged_view_slots = jax.vmap(_paged_view, in_axes=(None, 0))
+
+
+def paged_touch_span(w: int, page_size: int, n_tables: int) -> int:
+    """Pages a W-token window can touch at worst alignment (start at a
+    page's last token): (w-1)//ps + 2, capped at the table width. Static
+    — the scatter width is part of the executable, not data."""
+    return min(n_tables, (w - 1) // page_size + 2)
+
+
+def _writeback_window(view, table, p0, nt):
+    """Slice the ``nt`` pages around write position ``p0`` out of a
+    modified view. Returns (ids (nt,), pages (nt, L·H, ps, hd)). The
+    start is left-clipped so the window stays inside the table; clipped
+    windows re-write earlier pages with the unchanged bits they were
+    gathered with — harmless, and it keeps ``nt`` static."""
+    lh, m, hd = view.shape
+    b = table.shape[0]
+    ps = m // b
+    pages = view.reshape(lh, b, ps, hd).transpose(1, 0, 2, 3)
+    start = jnp.clip(jnp.asarray(p0).reshape(()) // ps, 0, b - nt)
+    ids = jax.lax.dynamic_slice_in_dim(table, start, nt)
+    win = jax.lax.dynamic_slice_in_dim(pages, start, nt, axis=0)
+    return ids, win
+
+
+def _paged_update(pool, view, table, p0, nt):
+    """Scatter one slot's touched pages back into the pool."""
+    ids, win = _writeback_window(view, table, p0, nt)
+    return pool.at[ids].set(win)
+
+
+def paged_update_slots(pool, views, tables, p0s, nt: int):
+    """Scatter S slots' touched pages back in ONE pool write.
+
+    Duplicate scatter indices are safe by the allocator's invariants:
+    modified positions live in exclusively-owned pages (COW discipline),
+    shared pages in a clipped window carry their unchanged gathered
+    bits, and empty slots' zeroed tables collide only on the null page
+    (never read). So last-writer-wins ambiguity never changes bits that
+    anyone attends.
+    """
+    ids, wins = jax.vmap(
+        lambda v, t, p: _writeback_window(v, t, p, nt))(views, tables, p0s)
+    return pool.at[ids.reshape(-1)].set(
+        wins.reshape((-1,) + wins.shape[2:]))
+
+
+def lm_prefill_paged(params: Dict[str, jax.Array], window: jax.Array,
+                     kpool: jax.Array, vpool: jax.Array, table: jax.Array,
+                     pos0: jax.Array, true_len: jax.Array, n_heads: int
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Prefill a right-padded SUFFIX window directly into pages.
+
+    The prefix-hit admission path: positions 0..pos0-1 already hold
+    valid K/V in shared pages (radix hit), so only the suffix is
+    computed. window: (1, Wb) padded to a bucket with ``true_len`` real
+    tokens; table: (B,) page ids. The causal row structure of the
+    verify-window body gives padded-prompt masking for free: the
+    returned logits row ``true_len - 1`` attends exactly columns <=
+    pos0 + true_len - 1 (hit pages + the real suffix), never the padded
+    rows' garbage — the same overwrite-before-visible contract as
+    ``lm_prefill_masked``, relocated to pos0.
+
+    Returns (logits (1, vocab), kpool', vpool', pos = pos0 + true_len).
+    """
+    with jax.default_matmul_precision(_PRECISION):
+        p0 = jnp.asarray(pos0).reshape(()).astype(jnp.int32)
+        tl = jnp.asarray(true_len).reshape(()).astype(jnp.int32)
+        kv = _paged_view(kpool, table)
+        vv = _paged_view(vpool, table)
+        logits, kv, vv, _ = _lm_verify_window(
+            params, window, kv, vv, p0.reshape(1), n_heads)
+        last = jax.lax.dynamic_index_in_dim(logits[0], tl - 1, axis=0,
+                                            keepdims=False)
+        nt = paged_touch_span(window.shape[1], kpool.shape[2],
+                              table.shape[0])
+        kpool = _paged_update(kpool, kv, table, p0, nt)
+        vpool = _paged_update(vpool, vv, table, p0, nt)
+        return last[None], kpool, vpool, (p0 + tl).reshape(1)
+
+
+def lm_verify_window_paged(params: Dict[str, jax.Array], tokens: jax.Array,
+                           kpool: jax.Array, vpool: jax.Array,
+                           tables: jax.Array, poss: jax.Array, n_heads: int
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                      jax.Array]:
+    """Verify windows for S slots against paged caches: gather each
+    slot's view, run the same vmapped `_lm_verify_window` step as
+    :func:`lm_verify_window_slots`, scatter back the touched pages.
+    tokens: (S, W); tables: (S, B); poss: (S, 1). Returns (logits
+    (S, W, vocab), kpool', vpool', poss+W). Slots past their view
+    capacity B·ps NaN-poison their own row, same contract as the
+    contiguous form."""
+    with jax.default_matmul_precision(_PRECISION):
+        kviews = paged_view_slots(kpool, tables)
+        vviews = paged_view_slots(vpool, tables)
+        step = lambda tok, kc, vc, pos: _lm_verify_window(  # noqa: E731
+            params, tok[None], kc, vc, pos, n_heads)
+        logits, kviews, vviews, poss2 = jax.vmap(step)(
+            tokens, kviews, vviews, poss)
+        nt = paged_touch_span(tokens.shape[1], kpool.shape[2],
+                              tables.shape[1])
+        p0s = poss[:, 0]
+        kpool = paged_update_slots(kpool, kviews, tables, p0s, nt)
+        vpool = paged_update_slots(vpool, vviews, tables, p0s, nt)
+        return logits[:, 0], kpool, vpool, poss2
+
+
+def lm_decode_step_paged(params: Dict[str, jax.Array], tokens: jax.Array,
+                         kpool: jax.Array, vpool: jax.Array,
+                         tables: jax.Array, poss: jax.Array, n_heads: int
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array]:
+    """One decode step for S slots against paged caches — the W=1 case
+    of :func:`lm_verify_window_paged`, mirroring how the contiguous
+    `lm_decode_step_slots` is the W=1 verify window. tokens: (S, 1, 1)."""
+    return lm_verify_window_paged(
+        params, tokens[:, :, 0], kpool, vpool, tables, poss, n_heads)
+
+
 def prefill_flops(batch: int, seq: int, d_model: int, n_layers: int,
                   vocab: int, d_ff: int = 0) -> float:
     """Analytic forward FLOPs of one prefill (last-token unembed only).

@@ -214,7 +214,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         # batch·heads and q-blocks are independent; only the k axis is an
         # accumulation (scratch carries across it) and must stay ordered
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qf, kf, vf)

@@ -4,11 +4,16 @@ New capability beyond the reference (whose serving story is per-buffer
 pipeline invoke, `/root/reference/gst/nnstreamer/tensor_filter/` — no
 notion of multiplexed autoregressive streams): `LMEngine` provides
 continuous batching for causal-LM generation — many generation streams
-multiplexed into one compiled batched decode step.
+multiplexed into one compiled batched decode step. `PagedKVCache`
+(serving/kv_cache.py) lifts its concurrency past the slot count:
+fixed-size KV pages with radix prefix sharing, copy-on-write, and
+deterministic LRU eviction, enabled per engine via ``kv_page_size``.
 """
 
 from . import sampling
+from .kv_cache import PagedKVCache
 from .lm_engine import LMEngine, next_pow2_bucket
 from .tp_engine import TPLMEngine
 
-__all__ = ["LMEngine", "TPLMEngine", "next_pow2_bucket", "sampling"]
+__all__ = ["LMEngine", "PagedKVCache", "TPLMEngine", "next_pow2_bucket",
+           "sampling"]

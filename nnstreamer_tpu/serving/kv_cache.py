@@ -1,0 +1,490 @@
+"""Paged KV cache with radix-tree prefix sharing.
+
+The contiguous engine reserves one ``max_len`` KV region per slot, so
+concurrency is capped at ``n_slots`` and every short request strands the
+tail of its reservation (BENCH_r05: waste_frac 0.257 at 8 slots). This
+module replaces the reservation with fixed-size PAGES:
+
+- **Page pool.** One device-resident array pair per engine,
+  ``(n_pages + 1, L·H, page_size, head_dim)`` in the same flat per-slot
+  layout the decode kernels consume. Page 0 is the reserved NULL page:
+  table entries past a request's allocation point at it, so garbage
+  decode writes from empty/finished slots land somewhere harmless
+  (never-attended by the capacity invariant) instead of in live pages.
+- **Host-side allocator.** A FIFO free list plus per-request
+  RESERVATIONS: admission reserves ``ceil((T + max_new - 1)/ps)`` pages
+  up front (minus prefix hits), so any allocation made on behalf of an
+  admitted request is guaranteed to succeed — the scheduler never has
+  to unwind a half-dispatched chunk because a page ran out mid-flight.
+  Admission itself is gated on ``available()`` (free + evictable -
+  reserved), which is what lets hundreds of queued requests share a
+  pool sized for a handful of slots.
+- **Radix prefix sharing.** A radix tree over token-id chunks (one node
+  per FULL page of ``page_size`` tokens) content-addresses K/V pages:
+  two prompts sharing a prefix share the device pages for it (K/V of a
+  token depends only on its absolute-position prefix, so the bits are
+  identical by construction). Matching is refcounted: a hit pins the
+  whole matched path for the request's lifetime, because a page sitting
+  in an active slot's table must never be evicted underneath it.
+- **Copy-on-write.** Sharing is page-granular; a partial intra-page
+  match (common prompt prefix that ends mid-page) is served by COPYING
+  the best-matching child's page on device and letting the suffix
+  prefill overwrite from the divergence point — so writes only ever
+  land in exclusively-owned pages, which is the invariant that makes
+  the engine's gather/compute/scatter decode race-free.
+- **Deterministic LRU eviction + host offload.** Fully-released nodes
+  (ref == 0) queue for eviction in unpin order. Without
+  ``host_offload`` an evicted node and its (necessarily unpinned)
+  subtree leave the tree and their pages return to the free list; with
+  it, the page is copied D2H once and the node stays in the tree
+  page-less — a later prompt hitting it re-uploads instead of
+  recomputing the prefill.
+
+Economics surface as ``serving.kv_*`` metrics (pool gauges + prefix-hit
+/ evict counters, linted by scripts/check_metric_names.py) and a host
+``stats`` dict the bench lane reads (hit tokens, COW copies, pages
+peak). Prefix sharing follows the paged-attention / radix-attention
+lineage adapted to this repo's static-shape XLA discipline
+(docs/performance.md "Paged KV cache").
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import metrics as _obs
+
+__all__ = ["PagedKVCache", "PageNode", "AdmitPlan", "PageLease",
+           "empty_page_pool"]
+
+
+def empty_page_pool(n_pages: int, n_layers: int, n_heads: int,
+                    page_size: int, head_dim: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Zero K/V page pools, ``(n_pages + 1, L·H, page_size, head_dim)``.
+
+    The +1 is the reserved null page (id 0); usable pages are 1..n_pages.
+    Layout matches the engine's flat per-slot caches so a gathered run
+    of pages IS a contiguous cache view (models/causal_lm.paged_view).
+    """
+    shape = (n_pages + 1, n_layers * n_heads, page_size, head_dim)
+    return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _pool_set(pool, pid, page):
+    return pool.at[pid].set(page)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _pool_copy(pool, dst, src):
+    # the COW primitive: one on-device page copy, no host round-trip
+    return pool.at[dst].set(pool[src])
+
+
+class PageNode:
+    """One radix-tree node = one FULL page of tokens. ``key`` is the
+    page's token tuple; ``page`` its device page id (None when evicted
+    with a host offload copy in ``host_kv``); ``ref`` the pin count
+    (active requests whose table uses this page)."""
+
+    __slots__ = ("key", "parent", "children", "page", "host_kv", "ref")
+
+    def __init__(self, key: Optional[tuple], parent: "PageNode | None",
+                 page: Optional[int]) -> None:
+        self.key = key
+        self.parent = parent
+        self.children: Dict[tuple, PageNode] = {}
+        self.page = page
+        self.host_kv: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.ref = 0
+
+
+@dataclass
+class AdmitPlan:
+    """Pure lookup result — nothing is pinned/allocated until
+    :meth:`PagedKVCache.admit` commits it. ``nodes`` is the matched
+    radix path (full-page hits, device-resident or offloaded);
+    ``cow`` an optional (node, m) partial intra-page match served by
+    copy-on-write. The engine may ``drop_tail()`` to shrink the hit
+    until the padded suffix-prefill window fits the slot view."""
+
+    tokens: np.ndarray
+    page_size: int
+    nodes: List[PageNode]
+    cow: Optional[Tuple[PageNode, int]]
+
+    @property
+    def hit_len(self) -> int:
+        m = self.cow[1] if self.cow is not None else 0
+        return len(self.nodes) * self.page_size + m
+
+    def drop_tail(self) -> None:
+        """Shrink the hit by one unit: the COW tail first, then the
+        deepest matched node — lookup order reversed, so trimming is
+        deterministic."""
+        if self.cow is not None:
+            self.cow = None
+        elif self.nodes:
+            self.nodes.pop()
+
+
+@dataclass
+class PageLease:
+    """One admitted request's page bookkeeping. ``pages`` is the table
+    row source of truth (chunk order); ``own`` the subset owned outright
+    (freed or registered at release); ``nodes`` the pinned tree nodes
+    (unpinned at release); ``reserved`` pages still claimable from the
+    reservation."""
+
+    hit_len: int
+    pages: List[int] = field(default_factory=list)
+    own: Set[int] = field(default_factory=set)
+    nodes: List[PageNode] = field(default_factory=list)
+    reserved: int = 0
+
+
+class PagedKVCache:
+    """Device page pools + host allocator + radix prefix index.
+
+    The engine owns the scheduling; this class owns every page-lifetime
+    decision. Pools are plain attributes (``kpool``/``vpool``) that the
+    engine rebinds after donating them through its jitted kernels.
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, page_size: int,
+                 n_pages: int, head_dim: int, *,
+                 host_offload: bool = False, label: str = "lm") -> None:
+        if page_size < 1 or n_pages < 1:
+            raise ValueError("page_size and n_pages must be >= 1")
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.host_offload = host_offload
+        self.kpool, self.vpool = empty_page_pool(
+            n_pages, n_layers, n_heads, page_size, head_dim)
+        self.free: deque[int] = deque(range(1, n_pages + 1))
+        self.reserved = 0
+        self.root = PageNode(None, None, None)
+        #: ref-0 device-paged nodes in unpin order — the eviction queue
+        self._lru: "OrderedDict[PageNode, int]" = OrderedDict()
+        self._lru_seq = 0
+        self._shared = 0  # nodes pinned by >= 2 requests
+        self.stats = {"lookups": 0, "hit_requests": 0, "hit_tokens": 0,
+                      "prompt_tokens": 0, "cow_copies": 0, "evictions": 0,
+                      "offloads": 0, "reuploads": 0, "pages_peak": 0}
+        self._init_metrics(label)
+
+    def _init_metrics(self, label: str) -> None:
+        """serving.kv_* families (docs/observability.md naming +
+        scripts/check_metric_names.py kv placement rule). Gauges read
+        through a weakref so holding the registry never pins a retired
+        engine's device pools."""
+        import weakref
+
+        reg = _obs.registry()
+        ref = weakref.ref(self)
+        reg.gauge(
+            "nnstpu_serving_kv_total_pages",
+            "KV page-pool capacity (excludes the null page)",
+            ("engine",)).labels(label).set_function(
+                lambda: ref().n_pages if ref() is not None else 0)
+        reg.gauge(
+            "nnstpu_serving_kv_used_pages",
+            "KV pages currently allocated (shared + private)",
+            ("engine",)).labels(label).set_function(
+                lambda: ref().used_pages() if ref() is not None else 0)
+        reg.gauge(
+            "nnstpu_serving_kv_shared_pages",
+            "Prefix pages pinned by two or more live requests",
+            ("engine",)).labels(label).set_function(
+                lambda: ref().shared_pages() if ref() is not None else 0)
+        self._m_hit = reg.counter(
+            "nnstpu_serving_kv_prefix_hit_total",
+            "Prompt tokens served from shared prefix pages (skipped "
+            "prefill work)", ("engine",)).labels(label)
+        self._m_evict = reg.counter(
+            "nnstpu_serving_kv_evict_total",
+            "KV pages evicted from the pool (deterministic LRU)",
+            ("engine",)).labels(label)
+
+    # -- accounting -------------------------------------------------------- #
+
+    def used_pages(self) -> int:
+        return self.n_pages - len(self.free)
+
+    def shared_pages(self) -> int:
+        return self._shared
+
+    def available(self) -> int:
+        """Pages an admission may still claim: free + evictable minus
+        reservations already promised to admitted requests."""
+        return len(self.free) + len(self._lru) - self.reserved
+
+    # -- lookup / admit / release ------------------------------------------ #
+
+    def lookup(self, prompt: Any) -> AdmitPlan:
+        """Pure radix match (no pinning, no allocation): the longest
+        full-page path with device or offloaded K/V, plus the best
+        partial intra-page COW candidate below it. The hit is capped at
+        ``T - 1`` tokens — at least one prompt token must remain for the
+        suffix prefill to produce the first-token logits."""
+        self.stats["lookups"] += 1
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        t = int(prompt.size)
+        self.stats["prompt_tokens"] += t
+        ps = self.page_size
+        node, nodes = self.root, []
+        for k in range(max(0, (t - 1) // ps)):
+            key = tuple(int(x) for x in prompt[k * ps:(k + 1) * ps])
+            child = node.children.get(key)
+            if child is None or (child.page is None
+                                 and child.host_kv is None):
+                break
+            nodes.append(child)
+            node = child
+        cow = None
+        rest = prompt[len(nodes) * ps:]
+        cap_m = t - 1 - len(nodes) * ps
+        if cap_m > 0:
+            best = 0
+            # children iterate in insertion order — ties resolve
+            # deterministically to the earliest-registered page
+            for key, child in node.children.items():
+                if child.page is None:
+                    continue  # COW copies from device-resident pages only
+                lim = min(len(key), cap_m)
+                m = 0
+                while m < lim and key[m] == int(rest[m]):
+                    m += 1
+                if m > best:
+                    best, cow = m, (child, m)
+        return AdmitPlan(tokens=prompt, page_size=ps, nodes=nodes, cow=cow)
+
+    def admissible(self, plan: AdmitPlan, b_needed: int) -> bool:
+        """Can this plan be committed right now? ``b_needed`` is the
+        request's full page budget ceil((T + max_new - 1)/ps). Counts
+        the fresh pages needed (budget minus device-resident hits) plus
+        the ref-0 matched nodes admission would pull OUT of the
+        eviction queue — both reduce what the pool can still promise."""
+        d = sum(1 for nd in plan.nodes if nd.page is not None)
+        pins = sum(1 for nd in plan.nodes
+                   if nd.ref == 0 and nd.page is not None)
+        if plan.cow is not None and plan.cow[0].ref == 0:
+            pins += 1
+        return self.available() >= (b_needed - d) + pins
+
+    def admit(self, plan: AdmitPlan, b_needed: int) -> PageLease:
+        """Commit a plan: pin the matched path, re-upload offloaded
+        pages, COW-copy the partial match, allocate private prompt
+        pages, and register the prompt's remaining full chunks as
+        pinned nodes (so a second request admitted one iteration later
+        shares them). Caller must have checked :meth:`admissible`."""
+        ps = self.page_size
+        prompt = plan.tokens
+        t = int(prompt.size)
+        d = sum(1 for nd in plan.nodes if nd.page is not None)
+        reserve_n = b_needed - d
+        self.reserved += reserve_n
+        lease = PageLease(hit_len=plan.hit_len, reserved=reserve_n)
+        for nd in plan.nodes:
+            self._pin(nd)
+            lease.nodes.append(nd)
+        cow_src = None
+        if plan.cow is not None:
+            cow_src = plan.cow[0]
+            # keep the source resident while allocation may evict
+            self._pin(cow_src)
+        try:
+            for nd in plan.nodes:
+                if nd.page is None:
+                    self._upload(nd, self._lease_alloc(lease))
+            lease.pages = [nd.page for nd in plan.nodes]
+            if cow_src is not None:
+                pid = self._lease_alloc(lease)
+                self._copy_page(pid, cow_src.page)
+                lease.pages.append(pid)
+                lease.own.add(pid)
+                self.stats["cow_copies"] += 1
+        finally:
+            if cow_src is not None:
+                self._unpin(cow_src)
+        while len(lease.pages) < -(-t // ps):
+            pid = self._lease_alloc(lease)
+            lease.pages.append(pid)
+            lease.own.add(pid)
+        # full prompt chunks beyond the hit become pinned tree nodes NOW
+        # (their content is valid the moment the admission prefill's
+        # writes land — device ordering by pool-array dataflow)
+        self._register(lease, prompt, t // ps, pin=True)
+        if plan.hit_len:
+            self.stats["hit_requests"] += 1
+            self.stats["hit_tokens"] += plan.hit_len
+            self._m_hit.inc(plan.hit_len)
+        return lease
+
+    def lease_alloc(self, lease: PageLease) -> int:
+        """Allocate one decode page against the lease's reservation
+        (guaranteed to succeed — the reservation was gated at
+        admission) and return its id; the caller owns the table write."""
+        pid = self._lease_alloc(lease)
+        lease.pages.append(pid)
+        lease.own.add(pid)
+        return pid
+
+    def release(self, lease: PageLease, seq: np.ndarray) -> None:
+        """Retire a request: register the generated full pages (``seq``
+        = prompt + consumed output tokens — exactly the positions with
+        valid K/V) at ref 0, unpin the matched/created path, free the
+        rest, and return the unused reservation."""
+        full = min(int(np.asarray(seq).size) // self.page_size,
+                   len(lease.pages))
+        self._register(lease, np.asarray(seq, np.int32), full, pin=False)
+        for nd in lease.nodes:
+            self._unpin(nd)
+        lease.nodes = []
+        for pid in lease.pages:
+            if pid in lease.own:
+                self.free.append(pid)
+        lease.own.clear()
+        self.reserved -= lease.reserved
+        lease.reserved = 0
+
+    # -- internals --------------------------------------------------------- #
+
+    def _register(self, lease: PageLease, seq: np.ndarray, upto: int,
+                  pin: bool) -> None:
+        """Walk/extend the radix path for ``seq``'s first ``upto`` full
+        chunks, donating the lease's owned pages to new nodes. An
+        existing node with a device page wins (our duplicate page stays
+        owned → freed at release); an offloaded node ADOPTS our page —
+        same chunk path means bit-identical content."""
+        node = self.root
+        ps = self.page_size
+        for k in range(upto):
+            key = tuple(int(x) for x in seq[k * ps:(k + 1) * ps])
+            pid = lease.pages[k]
+            child = node.children.get(key)
+            if child is not None:
+                if child.page is None and pid in lease.own:
+                    child.page = pid
+                    lease.own.discard(pid)
+                    if pin:
+                        self._pin(child)
+                        lease.nodes.append(child)
+                    else:
+                        self._lru_push(child)
+                node = child
+                continue
+            if pid not in lease.own:
+                # a shared page under an unregistered chunk — the
+                # matched path always covers shared pages, so stop
+                break
+            child = PageNode(key, node, pid)
+            node.children[key] = child
+            lease.own.discard(pid)
+            if pin:
+                self._pin(child)
+                lease.nodes.append(child)
+            else:
+                self._lru_push(child)
+            node = child
+
+    def _pin(self, nd: PageNode) -> None:
+        if nd.ref == 0:
+            self._lru.pop(nd, None)
+        nd.ref += 1
+        if nd.ref == 2:
+            self._shared += 1
+
+    def _unpin(self, nd: PageNode) -> None:
+        nd.ref -= 1
+        if nd.ref == 1:
+            self._shared -= 1
+        if nd.ref == 0 and nd.page is not None:
+            self._lru_push(nd)
+
+    def _lru_push(self, nd: PageNode) -> None:
+        self._lru_seq += 1
+        self._lru[nd] = self._lru_seq
+
+    def _lease_alloc(self, lease: PageLease) -> int:
+        if lease.reserved <= 0:
+            raise RuntimeError(
+                "KV page allocation outside the request's reservation — "
+                "scheduler accounting bug")
+        lease.reserved -= 1
+        self.reserved -= 1
+        return self._alloc()
+
+    def _alloc(self) -> int:
+        while not self.free and self._lru:
+            self._evict_one()
+        if not self.free:
+            raise RuntimeError(
+                "KV page pool exhausted despite reservation — "
+                "allocator accounting bug")
+        pid = self.free.popleft()
+        used = self.used_pages()
+        if used > self.stats["pages_peak"]:
+            self.stats["pages_peak"] = used
+        return pid
+
+    def _evict_one(self) -> None:
+        """Evict the least-recently-unpinned ref-0 node. Deterministic:
+        the queue orders by unpin sequence and the free list is FIFO,
+        so identical workloads evict (and reuse) identical pages."""
+        nd = next(iter(self._lru))
+        del self._lru[nd]
+        if self.host_offload:
+            if nd.host_kv is None:
+                # one blocking D2H per cold page, amortized across every
+                # future re-upload (content is immutable once registered)
+                nd.host_kv = (np.asarray(jax.device_get(self.kpool[nd.page])),
+                              np.asarray(jax.device_get(self.vpool[nd.page])))
+                self.stats["offloads"] += 1
+            self.free.append(nd.page)
+            nd.page = None
+            self.stats["evictions"] += 1
+            self._m_evict.inc()
+        else:
+            freed = self._drop_subtree(nd)
+            self.stats["evictions"] += freed
+            self._m_evict.inc(freed)
+
+    def _drop_subtree(self, nd: PageNode) -> int:
+        """Remove ``nd`` and its subtree from the tree, freeing every
+        device page. Safe unpinned-only: a pinned descendant would pin
+        the whole path including ``nd`` (requests pin every matched
+        node root-to-leaf), and ``nd`` came off the ref-0 queue."""
+        if nd.parent is not None:
+            nd.parent.children.pop(nd.key, None)
+        freed, stack = 0, [nd]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children.clear()
+            self._lru.pop(n, None)
+            if n.page is not None:
+                self.free.append(n.page)
+                n.page = None
+                freed += 1
+            n.parent = None
+        return freed
+
+    def _upload(self, nd: PageNode, pid: int) -> None:
+        k_np, v_np = nd.host_kv
+        self.kpool = _pool_set(self.kpool, jnp.int32(pid), jnp.asarray(k_np))
+        self.vpool = _pool_set(self.vpool, jnp.int32(pid), jnp.asarray(v_np))
+        nd.page = pid  # host_kv kept: future evictions skip the D2H
+        self.stats["reuploads"] += 1
+
+    def _copy_page(self, dst: int, src: int) -> None:
+        self.kpool = _pool_copy(self.kpool, jnp.int32(dst), jnp.int32(src))
+        self.vpool = _pool_copy(self.vpool, jnp.int32(dst), jnp.int32(src))

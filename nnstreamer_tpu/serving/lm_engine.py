@@ -26,6 +26,18 @@ XLA-shaped design decisions:
   vmap construction). ``chunk=1`` gives lowest admission latency;
   larger chunks amortize dispatch (through a high-RTT link they are the
   difference between RTT-bound and compute-bound serving).
+- **Paged KV cache (opt-in: ``kv_page_size > 0``).** The per-slot
+  contiguous stores are replaced by one shared page pool
+  (serving/kv_cache.py): admission is gated on page availability
+  instead of slot-sized reservations (so the request backlog is bounded
+  by memory actually used, not slots x max_len), prompts sharing a
+  prefix share its device pages (radix lookup + copy-on-write), and
+  each jitted step gathers a slot's pages into the exact contiguous
+  layout, runs the SAME kernels, and scatters back only the touched
+  pages — greedy outputs stay bit-identical to the contiguous path
+  (tests/test_kv_paging.py). ``kv_slot_pages`` bounds a slot's gathered
+  view (its effective max_len), which is what keeps S slots' transient
+  views inside a slot-equivalent memory budget.
 
 Greedy-exactness contract: every stream's output matches isolated
 single-stream generation token-for-token regardless of what shares the
@@ -39,6 +51,7 @@ engine-backed worker, generated sequences flowing back per request.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -57,6 +70,19 @@ from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
 from ..resilience import policy as _rp
 from . import sampling
+from .kv_cache import PagedKVCache
+
+
+def _env_int(name: str) -> Optional[int]:
+    """Parse an optional integer env knob; empty/unset -> None, junk
+    raises with the variable named (typo-proof, like NNS_TPU_CHAOS)."""
+    v = os.environ.get(name, "")
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
 
 
 def next_pow2_bucket(n: int, lo: int = 16) -> int:
@@ -94,10 +120,12 @@ def _slot_insert(store, value, slot):
         (slot,) + (0,) * value.ndim)
 
 
-@partial(jax.jit, static_argnames=("n_heads", "n_steps"),
-         donate_argnums=(1, 2, 3, 4))
-def _decode_chunk(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
-                  n_heads, n_steps):
+def _chunk_scan(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
+                n_heads, n_steps):
+    """The n_steps decode scan over per-slot caches — ONE body shared by
+    the contiguous chunk and the paged chunk (which runs it on gathered
+    page views; the step kernels read capacity from the cache shape, so
+    the body is layout-agnostic)."""
     def one(carry, _):
         tokens, kc, vc, pos = carry
         logits, kc, vc, pos = causal_lm.lm_decode_step_slots(
@@ -123,6 +151,36 @@ def _decode_chunk(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
     return tokens, kc, vc, pos, outs.T  # outs (S, n_steps)
 
 
+@partial(jax.jit, static_argnames=("n_heads", "n_steps"),
+         donate_argnums=(1, 2, 3, 4))
+def _decode_chunk(params, tokens, kc, vc, pos, skeys, temp, top_k, top_p,
+                  n_heads, n_steps):
+    return _chunk_scan(params, tokens, kc, vc, pos, skeys, temp, top_k,
+                       top_p, n_heads, n_steps)
+
+
+@partial(jax.jit, static_argnames=("n_heads", "n_steps"),
+         donate_argnums=(1, 2, 3, 5))
+def _decode_chunk_paged(params, tokens, kpool, vpool, tables, pos, skeys,
+                        temp, top_k, top_p, n_heads, n_steps):
+    """Paged decode chunk: gather each slot's pages into a contiguous
+    view ONCE per chunk, run the shared scan on the views (in-place
+    dynamic_update_slice writes per step, same as contiguous), scatter
+    back only the pages an n_steps window can touch. The gather/scatter
+    cost amortizes over the whole chunk, not per token."""
+    kviews = causal_lm.paged_view_slots(kpool, tables)
+    vviews = causal_lm.paged_view_slots(vpool, tables)
+    p0s = pos[:, 0]
+    tokens, kviews, vviews, pos, outs = _chunk_scan(
+        params, tokens, kviews, vviews, pos, skeys, temp, top_k, top_p,
+        n_heads, n_steps)
+    nt = causal_lm.paged_touch_span(
+        n_steps, kpool.shape[2], tables.shape[1])
+    kpool = causal_lm.paged_update_slots(kpool, kviews, tables, p0s, nt)
+    vpool = causal_lm.paged_update_slots(vpool, vviews, tables, p0s, nt)
+    return tokens, kpool, vpool, pos, outs
+
+
 @partial(jax.jit, static_argnames=("n_heads",),
          donate_argnums=(2, 3, 4))
 def _verify_chunk(params, tokens_in, kc, vc, pos, n_heads):
@@ -145,6 +203,53 @@ def _verify_chunk(params, tokens_in, kc, vc, pos, n_heads):
     carried, pos_m, greedy, m = _accept_from_window(
         tokens_in, logits, pos_w)
     return carried, kc, vc, pos_m, greedy, m
+
+
+@partial(jax.jit, static_argnames=("n_heads",),
+         donate_argnums=(2, 3, 5))
+def _verify_chunk_paged(params, tokens_in, kpool, vpool, tables, pos,
+                        n_heads):
+    """Speculative verify against paged caches: the same acceptance
+    logic on `lm_verify_window_paged`'s gathered-view logits. Rejected
+    drafts' K/V land in pages the slot owns exclusively (or the null
+    page past its reservation) and are overwritten before visible —
+    the contiguous roll-back-by-pos invariant survives paging intact."""
+    logits, kpool, vpool, pos_w = causal_lm.lm_verify_window_paged(
+        params, tokens_in, kpool, vpool, tables, pos, n_heads)
+    carried, pos_m, greedy, m = _accept_from_window(
+        tokens_in, logits, pos_w)
+    return carried, kpool, vpool, pos_m, greedy, m
+
+
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(2, 3))
+def _prefill_paged_admit(params, window, kpool, vpool, table, pos0,
+                         true_len, skey, temp, top_k, top_p, n_heads):
+    """Prefix-hit admission: prefill only the padded SUFFIX window into
+    the slot's pages at pos0 = hit length. The sampling key folds in
+    ``pos0 + true_len`` — the TOTAL tokens consumed — so a prefix-hit
+    admission draws the same first token as a full prefill of the same
+    prompt (the (seed, consumed) schedule is position-based, not
+    dispatch-based)."""
+    logits, kpool, vpool, pos = causal_lm.lm_prefill_paged(
+        params, window, kpool, vpool, table, pos0, true_len, n_heads)
+    first = sampling.sample_row(
+        logits[0], jax.random.fold_in(skey, pos0 + true_len),
+        temp, top_k, top_p)
+    return first, kpool, vpool, pos
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _install_pages(kpool, vpool, kc, vc, table):
+    """Scatter a freshly prefilled contiguous slot cache (the no-hit
+    admission path reuses `_prefill_admit` unchanged) into the slot's
+    pages. Table rows past the prompt's pages hold the null page —
+    the padded tail's garbage K/V lands there, never in live pages."""
+    lh, m, hd = kc.shape
+    b = table.shape[0]
+    ps = m // b
+    kpages = kc.reshape(lh, b, ps, hd).transpose(1, 0, 2, 3)
+    vpages = vc.reshape(lh, b, ps, hd).transpose(1, 0, 2, 3)
+    return kpool.at[table].set(kpages), vpool.at[table].set(vpages)
 
 
 def _accept_from_window(tokens_in, logits, pos_w):
@@ -179,6 +284,9 @@ class _Request:
     #: resilience.policy.Deadline (or None): checked at submit and again
     #: at admission — expired work is shed, not prefilled
     deadline: Any = None
+    #: kv_cache.PageLease while admitted under paging (None otherwise):
+    #: the request's page-table bookkeeping, released at retirement
+    kv_lease: Any = None
     # tracing (None when tracing is off at submit time): the request
     # span parents admission-wait / prefill / compile / decode children
     span: Any = None            # serving.request — submit → retire
@@ -193,12 +301,27 @@ class LMEngine:
     decode batch (slot) count; ``chunk`` the decode steps per scheduler
     iteration. ``bucket`` maps a prompt length to its padded prefill
     length (defaults to power-of-two buckets capped at max_len).
+
+    Paged KV cache (serving/kv_cache.py): ``kv_page_size`` > 0 swaps
+    the per-slot contiguous stores for a shared page pool of
+    ``kv_pages`` pages with radix prefix sharing; ``kv_slot_pages``
+    bounds one request's capacity (pages x page_size tokens, default
+    max_len worth); ``kv_host_offload`` keeps evicted cold pages in
+    host RAM for re-upload instead of recomputing. All four default
+    from NNS_LM_KV_PAGE_SIZE / NNS_LM_KV_PAGES / NNS_LM_KV_SLOT_PAGES /
+    NNS_LM_KV_OFFLOAD so `nns-launch --kv-page-size/--kv-pages` reach
+    engines constructed anywhere; an explicit ``kv_page_size=0`` pins
+    the contiguous path regardless of environment.
     """
 
     def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
                  n_slots: int = 4, chunk: int = 8,
                  bucket=None, gang: bool = False,
-                 spec_draft: int = 0) -> None:
+                 spec_draft: int = 0,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 kv_slot_pages: Optional[int] = None,
+                 kv_host_offload: Optional[bool] = None) -> None:
         if n_slots < 1 or chunk < 1:
             raise ValueError("n_slots and chunk must be >= 1")
         if spec_draft < 0 or spec_draft + 1 > max_len:
@@ -224,11 +347,51 @@ class LMEngine:
             lambda n: min(next_pow2_bucket(n), max_len))
         L = stack_shape(params["wqkv"])[0]
         hd = params["embed"].shape[1] // n_heads
+        # paged-KV config: explicit kwargs win; unset ones fall back to
+        # the NNS_LM_KV_* environment (the nns-launch flag transport)
+        ps = kv_page_size if kv_page_size is not None \
+            else (_env_int("NNS_LM_KV_PAGE_SIZE") or 0)
+        if ps < 0:
+            raise ValueError("kv_page_size must be >= 0 (0 = contiguous)")
+        self._kv: Optional[PagedKVCache] = None
+        self._m_slot = max_len  # one request's token capacity
+        if ps:
+            if max_len % ps:
+                raise ValueError(
+                    f"kv_page_size={ps} must divide max_len={max_len}")
+            slot_pages = kv_slot_pages if kv_slot_pages is not None \
+                else (_env_int("NNS_LM_KV_SLOT_PAGES") or max_len // ps)
+            if not 1 <= slot_pages <= max_len // ps:
+                raise ValueError(
+                    f"kv_slot_pages={slot_pages} outside "
+                    f"[1, max_len/page_size={max_len // ps}]")
+            self._m_slot = slot_pages * ps
+            if spec_draft + 1 > self._m_slot:
+                raise ValueError(
+                    f"spec_draft={spec_draft} needs kv_slot_pages * "
+                    f"kv_page_size > spec_draft (got {self._m_slot})")
+            n_pages = kv_pages if kv_pages is not None \
+                else (_env_int("NNS_LM_KV_PAGES")
+                      or n_slots * slot_pages)
+            offload = kv_host_offload if kv_host_offload is not None \
+                else os.environ.get("NNS_LM_KV_OFFLOAD", "") == "1"
+            self._kv = PagedKVCache(
+                L, n_heads, ps, n_pages, hd, host_offload=bool(offload),
+                label=self._engine_label)
+            self._kv_slot_pages = slot_pages
+            #: per-slot page tables, mirrored on host (the scheduler is
+            #: the only writer); row entries past a request's allocated
+            #: pages hold the null page 0
+            self._table_host = np.zeros((n_slots, slot_pages), np.int32)
         # device-resident slot state (leading axis = slot); cache
         # allocation is a hook so a mesh-sharded engine never
-        # materializes the unsharded stores (serving/tp_engine.py)
+        # materializes the unsharded stores (serving/tp_engine.py);
+        # the paged path has no per-slot stores at all — its K/V live
+        # in the shared page pool
         self._tokens = jnp.zeros((n_slots, 1, 1), jnp.int32)
-        self._kc, self._vc = self._alloc_slot_caches(L, hd)
+        self._kc = self._vc = None
+        if self._kv is None:
+            self._kc, self._vc = self._alloc_slot_caches(L, hd)
         self._pos = jnp.zeros((n_slots, 1), jnp.int32)
         # per-slot sampling controls (traced values — greedy and sampled
         # streams share one executable; see serving/sampling.py)
@@ -382,6 +545,21 @@ class LMEngine:
             raise ValueError(
                 f"prompt ({p.size}) + max_new ({max_new}) exceeds cache "
                 f"capacity max_len={self.max_len}")
+        if self._kv is not None:
+            if p.size + max_new - 1 > self._m_slot:
+                self._reject("prompt + max_new exceeds paged slot view")
+                raise ValueError(
+                    f"prompt ({p.size}) + max_new ({max_new}) exceeds "
+                    f"paged per-request capacity kv_slot_pages * "
+                    f"kv_page_size = {self._m_slot}")
+            need = -(-(p.size + max_new - 1) // self._kv.page_size)
+            if need > self._kv.n_pages:
+                # would deadlock admission: even an empty pool could
+                # never cover this request's reservation
+                self._reject("request page budget exceeds pool")
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool has "
+                    f"only kv_pages={self._kv.n_pages}")
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(
@@ -464,6 +642,12 @@ class LMEngine:
     def results(self) -> Dict[int, List[int]]:
         return dict(self._finished)
 
+    @property
+    def kv_stats(self) -> Optional[Dict[str, int]]:
+        """Paged-KV-cache counters (hit/prompt tokens, COW copies,
+        evictions, pages_peak, ...) or None when running contiguous."""
+        return None if self._kv is None else dict(self._kv.stats)
+
     # -- scheduler internals ---------------------------------------------- #
 
     def _admit(self) -> None:
@@ -481,16 +665,35 @@ class LMEngine:
                 req = self._queue.popleft() if self._queue else None
             if req is None:
                 continue
+            plan = None
+            if self._kv is not None:
+                plan = self._paged_plan(req)
+                if plan is None:
+                    # the pool cannot cover this request's page
+                    # reservation yet: requeue at the FRONT (FIFO — no
+                    # starvation by smaller latecomers) and stop
+                    # admitting; pages free as active streams retire
+                    self._queue.appendleft(req)
+                    break
             if req.wait_span is not None:
                 req.wait_span.end()
             t = int(req.prompt.size)
-            tb = self._bucket(t)
+            hit = self._paged_admit(slot, req, plan) \
+                if self._kv is not None else 0
+            ts = t - hit  # suffix tokens the prefill must still compute
+            tb = self._bucket(t) if self._kv is None \
+                else min(self._bucket(ts), self._m_slot)
             padded = np.zeros((1, tb), np.int32)
-            padded[0, :t] = req.prompt
+            padded[0, :ts] = req.prompt[hit:]
             skey = sampling.seed_key(req.seed)
             temp = jnp.float32(req.temperature)
             tk, tp = jnp.int32(req.top_k), jnp.float32(req.top_p)
-            first_use = tb not in self._seen_buckets
+            # paged executables are distinct from contiguous ones (and
+            # the prefix-hit suffix prefill from the no-hit install), so
+            # they warm separate bucket entries / compile counters
+            bkey: Any = tb if self._kv is None else ("kv", hit > 0, tb)
+            blabel = str(tb) if self._kv is None or not hit else f"kv{tb}"
+            first_use = bkey not in self._seen_buckets
             pspan = cspan = _tracing.NOOP_SPAN
             if req.span is not None:
                 if first_use:
@@ -503,14 +706,19 @@ class LMEngine:
                 pspan = _tracing.start_span(
                     "serving.prefill", parent=req.span.context,
                     attrs={"bucket": tb, "slot": slot})
-            first = self._prefill_into(slot, padded, t, skey, temp, tk, tp)
+            if self._kv is None:
+                first = self._prefill_into(
+                    slot, padded, t, skey, temp, tk, tp)
+            else:
+                first = self._prefill_paged(
+                    slot, padded, hit, ts, skey, temp, tk, tp)
             cspan.end()
             self.stats["prefills"] += 1
             lbl = self._engine_label
-            self._m_prefills.labels(lbl, str(tb)).inc()
+            self._m_prefills.labels(lbl, blabel).inc()
             if first_use:
-                self._seen_buckets.add(tb)
-                self._m_compiles.labels(lbl, str(tb)).inc()
+                self._seen_buckets.add(bkey)
+                self._m_compiles.labels(lbl, blabel).inc()
             self._m_streams.labels(lbl, "admitted").inc()
             sl = jnp.int32(slot)
             self._tokens = _slot_insert(
@@ -548,12 +756,93 @@ class LMEngine:
         self._pos = _slot_insert(self._pos, pos, sl)
         return first
 
+    # -- paged-KV scheduling ---------------------------------------------- #
+
+    def _paged_plan(self, req: "_Request"):
+        """Radix lookup + hit trimming + admissibility for one queued
+        request. Returns the committed-to plan, or None while the pool
+        cannot cover the request's page reservation."""
+        kv = self._kv
+        t = int(req.prompt.size)
+        plan = kv.lookup(req.prompt)
+        # the suffix prefills as a PADDED window at pos0 = hit, so the
+        # hit plus the padded bucket width must fit the slot view; trim
+        # the hit (COW tail first, then deepest node) until it does
+        while plan.hit_len and plan.hit_len + min(
+                self._bucket(t - plan.hit_len), self._m_slot) \
+                > self._m_slot:
+            plan.drop_tail()
+        b_needed = -(-(t + req.max_new - 1) // kv.page_size)
+        return plan if kv.admissible(plan, b_needed) else None
+
+    def _paged_admit(self, slot: int, req: "_Request", plan) -> int:
+        """Commit the plan — pin shared pages, COW-copy the partial
+        match, allocate private prompt pages — and write the slot's
+        page-table row. Returns the prefix-hit length in tokens (the
+        suffix prefill starts there)."""
+        kv = self._kv
+        t = int(req.prompt.size)
+        b_needed = -(-(t + req.max_new - 1) // kv.page_size)
+        lease = kv.admit(plan, b_needed)
+        req.kv_lease = lease
+        row = np.zeros(self._kv_slot_pages, np.int32)
+        row[:len(lease.pages)] = lease.pages
+        self._table_host[slot] = row
+        return lease.hit_len
+
+    def _prefill_paged(self, slot: int, padded, hit: int, true_len: int,
+                       skey, temp, tk, tp):
+        """Prefill into the slot's pages: the no-hit path runs the
+        UNCHANGED contiguous prefill at the slot-view capacity and
+        scatters the result into pages (bit-identical by construction);
+        a prefix hit prefills only the padded suffix window at pos0 =
+        hit against the gathered view."""
+        kv = self._kv
+        table = jnp.asarray(self._table_host[slot])
+        if hit == 0:
+            first, kc, vc, pos = _prefill_admit(
+                self.params, jnp.asarray(padded), jnp.int32(true_len),
+                skey, temp, tk, tp,
+                n_heads=self.n_heads, max_len=self._m_slot)
+            kv.kpool, kv.vpool = _install_pages(
+                kv.kpool, kv.vpool, kc, vc, table)
+        else:
+            first, kv.kpool, kv.vpool, pos = _prefill_paged_admit(
+                self.params, jnp.asarray(padded), kv.kpool, kv.vpool,
+                table, jnp.int32(hit), jnp.int32(true_len),
+                skey, temp, tk, tp, n_heads=self.n_heads)
+        self._pos = _slot_insert(self._pos, pos, jnp.int32(slot))
+        return first
+
+    def _ensure_pages(self, active: List[int], w: int) -> None:
+        """Grow active slots' page tables to cover the next ``w``
+        write positions (capped at each request's reservation bound —
+        writes past it route to the null page by table construction).
+        Allocation cannot fail: admission reserved the full budget."""
+        kv = self._kv
+        ps = kv.page_size
+        for s in active:
+            req = self._slot_req[s]
+            lease = req.kv_lease
+            bound = int(req.prompt.size) + req.max_new - 1
+            need = -(-min(self._pos_host[s] + w, bound) // ps)
+            while len(lease.pages) < need:
+                pid = kv.lease_alloc(lease)
+                self._table_host[s, len(lease.pages) - 1] = pid
+
     def _decode(self) -> None:
         active = [s for s, r in enumerate(self._slot_req) if r is not None]
         if not active:
             return
-        if self.spec_draft > 0 and self.max_len - max(
-                self._pos_host[s] for s in active) >= self.spec_draft + 1 \
+        # capacity headroom is PER-REQUEST capacity: max_len contiguous,
+        # the kv_slot_pages * page_size view bound under paging. The old
+        # max_len comparison would either let speculation NaN-poison a
+        # bounded view (m_slot < max_len) or was simply the same number;
+        # page-pool headroom is NOT a gate — admission reserved every
+        # active request's full page budget, so _ensure_pages below
+        # always succeeds
+        headroom = self._m_slot - max(self._pos_host[s] for s in active)
+        if self.spec_draft > 0 and headroom >= self.spec_draft + 1 \
                 and all(self._slot_req[s].temperature <= 0.0
                         for s in active) \
                 and any(self._slot_req[s].max_new - len(self._slot_req[s].out)
@@ -568,13 +857,15 @@ class LMEngine:
             # stream can only accept one token per dispatch (its draw is
             # sequential by definition), so any batch containing one is
             # served strictly better by chunked decode
+            if self._kv is not None:
+                self._ensure_pages(active, self.spec_draft + 1)
             self._decode_speculative(active)
             return
         # cap the chunk so no ACTIVE slot decodes past cache capacity
         # (an overflowing row NaN-poisons itself by contract); submit()'s
         # `prompt + max_new - 1 <= max_len` guard keeps cap >= 1 for
         # every active slot, so this never clamps to a forced overflow
-        cap = self.max_len - max(self._pos_host[s] for s in active)
+        cap = headroom
         remaining = max(r.max_new - len(r.out) for r in self._slot_req
                         if r is not None)
         n = max(1, min(self.chunk, cap, remaining))
@@ -585,6 +876,8 @@ class LMEngine:
             # one per tail length (full-size chunks keep the user's
             # exact value, whatever it is)
             n = 1 << (n.bit_length() - 1)
+        if self._kv is not None:
+            self._ensure_pages(active, n)
         t0 = time.monotonic()
         outs = np.asarray(self._run_chunk(n))  # (S, n)
         self._m_tok_lat.observe((time.monotonic() - t0) / n)
@@ -612,7 +905,17 @@ class LMEngine:
     def _run_chunk(self, n: int):
         """Run ``n`` decode steps over all slots, updating the carried
         device state; returns the (S, n) generated tokens. The second
-        device-layout hook a mesh-sharded engine overrides."""
+        device-layout hook a mesh-sharded engine overrides (the paged
+        branch never reaches a TP engine — it pins kv_page_size=0)."""
+        if self._kv is not None:
+            kv = self._kv
+            (self._tokens, kv.kpool, kv.vpool, self._pos, outs) = \
+                _decode_chunk_paged(
+                    self.params, self._tokens, kv.kpool, kv.vpool,
+                    jnp.asarray(self._table_host), self._pos,
+                    self._skeys, self._temp, self._topk, self._topp,
+                    n_heads=self.n_heads, n_steps=n)
+            return outs
         self._tokens, self._kc, self._vc, self._pos, outs = \
             _decode_chunk(self.params, self._tokens, self._kc,
                           self._vc, self._pos, self._skeys,
@@ -623,6 +926,14 @@ class LMEngine:
     def _run_verify(self, tokens_in):
         """Device kernel hook for one speculative verify iteration —
         the TP engine swaps in its mesh-sharded verify chunk."""
+        if self._kv is not None:
+            kv = self._kv
+            carried, kv.kpool, kv.vpool, pos, outs, m = \
+                _verify_chunk_paged(
+                    self.params, tokens_in, kv.kpool, kv.vpool,
+                    jnp.asarray(self._table_host), self._pos,
+                    n_heads=self.n_heads)
+            return carried, self._kc, self._vc, pos, outs, m
         return _verify_chunk(self.params, tokens_in, self._kc, self._vc,
                              self._pos, n_heads=self.n_heads)
 
@@ -714,6 +1025,15 @@ class LMEngine:
             self._m_tokens.inc(len(req.out))
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None
+            if self._kv is not None and req.kv_lease is not None:
+                # positions 0..consumed-1 hold valid K/V (the final
+                # output token was never written back); register those
+                # full pages as shareable prefix nodes, free the rest
+                seq = req.prompt if len(req.out) <= 1 else np.concatenate(
+                    [req.prompt, np.asarray(req.out[:-1], np.int32)])
+                self._kv.release(req.kv_lease, seq)
+                req.kv_lease = None
+                self._table_host[slot] = 0
             if req.temperature > 0.0:
                 # restore greedy defaults so a finished sampled stream
                 # doesn't keep the all-greedy fast path (and the

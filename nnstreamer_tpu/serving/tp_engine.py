@@ -206,6 +206,16 @@ class TPLMEngine(LMEngine):
         if n_heads % n:
             raise ValueError(f"n_heads={n_heads} not divisible by "
                              f"mesh axis {axis}={n}")
+        if any(kw.get(k) for k in ("kv_page_size", "kv_pages",
+                                   "kv_slot_pages", "kv_host_offload")):
+            raise ValueError(
+                "TPLMEngine does not support the paged KV cache (kv_* "
+                "options): its slot caches shard by head over the mesh; "
+                "use the single-device LMEngine for paging")
+        # pin the contiguous path so the NNS_LM_KV_* environment (the
+        # nns-launch flag transport) can never silently enable paging
+        # on a sharded engine
+        kw["kv_page_size"] = 0
         # set before super().__init__: _alloc_slot_caches reads these
         self.mesh, self.axis, self._n = mesh, axis, n
         super().__init__(params, n_heads, max_len, **kw)

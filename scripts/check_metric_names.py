@@ -9,7 +9,7 @@ Metric convention (docs/observability.md): every metric is
   * counters    ending in ``_total``,
   * histograms  ending in ``_seconds``,
   * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes`` /
-    ``_state``,
+    ``_state`` / ``_pages``,
   * label keys matching ``[a-z_][a-z0-9_]*``, never the reserved
     ``instance``/``role`` (appended by fleet federation) or ``le``
     (histogram encoder), and at most 8 keys per family (cardinality
@@ -24,6 +24,14 @@ every flight-recorder event type is the same lowercase dotted
 ``<layer>.<event>`` shape, with layer additionally allowing {core, obs}
 (the log bridge and the obs subsystem itself emit events) — e.g.
 ``pipeline.stall``, ``query.reconnect_storm``, ``core.log``.
+
+KV-cache placement (docs/performance.md "Paged KV cache"): every
+``serving`` metric whose body starts with ``kv_`` belongs to the paged
+KV cache and is registered in nnstreamer_tpu/serving/ — no other
+package invents ``kv_*`` serving series, and the ``pages`` gauge unit
+is reserved for those bodies (a ``_pages`` gauge outside the kv family
+is a naming drift, not a new convention). check_kv enforces both
+directions, mirroring check_resilience.
 
 Resilience placement (docs/resilience.md): the ``resilience``/``chaos``
 metric + event layers belong to nnstreamer_tpu/resilience/ — every
@@ -59,8 +67,9 @@ LAYERS = ("pipeline", "query", "serving", "resilience", "chaos")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
-    # _state: enumerated-condition gauges (e.g. breaker 0/1/2)
-    "gauge": ("depth", "slots", "bytes", "state"),
+    # _state: enumerated-condition gauges (e.g. breaker 0/1/2);
+    # _pages: KV-page pool occupancy (serving kv_ family only)
+    "gauge": ("depth", "slots", "bytes", "state", "pages"),
 }
 #: span layers add "device" — device.xprof has no metric series
 SPAN_LAYERS = ("pipeline", "query", "serving", "device")
@@ -76,6 +85,11 @@ EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
 RESILIENCE_LAYERS = frozenset({"resilience", "chaos"})
 RESILIENCE_DIR = "resilience"
+
+#: the paged KV cache owns the ``kv_``-prefixed serving bodies and the
+#: ``pages`` gauge unit: both must stay inside KV_DIR (see module doc)
+KV_BODY_PREFIX = "kv_"
+KV_DIR = "serving"
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -239,6 +253,35 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_spans(root)
     problems += check_events(root)
     problems += check_resilience(root)
+    problems += check_kv(root)
+    return problems
+
+
+def check_kv(root: Path = SOURCE_ROOT):
+    """Placement lint for the paged-KV-cache telemetry: every
+    ``serving`` metric with a ``kv_``-prefixed body is registered under
+    nnstreamer_tpu/serving/ (the cache records its own pool/prefix
+    series — other modules read them through the registry), and the
+    ``pages`` gauge unit never appears outside that family."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        is_kv = (m.group("layer") == "serving"
+                 and m.group("body").startswith(KV_BODY_PREFIX))
+        in_pkg = KV_DIR in path.parts
+        if is_kv and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the serving "
+                f"{KV_BODY_PREFIX}* body outside "
+                f"nnstreamer_tpu/{KV_DIR}/ — the paged KV cache owns "
+                f"that family")
+        elif m.group("unit") == "pages" and not is_kv:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the 'pages' "
+                f"gauge unit reserved for serving "
+                f"{KV_BODY_PREFIX}* bodies")
     return problems
 
 

@@ -1,0 +1,511 @@
+"""Paged KV cache (serving/kv_cache.py + the LMEngine paged path).
+
+Contracts pinned here:
+
+- Allocator: reservation accounting balances, admission is gated on
+  ``available()``, eviction is lazy + deterministic LRU, host offload
+  round-trips page bits exactly.
+- Kernels (models/causal_lm.py paged section): the gathered page view
+  IS the contiguous layout, so paged decode/verify/prefill are
+  bit-identical to the contiguous kernels — by construction, asserted
+  with exact equality (no tolerances).
+- Engine: greedy output under paging matches the contiguous engine and
+  the isolated oracle token-for-token across prefix sharing, COW
+  divergence, pool exhaustion, eviction, offload, speculative decoding,
+  and the bounded per-slot view.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import causal_lm
+from nnstreamer_tpu.serving import LMEngine, PagedKVCache, TPLMEngine
+from nnstreamer_tpu.serving.kv_cache import empty_page_pool
+
+V, D, H, L, MAXLEN = 97, 32, 4, 2, 64
+PS = 8  # page size used by every engine test: 8 pages per max_len
+
+
+@pytest.fixture(scope="module")
+def params():
+    return causal_lm.init_causal_lm(
+        jax.random.PRNGKey(7), V, D, H, L, MAXLEN)
+
+
+# single-bucket jitted oracle: every prompt in this file fits one padded
+# prefill shape, so the whole suite pays exactly two oracle compiles
+_ORACLE_BUCKET = 32
+_oracle_prefill = jax.jit(causal_lm.lm_prefill_masked, static_argnums=(3, 4))
+_oracle_decode = jax.jit(causal_lm.lm_decode_step, static_argnums=(5,))
+
+
+def isolated_generate(params, prompt, max_new, eos=None):
+    """Single-stream oracle: masked-bucket prefill + one-at-a-time decode."""
+    p = np.asarray(prompt, np.int32)
+    assert len(p) <= _ORACLE_BUCKET
+    buf = np.zeros((1, _ORACLE_BUCKET), np.int32)
+    buf[0, :len(p)] = p
+    logits, kc, vc, pos = _oracle_prefill(
+        params, jnp.asarray(buf), jnp.int32(len(p)), H, MAXLEN)
+    out = [int(jnp.argmax(logits[0]))]
+    while len(out) < max_new and not (eos is not None and out[-1] == eos):
+        tok = jnp.asarray([[out[-1]]], jnp.int32)
+        logits, kc, vc, pos = _oracle_decode(params, tok, kc, vc, pos, H)
+        out.append(int(jnp.argmax(logits[0])))
+    return out
+
+
+def prompts_rng(n, lo=1, hi=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, V, rng.integers(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def run_engine(params, jobs, **kw):
+    eng = LMEngine(params, H, MAXLEN, **kw)
+    rids = [eng.submit(p, max_new=mn) for p, mn in jobs]
+    res = eng.run()
+    return [res[r] for r in rids], eng
+
+
+# -- allocator units (tiny standalone pools, no model) --------------------- #
+
+
+def _cache(n_pages=8, ps=4, **kw):
+    return PagedKVCache(1, 1, ps, n_pages, 2, **kw)
+
+
+def _toks(seed, n):
+    return np.random.default_rng(seed).integers(0, 50, n).astype(np.int32)
+
+
+def test_reservation_accounting_balances():
+    kv = _cache()
+    prompt = _toks(0, 10)
+    plan = kv.lookup(prompt)
+    assert plan.hit_len == 0
+    lease = kv.admit(plan, b_needed=4)
+    # 3 prompt pages allocated eagerly, 1 still claimable
+    assert len(lease.pages) == 3 and lease.reserved == 1
+    assert kv.reserved == 1 and kv.available() == 8 - 4
+    kv.lease_alloc(lease)
+    assert lease.reserved == 0 and kv.reserved == 0
+    with pytest.raises(RuntimeError, match="reservation"):
+        kv.lease_alloc(lease)
+    kv.release(lease, prompt)
+    assert kv.reserved == 0
+    # the 2 full prompt chunks stay registered (evictable), the rest
+    # returned: everything is claimable again
+    assert kv.available() == 8 and len(kv._lru) == 2
+
+
+def test_admissible_gates_and_lazy_eviction_reclaims():
+    kv = _cache(n_pages=4)
+    p1, p2, p3 = _toks(1, 8), _toks(2, 8), _toks(3, 8)
+    l1 = kv.admit(kv.lookup(p1), b_needed=2)
+    kv.admit(kv.lookup(p2), b_needed=2)
+    plan3 = kv.lookup(p3)
+    assert not kv.admissible(plan3, b_needed=2)
+    kv.release(l1, p1)  # 2 registered ref-0 pages -> evictable
+    assert kv.admissible(plan3, b_needed=2)
+    l3 = kv.admit(plan3, b_needed=2)
+    assert len(l3.pages) == 2
+    # allocation was served by dropping p1's ref-0 subtree (both pages
+    # free in one eviction -- the whole chain is dead without its root)
+    assert kv.stats["evictions"] == 2
+
+
+def test_lookup_caps_hit_at_t_minus_1_and_cow_matches():
+    kv = _cache()
+    prompt = _toks(4, 8)
+    kv.release(kv.admit(kv.lookup(prompt), b_needed=2), prompt)
+    plan = kv.lookup(prompt)
+    # same 8 tokens again: only 1 FULL chunk may match ((t-1)//ps); the
+    # second chunk is served as a 3-token COW partial -> hit t-1
+    assert len(plan.nodes) == 1
+    assert plan.cow is not None and plan.cow[1] == 3
+    assert plan.hit_len == 7
+    lease = kv.admit(plan, b_needed=2)
+    assert kv.stats["cow_copies"] == 1
+    # the COW page is owned outright, never the shared original
+    assert plan.cow[0].page not in lease.own
+
+
+def test_cow_copy_preserves_page_bits():
+    kv = _cache()
+    prompt = _toks(5, 8)
+    l0 = kv.admit(kv.lookup(prompt), b_needed=2)
+    marker = jnp.full_like(kv.kpool[l0.pages[0]], 1.5)
+    kv.kpool = kv.kpool.at[l0.pages[0]].set(marker)
+    kv.release(l0, prompt)
+    # diverge inside page 0: 2 shared tokens then different ones
+    other = np.concatenate([prompt[:2], _toks(6, 6)])
+    plan = kv.lookup(other)
+    assert plan.nodes == [] and plan.cow is not None and plan.cow[1] == 2
+    lease = kv.admit(plan, b_needed=2)
+    cow_pid = lease.pages[0]
+    np.testing.assert_array_equal(np.asarray(kv.kpool[cow_pid]),
+                                  np.asarray(marker))
+
+
+def test_eviction_is_deterministic():
+    def drive(kv):
+        for seed in range(6):
+            p = _toks(seed, 12)
+            kv.release(kv.admit(kv.lookup(p), b_needed=3), p)
+        return list(kv.free), dict(kv.stats)
+
+    a, b = drive(_cache(n_pages=6)), drive(_cache(n_pages=6))
+    assert a == b
+    assert a[1]["evictions"] > 0
+
+
+def test_host_offload_roundtrips_page_bits():
+    kv = _cache(n_pages=2, host_offload=True)
+    prompt = _toks(7, 8)
+    lease = kv.admit(kv.lookup(prompt), b_needed=2)
+    p1, p2 = lease.pages
+    kv.kpool = kv.kpool.at[p1].set(1.25)
+    kv.vpool = kv.vpool.at[p1].set(2.5)
+    kv.kpool = kv.kpool.at[p2].set(3.75)
+    kv.release(lease, prompt)
+    # a second request forces both pages out: D2H once per page, nodes
+    # stay in the tree page-less
+    other = _toks(8, 8)
+    kv.release(kv.admit(kv.lookup(other), b_needed=2), other)
+    assert kv.stats["offloads"] == 2 and kv.stats["evictions"] == 2
+    # re-admitting the first prompt re-uploads the matched chunk with
+    # its original bits (full-chunk hit is capped at (t-1)//ps = 1;
+    # offloaded chunk 2 is not a COW candidate -- device-resident only)
+    plan = kv.lookup(prompt)
+    assert len(plan.nodes) == 1 and plan.cow is None
+    lease3 = kv.admit(plan, b_needed=2)
+    assert kv.stats["reuploads"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(kv.kpool[lease3.pages[0]]),
+        np.full_like(np.asarray(kv.kpool[0]), 1.25))
+    np.testing.assert_array_equal(
+        np.asarray(kv.vpool[lease3.pages[0]]),
+        np.full_like(np.asarray(kv.vpool[0]), 2.5))
+
+
+def test_pool_validation():
+    with pytest.raises(ValueError, match=">= 1"):
+        PagedKVCache(1, 1, 0, 4, 2)
+    with pytest.raises(ValueError, match=">= 1"):
+        PagedKVCache(1, 1, 4, 0, 2)
+
+
+# -- kernel bit-identity --------------------------------------------------- #
+
+
+def _paged_from_flat(kc, vc, ps):
+    """Scatter one flat (LH, M, hd) cache into fresh page pools; returns
+    (kpool, vpool, table) with pages 1..M/ps in order."""
+    lh, m, hd = kc.shape
+    b = m // ps
+    kpool, vpool = empty_page_pool(b, 1, lh, ps, hd)
+    table = jnp.arange(1, b + 1, dtype=jnp.int32)
+    kpool = kpool.at[table].set(
+        kc.reshape(lh, b, ps, hd).transpose(1, 0, 2, 3))
+    vpool = vpool.at[table].set(
+        vc.reshape(lh, b, ps, hd).transpose(1, 0, 2, 3))
+    return kpool, vpool, table
+
+
+def test_paged_view_is_the_contiguous_layout(params):
+    prompt = prompts_rng(1, lo=10, hi=11, seed=20)[0]
+    _, kc, vc, _ = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt[None]), H, MAXLEN)
+    kpool, _, table = _paged_from_flat(kc, vc, PS)
+    view = causal_lm.paged_view_slots(kpool, table[None])[0]
+    np.testing.assert_array_equal(np.asarray(view), np.asarray(kc))
+
+
+def test_paged_decode_steps_bit_identical(params):
+    prompt = prompts_rng(1, lo=9, hi=10, seed=21)[0]
+    lg, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt[None]), H, MAXLEN)
+    kpool, vpool, table = _paged_from_flat(kc, vc, PS)
+    tables, poss = table[None], pos[None]
+    kcs, vcs = kc[None], vc[None]
+    tok = jnp.argmax(lg, -1).astype(jnp.int32)[:, None][None]
+    step_c = jax.jit(causal_lm.lm_decode_step_slots, static_argnums=(5,))
+    step_p = jax.jit(causal_lm.lm_decode_step_paged, static_argnums=(6,))
+    for _ in range(2 * PS + 3):  # cross two page boundaries
+        lg_c, kcs, vcs, poss_c = step_c(params, tok, kcs, vcs, poss, H)
+        lg_p, kpool, vpool, poss = step_p(
+            params, tok, kpool, vpool, tables, poss, H)
+        np.testing.assert_array_equal(np.asarray(lg_p), np.asarray(lg_c))
+        np.testing.assert_array_equal(np.asarray(poss), np.asarray(poss_c))
+        tok = jnp.argmax(lg_p, -1).astype(jnp.int32)[:, :, None]
+    # every touched page carries the same bits as the contiguous cache
+    view = causal_lm.paged_view_slots(kpool, tables)
+    np.testing.assert_array_equal(np.asarray(view), np.asarray(kcs))
+
+
+def test_paged_verify_window_bit_identical(params):
+    rng = np.random.default_rng(22)
+    prompt = rng.integers(0, V, (1, 12)).astype(np.int32)
+    _, kc, vc, pos = causal_lm.lm_prefill(
+        params, jnp.asarray(prompt), H, MAXLEN)
+    window = rng.integers(0, V, (1, 5)).astype(np.int32)
+    wl, kc2, vc2, wpos = causal_lm.lm_verify_window_slots(
+        params, jnp.asarray(window)[None][:, 0], kc[None], vc[None],
+        pos[None], H)
+    kpool, vpool, table = _paged_from_flat(kc, vc, PS)
+    pl, kpool, vpool, ppos = causal_lm.lm_verify_window_paged(
+        params, jnp.asarray(window), kpool, vpool, table[None], pos[None], H)
+    np.testing.assert_array_equal(np.asarray(pl), np.asarray(wl))
+    np.testing.assert_array_equal(np.asarray(ppos), np.asarray(wpos))
+    view = causal_lm.paged_view_slots(kpool, table[None])
+    np.testing.assert_array_equal(np.asarray(view), np.asarray(kc2))
+
+
+def test_touch_span_bounds():
+    assert causal_lm.paged_touch_span(1, 8, 8) == 2
+    assert causal_lm.paged_touch_span(8, 8, 8) == 2
+    assert causal_lm.paged_touch_span(9, 8, 8) == 3
+    assert causal_lm.paged_touch_span(64, 8, 8) == 8  # capped at table
+
+
+# -- engine: exactness under paging ---------------------------------------- #
+
+
+def test_paged_engine_bit_identical_to_contiguous(params):
+    jobs = [(p, 6 + i % 5) for i, p in enumerate(prompts_rng(7, seed=30))]
+    cont, _ = run_engine(params, jobs, n_slots=2, chunk=4)
+    paged, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                            kv_page_size=PS)
+    assert paged == cont
+    for (p, mn), got in zip(jobs, paged):
+        assert got == isolated_generate(params, p, mn)
+    assert eng.kv_stats is not None and eng.kv_stats["pages_peak"] > 0
+
+
+def test_prefix_sharing_hits_and_stays_exact(params):
+    prefix = prompts_rng(1, lo=16, hi=17, seed=31)[0]  # 2 full pages
+    jobs = [(np.concatenate([prefix, s]), 8)
+            for s in prompts_rng(5, lo=4, hi=12, seed=32)]
+    paged, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                            kv_page_size=PS)
+    for (p, mn), got in zip(jobs, paged):
+        assert got == isolated_generate(params, p, mn)
+    kv = eng.kv_stats
+    assert kv["hit_requests"] >= 3
+    assert kv["hit_tokens"] >= 3 * 16
+
+
+def test_cow_divergence_stays_exact(params):
+    # 12 shared tokens = 1 full page + a 4-token partial: the partial
+    # must be served copy-on-write, and divergent suffixes never bleed
+    # into each other through the shared page
+    prefix = prompts_rng(1, lo=12, hi=13, seed=33)[0]
+    jobs = [(np.concatenate([prefix, s]), 7)
+            for s in prompts_rng(4, lo=3, hi=10, seed=34)]
+    paged, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                            kv_page_size=PS)
+    for (p, mn), got in zip(jobs, paged):
+        assert got == isolated_generate(params, p, mn)
+    assert eng.kv_stats["cow_copies"] >= 1
+
+
+def test_pool_exhaustion_defers_admission_fifo(params):
+    # pool of 8 pages, each request needs 4: only 2 admissible at once,
+    # the rest wait their turn and every stream still completes exact
+    jobs = [(p, 8) for p in prompts_rng(6, lo=20, hi=24, seed=35)]
+    paged, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                            kv_page_size=PS, kv_pages=8)
+    for (p, mn), got in zip(jobs, paged):
+        assert got == isolated_generate(params, p, mn)
+    assert eng.kv_stats["pages_peak"] <= 8
+
+
+def test_engine_eviction_deterministic(params):
+    jobs = [(p, 8) for p in prompts_rng(6, lo=18, hi=28, seed=36)]
+
+    def once():
+        outs, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                               kv_page_size=PS, kv_pages=8)
+        return outs, eng.kv_stats
+
+    (out_a, kv_a), (out_b, kv_b) = once(), once()
+    assert out_a == out_b and kv_a == kv_b
+    assert kv_a["evictions"] > 0
+    for (p, mn), got in zip(jobs, out_a):
+        assert got == isolated_generate(params, p, mn)
+
+
+def test_engine_host_offload_reuploads_and_stays_exact(params):
+    base = prompts_rng(1, lo=24, hi=25, seed=37)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4,
+                   kv_page_size=PS, kv_pages=8, kv_host_offload=True)
+    r1 = eng.submit(base, max_new=8)
+    assert eng.run()[r1] == isolated_generate(params, base, 8)
+    # churn the pool so base's registered chunks get offloaded
+    churn = prompts_rng(2, lo=22, hi=26, seed=38)
+    rids = [eng.submit(p, max_new=8) for p in churn]
+    res = eng.run()
+    for rid, p in zip(rids, churn):
+        assert res[rid] == isolated_generate(params, p, 8)
+    kv = eng.kv_stats
+    assert kv["offloads"] >= 1
+    # the same prompt again: its offloaded prefix re-uploads, not
+    # recomputes -- and the output is still exact
+    r2 = eng.submit(base, max_new=8)
+    assert eng.run()[r2] == isolated_generate(params, base, 8)
+    kv = eng.kv_stats
+    assert kv["reuploads"] >= 1 and kv["hit_tokens"] > 0
+
+
+def test_mid_flight_admission_paged(params):
+    prompts = prompts_rng(5, seed=39)
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4, kv_page_size=PS)
+    rids = [eng.submit(p, max_new=10) for p in prompts[:2]]
+    eng.step_iteration()
+    eng.step_iteration()
+    rids += [eng.submit(p, max_new=10) for p in prompts[2:]]
+    res = eng.run()
+    for rid, p in zip(rids, prompts):
+        assert res[rid] == isolated_generate(params, p, 10)
+
+
+def test_paged_waste_invariant_and_sampling(params):
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4, kv_page_size=PS)
+    rids = [eng.submit(p, max_new=3 + 4 * i)
+            for i, p in enumerate(prompts_rng(3, seed=40))]
+    # a sampled stream rides along: determinism contract is per-seed
+    rs = eng.submit(prompts_rng(1, seed=41)[0], max_new=6,
+                    temperature=0.9, top_k=11, seed=3)
+    res = eng.run()
+    for i, (rid, p) in enumerate(zip(rids, prompts_rng(3, seed=40))):
+        assert res[rid] == isolated_generate(params, p, 3 + 4 * i)
+    st = eng.stats
+    assert eng.n_slots * st["decode_steps"] == \
+        (st["tokens_out"] - st["prefills"]) + st["wasted_slot_steps"]
+    # sampled stream: batch-composition-independent (same seed alone)
+    solo = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4, kv_page_size=PS)
+    r = solo.submit(prompts_rng(1, seed=41)[0], max_new=6,
+                    temperature=0.9, top_k=11, seed=3)
+    assert solo.run()[r] == res[rs]
+
+
+# -- engine: speculative decoding under paging ------------------------------ #
+
+
+def _repetitive(n):
+    base = [5, 9, 2, 7]
+    return np.array((base * (n // 4 + 1))[:n], np.int32)
+
+
+def test_spec_paged_identical_and_accepting(params):
+    jobs = [(_repetitive(10), 20), (_repetitive(6), 12)]
+    plain, _ = run_engine(params, jobs, n_slots=2, chunk=4)
+    spec, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                           spec_draft=4, kv_page_size=PS)
+    assert spec == plain
+    assert eng.stats["spec_iterations"] > 0
+    assert eng.stats["spec_accepted"] > 0
+
+
+def test_bounded_slot_view_gates_spec_and_stays_exact(params):
+    # kv_slot_pages=4 -> per-request capacity 32 < max_len: the spec
+    # gate must use the VIEW capacity, or the last tokens would write
+    # past the gathered pages and NaN-poison the stream
+    prompt = _repetitive(20)
+    jobs = [(prompt, 13)]  # 20 + 13 - 1 == 32 fills the view exactly
+    plain, _ = run_engine(params, jobs, n_slots=1, chunk=3)
+    spec, eng = run_engine(params, jobs, n_slots=1, chunk=3, spec_draft=8,
+                           kv_page_size=PS, kv_slot_pages=4)
+    assert spec == plain
+    assert not any(np.isnan(spec[0]))
+
+
+def test_bounded_slot_view_rejects_oversize(params):
+    eng = LMEngine(params, H, MAXLEN, kv_page_size=PS, kv_slot_pages=4)
+    with pytest.raises(ValueError, match="paged per-request capacity"):
+        eng.submit(np.arange(30, dtype=np.int32) % V, max_new=8)
+    # within the view but beyond the whole pool: rejected up front so
+    # admission can never deadlock waiting for pages that cannot exist
+    eng2 = LMEngine(params, H, MAXLEN, kv_page_size=PS, kv_pages=2)
+    with pytest.raises(ValueError, match="kv_pages=2"):
+        eng2.submit(np.arange(20, dtype=np.int32) % V, max_new=8)
+
+
+# -- config plumbing -------------------------------------------------------- #
+
+
+def test_constructor_validation(params):
+    with pytest.raises(ValueError, match="divide"):
+        LMEngine(params, H, MAXLEN, kv_page_size=7)
+    with pytest.raises(ValueError, match="kv_slot_pages"):
+        LMEngine(params, H, MAXLEN, kv_page_size=PS, kv_slot_pages=9)
+    with pytest.raises(ValueError, match="kv_page_size must be >= 0"):
+        LMEngine(params, H, MAXLEN, kv_page_size=-1)
+    with pytest.raises(ValueError, match="spec_draft"):
+        LMEngine(params, H, MAXLEN, kv_page_size=PS, kv_slot_pages=1,
+                 spec_draft=8)
+
+
+def test_env_transport_and_explicit_override(params, monkeypatch):
+    monkeypatch.setenv("NNS_LM_KV_PAGE_SIZE", str(PS))
+    monkeypatch.setenv("NNS_LM_KV_PAGES", "12")
+    eng = LMEngine(params, H, MAXLEN, n_slots=2)
+    assert eng._kv is not None and eng._kv.n_pages == 12
+    # explicit kv_page_size=0 pins contiguous regardless of environment
+    eng0 = LMEngine(params, H, MAXLEN, n_slots=2, kv_page_size=0)
+    assert eng0._kv is None
+    monkeypatch.setenv("NNS_LM_KV_PAGE_SIZE", "junk")
+    with pytest.raises(ValueError, match="NNS_LM_KV_PAGE_SIZE"):
+        LMEngine(params, H, MAXLEN, n_slots=2)
+
+
+def test_env_paged_engine_stays_exact(params, monkeypatch):
+    monkeypatch.setenv("NNS_LM_KV_PAGE_SIZE", str(PS))
+    prompt = prompts_rng(1, lo=10, hi=11, seed=42)[0]
+    eng = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    assert eng._kv is not None
+    rid = eng.submit(prompt, max_new=9)
+    assert eng.run()[rid] == isolated_generate(params, prompt, 9)
+
+
+def test_tp_engine_rejects_paging(params, monkeypatch):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("model",))
+    with pytest.raises(ValueError, match="paged KV cache"):
+        TPLMEngine(params, H, MAXLEN, mesh, kv_page_size=PS)
+    # env paging must not leak into the TP engine either
+    monkeypatch.setenv("NNS_LM_KV_PAGE_SIZE", str(PS))
+    eng = TPLMEngine(params, H, MAXLEN, mesh)
+    assert eng._kv is None
+
+
+# -- stress (excluded from tier-1) ------------------------------------------ #
+
+
+@pytest.mark.slow
+def test_many_requests_through_small_pool_stress(params):
+    # 24 mixed requests (some sharing a prefix) through a 4x
+    # oversubscribed engine: every stream exact, pool never exceeded
+    prefix = prompts_rng(1, lo=16, hi=17, seed=50)[0]
+    rng = np.random.default_rng(51)
+    jobs = []
+    for i in range(24):
+        if i % 3:
+            p = np.concatenate(
+                [prefix, rng.integers(0, V, rng.integers(2, 14))
+                 .astype(np.int32)])
+        else:
+            p = rng.integers(0, V, rng.integers(8, 30)).astype(np.int32)
+        jobs.append((p, 4 + i % 9))
+    paged, eng = run_engine(params, jobs, n_slots=8, chunk=4,
+                            kv_page_size=PS, kv_pages=32)
+    for (p, mn), got in zip(jobs, paged):
+        assert got == isolated_generate(params, p, mn)
+    kv = eng.kv_stats
+    assert kv["pages_peak"] <= 32
+    assert kv["hit_requests"] > 0

@@ -160,6 +160,45 @@ def test_hash_in_prop_value_not_a_comment():
     assert branches[0][2] == ("c", {})
 
 
+def test_kv_flags_set_env_transport(monkeypatch):
+    # --kv-page-size/--kv-pages export the NNS_LM_KV_* env BEFORE the
+    # pipeline starts, so any LMEngine built during the run picks the
+    # paged cache up (serving/lm_engine.py reads them at __init__)
+    import os
+
+    monkeypatch.delenv("NNS_LM_KV_PAGE_SIZE", raising=False)
+    monkeypatch.delenv("NNS_LM_KV_PAGES", raising=False)
+    rc = cli_main(["--kv-page-size", "8", "--kv-pages", "64",
+                   "--timeout", "30",
+                   "videotestsrc num-buffers=2 width=8 height=8 ! "
+                   "tensor_converter ! tensor_sink"])
+    try:
+        assert rc == 0
+        assert os.environ["NNS_LM_KV_PAGE_SIZE"] == "8"
+        assert os.environ["NNS_LM_KV_PAGES"] == "64"
+    finally:
+        os.environ.pop("NNS_LM_KV_PAGE_SIZE", None)
+        os.environ.pop("NNS_LM_KV_PAGES", None)
+
+
+@pytest.mark.parametrize("argv", [
+    ["--kv-pages", "8"],                      # pages without a page size
+    ["--kv-page-size", "0"],                  # page size must be >= 1
+    ["--kv-page-size", "8", "--kv-pages", "0"],
+], ids=["pages-alone", "zero-ps", "zero-pages"])
+def test_kv_flag_validation_rejected(argv, monkeypatch):
+    import os
+
+    monkeypatch.delenv("NNS_LM_KV_PAGE_SIZE", raising=False)
+    with pytest.raises(SystemExit) as ei:
+        cli_main(argv + ["videotestsrc num-buffers=1 ! tensor_converter "
+                         "! tensor_sink"])
+    assert ei.value.code == 2
+    # a rejected flag combo must not leak half-set env into the process
+    assert "NNS_LM_KV_PAGE_SIZE" not in os.environ
+    assert "NNS_LM_KV_PAGES" not in os.environ
+
+
 def test_list_models_includes_zoo_families():
     import io
     from contextlib import redirect_stdout

@@ -250,6 +250,22 @@ def test_gang_mode_static_batching_exact(params):
     assert gang.stats["decode_steps"] >= cont.stats["decode_steps"]
 
 
+def test_paged_kv_same_tokens_as_contiguous(params):
+    # the paged cache's engine-level exactness suite is
+    # tests/test_kv_paging.py; this pins the serving contract from THIS
+    # file's angle — kv_page_size is a scheduling knob, not a numerics
+    # knob: same workload, same tokens, bit for bit
+    prompts = prompts_rng(4, seed=15)
+    cont = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4)
+    paged = LMEngine(params, H, MAXLEN, n_slots=2, chunk=4, kv_page_size=8)
+    rc = [cont.submit(p, max_new=8) for p in prompts]
+    rp = [paged.submit(p, max_new=8) for p in prompts]
+    res_c, res_p = cont.run(), paged.run()
+    for rid_c, rid_p, p in zip(rc, rp, prompts):
+        assert res_p[rid_p] == res_c[rid_c] == isolated_generate(params, p, 8)
+    assert paged.kv_stats is not None and cont.kv_stats is None
+
+
 def test_stats_account_for_waste(params):
     prompts = prompts_rng(2, seed=10)
     eng = LMEngine(params, H, MAXLEN, n_slots=4, chunk=4)

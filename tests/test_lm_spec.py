@@ -134,6 +134,18 @@ def test_spec_draft_validation(params):
         LMEngine(params, H, MAXLEN, spec_draft=MAXLEN)
 
 
+def test_spec_under_paged_kv_identical(params):
+    # speculation's greedy-exactness contract survives the paged cache
+    # (capacity gate reads the slot VIEW headroom, not max_len — the
+    # full matrix is tests/test_kv_paging.py; this pins the spec angle)
+    jobs = [(_repetitive(10), 18, {}), (_repetitive(6), 10, {})]
+    plain, _ = run_engine(params, jobs, n_slots=2, chunk=4)
+    spec, eng = run_engine(params, jobs, n_slots=2, chunk=4,
+                           spec_draft=4, kv_page_size=8)
+    assert spec == plain
+    assert eng.stats["spec_iterations"] > 0
+
+
 def test_draft_tokens_prompt_lookup():
     from nnstreamer_tpu.serving.lm_engine import _Request
     req = _Request(0, np.array([1, 2, 3, 9, 1, 2, 3], np.int32), 8, None)
