@@ -15,6 +15,9 @@ snapshots to an aggregator / serve the merged fleet — see
 docs/observability.md), --deadline-ms/--fallback (resilience: per-buffer
 deadlines + breaker-gated local degradation on every
 tensor_query_client — see docs/resilience.md),
+--backends/--hedge-ms (fleet routing: spread every
+tensor_query_client across N servers with failover and optional
+hedged dispatch — docs/resilience.md "Fleet routing & failover"),
 --kv-page-size/--kv-pages (serving: paged KV cache geometry for any
 LMEngine the pipeline constructs, exported via the NNS_LM_KV_* env —
 see docs/performance.md "Paged KV cache"). Setting the
@@ -76,6 +79,19 @@ def main(argv=None) -> int:
                     help="degraded-mode route for every tensor_query_client "
                          "when its circuit breaker opens: 'passthrough' or "
                          "a local element kind (e.g. tensor_filter)")
+    ap.add_argument("--backends", metavar="HOST:PORT[,HOST:PORT...]",
+                    default=None,
+                    help="route every tensor_query_client across this "
+                         "backend set instead of its single host/port: "
+                         "per-backend circuit breakers, two-choice "
+                         "placement, mid-stream failover (query.router, "
+                         "docs/resilience.md 'Fleet routing & failover')")
+    ap.add_argument("--hedge-ms", type=float, default=None, metavar="MS",
+                    help="hedged dispatch for routed clients: duplicate a "
+                         "request to a second backend once the observed "
+                         "P95 round trip (floored at MS) elapses without "
+                         "a response; first result wins (needs --backends "
+                         "with >= 2 endpoints)")
     ap.add_argument("--kv-page-size", type=int, default=None, metavar="TOK",
                     help="enable the paged KV cache on every LMEngine built "
                          "during the run: tokens per page (must divide the "
@@ -106,6 +122,23 @@ def main(argv=None) -> int:
         return inspect_element(args.inspect)
     if not args.pipeline:
         ap.error("pipeline description required")
+    backend_eps = None
+    if args.backends is not None:
+        from .query.router import parse_endpoints
+
+        try:
+            backend_eps = parse_endpoints(args.backends)
+        except ValueError as e:
+            ap.error(f"--backends: {e}")
+    if args.hedge_ms is not None:
+        if backend_eps is None:
+            ap.error("--hedge-ms needs --backends (hedging is a routed-"
+                     "dispatch feature)")
+        if args.hedge_ms <= 0:
+            ap.error("--hedge-ms must be > 0")
+        if len(backend_eps) < 2:
+            ap.error("--hedge-ms needs --backends with >= 2 endpoints "
+                     "(a hedge must land on a different backend)")
     if args.kv_pages is not None and args.kv_page_size is None:
         ap.error("--kv-pages needs --kv-page-size (paging is off without "
                  "a page size)")
@@ -128,19 +161,24 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — CLI reports, never tracebacks
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
-    if args.deadline_ms is not None or args.fallback is not None:
+    if args.deadline_ms is not None or args.fallback is not None \
+            or backend_eps is not None:
         from .query.client import TensorQueryClient
 
         clients = [el for el in p.elements.values()
                    if isinstance(el, TensorQueryClient)]
         if not clients:
-            ap.error("--deadline-ms/--fallback need a tensor_query_client "
-                     "in the pipeline")
+            ap.error("--deadline-ms/--fallback/--backends need a "
+                     "tensor_query_client in the pipeline")
         for el in clients:
             if args.deadline_ms is not None:
                 el.deadline_ms = float(args.deadline_ms)
             if args.fallback is not None:
                 el.fallback = args.fallback
+            if backend_eps is not None:
+                el.backends = [f"{h}:{pt}" for h, pt in backend_eps]
+                if args.hedge_ms is not None:
+                    el.hedge_ms = float(args.hedge_ms)
     if os.environ.get("NNS_TPU_CHAOS"):
         from .resilience import chaos
 

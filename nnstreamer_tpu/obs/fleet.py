@@ -84,6 +84,15 @@ DEFAULT_INTERVAL_S = 2.0
 TTL_FACTOR = 3.0
 EXPIRE_FACTOR = 15.0
 
+#: bounded count of expired-instance tombstones kept for routing views
+TOMBSTONE_LIMIT = 64
+
+#: gauge families whose series sum to an instance's routing queue
+#: depth (serving admission queue, query inbox, pipeline queues)
+QUEUE_DEPTH_FAMILIES = ("nnstpu_serving_queue_depth",
+                        "nnstpu_query_inbox_depth",
+                        "nnstpu_pipeline_queue_depth")
+
 #: per-push span batch bound (the store-side queue is bounded too)
 MAX_SPANS_PER_PUSH = 512
 
@@ -323,6 +332,11 @@ class FleetAggregator:
             else _tracing.store()
         self._lock = threading.Lock()
         self._instances: "OrderedDict[str, _Instance]" = OrderedDict()
+        #: expired instances, kept (bounded) so routing views report
+        #: them as not-routable instead of silently dropping the key;
+        #: a fresh push from the same instance clears its tombstone
+        self._tombstones: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()
         #: (instance, family) pairs already journaled as conflicts —
         #: one event per drift, not one per scrape
         self._conflicts: set = set()
@@ -348,6 +362,15 @@ class FleetAggregator:
                 rec = self._instances[iid]
                 if now - rec.last_mono > self._expire_after(rec):
                     dead.append(self._instances.pop(iid))
+                    # expiry leaves a tombstone, not silence: a router
+                    # asking about this instance must see "known dead"
+                    # (routable=False), not an absent key it could
+                    # misread as "never part of the fleet"
+                    self._tombstones[iid] = {
+                        "role": rec.role, "expired_mono": now}
+                    self._tombstones.move_to_end(iid)
+                    while len(self._tombstones) > TOMBSTONE_LIMIT:
+                        self._tombstones.popitem(last=False)
         for rec in dead:
             _events.record(
                 "fleet.expire",
@@ -414,6 +437,8 @@ class FleetAggregator:
             rec.pushes += 1
             rec.last_mono = time.monotonic()
             self.pushes_ingested += 1
+            # a returning instance is alive again: drop its tombstone
+            self._tombstones.pop(iid, None)
         if isinstance(spans, list) and spans:
             ingested = self._store.ingest_remote(spans, iid)
             with self._lock:
@@ -598,12 +623,75 @@ class FleetAggregator:
                 fresh and bool(rec.ready.get("ready"))
         return local_ready and all(conds.values()), conds
 
+    # -- routing view ------------------------------------------------------ #
+    @staticmethod
+    def _queue_depth(rec: _Instance) -> float:
+        """Instance load as one plain scalar: the sum of every series
+        in its pushed queue-depth gauge families. Buried sub-doc → a
+        number a placement loop can compare without parsing."""
+        total = 0.0
+        for fam_name in QUEUE_DEPTH_FAMILIES:
+            fam = rec.metrics.get(fam_name)
+            if not isinstance(fam, dict):
+                continue
+            for series in fam.get("series") or ():
+                try:
+                    total += float(series.get("value", 0.0))
+                except (TypeError, ValueError):
+                    continue
+        return total
+
+    def routing_view(self) -> Dict[str, Dict[str, Any]]:
+        """Per-instance placement signals as plain scalars — what the
+        query router consumes. Each live instance maps to::
+
+            {"routable": bool,   # fresh AND self-reported ready
+             "ready": bool, "stale": bool, "queue_depth": float,
+             "role": str, "push_age_s": float}
+
+        An EXPIRED instance stays in the view as a tombstone
+        (``routable=False, expired=True``) instead of vanishing — a
+        router must read "known dead", never mistake absence for
+        "never existed"."""
+        self._expire_now()
+        now = time.monotonic()
+        with self._lock:
+            recs = list(self._instances.values())
+            stones = {iid: dict(t) for iid, t in self._tombstones.items()}
+        view: Dict[str, Dict[str, Any]] = {}
+        for rec in recs:
+            age = now - rec.last_mono
+            stale = age > self._ttl(rec)
+            ready = bool(rec.ready.get("ready"))
+            view[rec.instance] = {
+                "routable": (not stale) and ready,
+                "ready": ready,
+                "stale": stale,
+                "queue_depth": self._queue_depth(rec),
+                "role": rec.role,
+                "push_age_s": age,
+            }
+        for iid, stone in stones.items():
+            if iid in view:
+                continue
+            view[iid] = {
+                "routable": False,
+                "ready": False,
+                "stale": True,
+                "expired": True,
+                "queue_depth": float("inf"),
+                "role": stone.get("role", "worker"),
+                "push_age_s": now - float(stone.get("expired_mono", now)),
+            }
+        return view
+
     # -- /debug/fleet ------------------------------------------------------ #
     def snapshot(self) -> Dict[str, Any]:
         self._expire_now()
         now = time.monotonic()
         with self._lock:
             recs = list(self._instances.values())
+            stones = list(self._tombstones)
         instances = []
         for rec in recs:
             age = now - rec.last_mono
@@ -621,12 +709,14 @@ class FleetAggregator:
                 "spans_ingested": rec.spans_ingested,
                 "health_status": rec.health.get("status"),
                 "ready": bool(rec.ready.get("ready")),
+                "queue_depth": self._queue_depth(rec),
             })
         return {
             "aggregator": {"instance": self.instance, "role": self.role},
             "pushes_ingested": self.pushes_ingested,
             "bad_pushes": self.bad_pushes,
             "instances": instances,
+            "expired": stones,
         }
 
     def close(self) -> None:

@@ -116,6 +116,16 @@ class TensorQueryClient(Element):
         #: EOS drain patience for pipelined in-flight results
         #: (was a hardcoded 60 s)
         self.drain_timeout_s = 60.0
+        #: routed mode: a comma-separated "host:port,host:port" string
+        #: (or list) of tensor_query servers. Set, it replaces the
+        #: single host/port link with a QueryRouter — per-backend
+        #: breakers, two-choice placement, mid-stream failover. Unset
+        #: (default), no router object exists and chain() pays one
+        #: is-None check — the chaos-hook zero-overhead contract.
+        self.backends: Any = None
+        #: hedged dispatch delay floor in ms (routed mode only; 0 =
+        #: hedging off). The live delay is max(observed P95, hedge_ms).
+        self.hedge_ms = 0.0
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
         self.add_src_pad(template=Caps.any_tensors())
@@ -151,6 +161,14 @@ class TensorQueryClient(Element):
         self._fallback_tap: Optional[_FallbackTap] = None
         self._fb_active = False      # fallback carried the last buffer
         self._last_deadline: Optional[_rp.Deadline] = None
+        #: multi-backend router (query/router.py); stays None without
+        #: ``backends=`` so the routed branch in chain() costs one
+        #: attribute load + is-None check
+        self._router = None
+        #: EOS drain in progress: _connect refuses to dial (the drain
+        #: is waiting for RESULTs already owed on the existing link —
+        #: a fresh connection can't deliver them, only leak)
+        self._draining = False
         # offload telemetry (obs subsystem; message/byte counts live at
         # the protocol layer): dials, request round trips, and the
         # pipelined in-flight window (collection-time read, no hot cost)
@@ -178,12 +196,17 @@ class TensorQueryClient(Element):
             f"query.client:{self.name}", kind="query",
             probe=lambda: (lambda c: None if c is None else
                            {"connected": c._sock is not None,
-                            "in_flight": len(c._pending)})(ref()),
+                            "in_flight": len(c._pending),
+                            "routed": c._router is not None})(ref()),
             attrs={"element": self.name})
+        # routed mode has no single _sock; ready = any active backend
         _health.add_readiness(
             f"query:{self.name}",
             lambda: (lambda c: None if c is None
-                     else c._sock is not None)(ref()))
+                     else (any(b.state == "active"
+                               for b in c._router.backends.backends())
+                           if c._router is not None
+                           else c._sock is not None))(ref()))
 
     # -- connection ---------------------------------------------------------- #
     def _resolve_endpoints(self) -> list:
@@ -199,6 +222,12 @@ class TensorQueryClient(Element):
         return [(self.host, int(self.port))]
 
     def _connect(self) -> socket.socket:
+        if self._draining:
+            # EOS drain must never dial: a new connection can't carry
+            # the in-flight results the drain is waiting for, and the
+            # old drain/reconnect race left sockets behind
+            raise ConnectionError(
+                f"{self.name}: draining — refusing to open a connection")
         last: Optional[Exception] = None
         for host, port in self._resolve_endpoints():
             sock: Optional[socket.socket] = None
@@ -249,9 +278,36 @@ class TensorQueryClient(Element):
     def start(self) -> None:
         self._caps_out_sent = False
         self._reader_error = None
+        self._draining = False
         if self.fallback and self._fallback_el is None \
                 and self.fallback != "passthrough":
             self._build_fallback()
+        if self.backends and self._router is None:
+            self._build_router()
+
+    def _build_router(self) -> None:
+        from . import router as _router_mod
+
+        eps = _router_mod.parse_endpoints(self.backends)
+        bset = _router_mod.BackendSet(
+            eps, owner=self.name, timeout_s=float(self.timeout_s),
+            breaker_threshold=int(self.breaker_threshold),
+            breaker_reset_s=float(self.breaker_reset_s))
+        self._router = _router_mod.QueryRouter(
+            bset, name=self.name,
+            max_request_retry=int(self.max_request_retry),
+            hedge_ms=float(self.hedge_ms or 0.0),
+            retry_policy=self._retry_policy())
+        ref = weakref.ref(self)
+        self._router.set_caps_provider(
+            lambda: (lambda c: str(c.sink_pad.caps or "")
+                     if c is not None else "")(ref()))
+
+    @property
+    def router(self):
+        """The live QueryRouter in routed mode (None otherwise) — the
+        handle for live backend add/remove/drain."""
+        return self._router
 
     def _build_fallback(self) -> None:
         """Materialize the ``fallback=`` property: a callable becomes a
@@ -277,6 +333,9 @@ class TensorQueryClient(Element):
             el.on_caps(el.sink_pads[0], caps)
 
     def stop(self) -> None:
+        if self._router is not None:
+            self._router.close()
+            self._router = None
         if self._sock is not None:
             try:
                 # shutdown (not just close) unblocks a reader thread
@@ -557,8 +616,17 @@ class TensorQueryClient(Element):
                            pending=abandoned)
 
     def on_eos(self) -> None:
-        # all in-flight results must be pushed before EOS propagates
-        self._drain_pending()
+        # all in-flight results must be pushed before EOS propagates.
+        # The drain window is strictly read-only on connection state:
+        # no dialing (see _connect) and, in routed mode, no membership
+        # growth — a backend added mid-drain could never owe results.
+        self._draining = True
+        if self._router is not None:
+            self._router.draining = True
+        try:
+            self._drain_pending()
+        finally:
+            self._draining = False
 
     # -- degraded paths -------------------------------------------------------- #
     def _shed(self, buf: Buffer, why: str) -> FlowReturn:
@@ -607,6 +675,10 @@ class TensorQueryClient(Element):
             self._last_deadline = dl
             if dl.expired():
                 return self._shed(buf, "deadline expired before send")
+        # routed mode: per-backend breakers + placement live in the
+        # router; disabled cost is this one is-None check
+        if self._router is not None:
+            return self._chain_routed(buf, dl)
         # breaker gate — only with a fallback to route to (without one,
         # refusing to try would just fail faster than trying)
         if self.fallback and not self._breaker.allow():
@@ -615,6 +687,45 @@ class TensorQueryClient(Element):
         if depth > 1:
             return self._chain_pipelined(buf, depth)
         return self._chain_sync(buf, dl)
+
+    def _chain_routed(self, buf: Buffer,
+                      dl: Optional["_rp.Deadline"]) -> Optional[FlowReturn]:
+        from .router import RouterError, _ShedSignal
+
+        meta, payload = buffer_to_payload(buf, sparse=bool(self.sparse))
+        sess = buf.meta.get("session")
+        if sess is not None:
+            # affinity key rides the wire so the serving side can pin
+            # KV/prefix reuse; the router hashes it for placement
+            meta["session"] = str(sess)
+        try:
+            rmeta, rpayload = self._router.dispatch(
+                meta, payload, deadline=dl,
+                session=str(sess) if sess is not None else None)
+        except _ShedSignal:
+            return self._shed(buf, "deadline expired in router")
+        except RouterError as e:
+            if self.fallback:
+                return self._route_fallback(buf, f"all backends down: {e}")
+            self._hc.set_status(_health.Status.FAILED,
+                                f"all backends down: {e}")
+            _events.record("query.connect_failed",
+                           f"{self.name}: all backends down: {e}",
+                           severity="error", element=self.name)
+            raise ConnectionError(
+                "tensor_query_client: request failed on every backend")
+        self._hc.beat()
+        if self._fb_active:
+            self._remote_restored()
+        out = payload_to_buffer(rmeta, rpayload)
+        out.pts, out.duration, out.offset = buf.pts, buf.duration, buf.offset
+        ctx = buf.meta.get(_tracing.CTX_META_KEY)
+        if ctx is not None:
+            out.meta[_tracing.CTX_META_KEY] = ctx
+            root = buf.meta.get(_tracing.ROOT_META_KEY)
+            if root is not None:
+                out.meta[_tracing.ROOT_META_KEY] = root
+        return self.push(out)
 
     def _chain_sync(self, buf: Buffer,
                     dl: Optional["_rp.Deadline"]) -> Optional[FlowReturn]:

@@ -82,11 +82,27 @@ _BYTES_TOTAL = _obs.registry().counter(
 
 
 #: chaos injection point (resilience/chaos.py installs/clears this):
-#: called as ``hook(direction, cmd, meta, payload) -> payload|None`` at
-#: the top of send_message ("send") and per received frame ("recv");
-#: None return drops the frame, a raise propagates into the caller's
-#: normal error handling. Disabled cost: one global load + None check.
+#: called as ``hook(direction, cmd, meta, payload, endpoint) ->
+#: payload|None`` at the top of send_message ("send") and per received
+#: frame ("recv"); ``endpoint`` is the socket's peer as "host:port"
+#: (None when unresolvable) so a plan can target one backend of a
+#: routed set. None return drops the frame, a raise propagates into
+#: the caller's normal error handling. Disabled cost: one global load
+#: + None check — the peer lookup only happens with a hook installed.
 CHAOS_HOOK = None
+
+
+def _peer_of(sock: socket.socket) -> Optional[str]:
+    """The socket's peer as ``"host:port"`` — chaos targeting only, so
+    failure is answered with None, never an exception."""
+    try:
+        peer = sock.getpeername()
+    except OSError:
+        return None
+    try:
+        return f"{peer[0]}:{peer[1]}"
+    except (TypeError, IndexError):
+        return None
 
 #: max bytes per wire chunk; also the granularity of receive timeouts
 CHUNK_SIZE = 1 << 20
@@ -142,7 +158,7 @@ def recv_message(sock: socket.socket,
                  ) -> Tuple[Cmd, Dict[str, Any], bytes]:
     cmd, meta, payload = _recv_one(sock)
     if CHAOS_HOOK is not None:
-        payload = CHAOS_HOOK("recv", cmd, meta, payload)
+        payload = CHAOS_HOOK("recv", cmd, meta, payload, _peer_of(sock))
         if payload is None:
             # frame dropped by the fault plan: deliver the next one —
             # from the caller's view the frame simply never arrived
@@ -219,7 +235,7 @@ def recv_message(sock: socket.socket,
 def send_message(sock: socket.socket, cmd: Cmd, meta: Dict[str, Any],
                  payload: bytes = b"") -> None:
     if CHAOS_HOOK is not None:
-        payload = CHAOS_HOOK("send", cmd, meta, payload)
+        payload = CHAOS_HOOK("send", cmd, meta, payload, _peer_of(sock))
         if payload is None:
             return  # frame silently eaten by the installed fault plan
     _MSG_TOTAL.labels("sent", cmd.name).inc()

@@ -173,15 +173,24 @@ class TensorQueryServerSrc(SourceElement):
                 cmd, meta, payload = recv_message(conn)
                 if cmd is Cmd.INFO_REQ:
                     # approve iff declared caps are compatible (REQUEST_INFO/
-                    # RESPOND_APPROVE handshake, tensor_query_common.h:42-51)
+                    # RESPOND_APPROVE handshake, tensor_query_common.h:42-51).
+                    # The fleet instance id joins this endpoint to its
+                    # pushed health/queue-depth snapshots, so a router
+                    # can place by live load instead of blind rotation.
                     send_message(conn, Cmd.INFO_APPROVE,
-                                 {"caps": str(self.caps), "client_id": cid})
+                                 {"caps": str(self.caps), "client_id": cid,
+                                  "instance": _fleet.default_instance()})
                 elif cmd is Cmd.PING:
                     send_message(conn, Cmd.PONG, {})
                 elif cmd is Cmd.DATA:
                     self._hc.beat()
                     buf = payload_to_buffer(meta, payload)
                     buf.meta["query_client_id"] = cid
+                    sess = meta.get("session")
+                    if sess is not None:
+                        # session affinity key survives the wire so the
+                        # serving layer can pin KV/prefix reuse to it
+                        buf.meta["session"] = sess
                     dms = meta.get(_rp.WIRE_KEY)
                     if dms is not None:
                         # re-anchor the remaining budget on THIS host's

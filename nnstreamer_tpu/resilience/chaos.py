@@ -46,7 +46,7 @@ log = logger("chaos")
 #: environment variable carrying a JSON fault plan (nns-launch honors it)
 ENV_VAR = "NNS_TPU_CHAOS"
 
-KINDS = ("drop", "delay", "corrupt", "disconnect")
+KINDS = ("drop", "delay", "corrupt", "disconnect", "partition")
 
 _INJECTED_TOTAL = _obs.registry().counter(
     "nnstpu_chaos_injected_total",
@@ -60,16 +60,24 @@ class Fault:
     ``target`` is ``"send"`` / ``"recv"`` (the query wire; ``cmd``
     optionally restricts to one command name, e.g. ``"DATA"`` so the
     INFO handshake survives) or ``"chain:<element>"`` (a specific sink
-    element; bare ``"chain"`` matches every element). Fire selection:
-    ``nth`` (an int or collection of ints, 1-based call numbers within
-    the matching stream) is exact; otherwise ``p`` draws per matching
-    call from the fault's own seeded PRNG. ``max_fires`` caps total
-    fires without disturbing the draw sequence.
+    element; bare ``"chain"`` matches every element). ``endpoint``
+    narrows a wire fault to one peer (``"host:port"`` as seen by the
+    socket) — how a plan kills exactly one backend of a routed set.
+    Fire selection: ``nth`` (an int or collection of ints, 1-based call
+    numbers within the matching stream) is exact; otherwise ``p`` draws
+    per matching call from the fault's own seeded PRNG. ``max_fires``
+    caps total fires without disturbing the draw sequence.
+
+    Kind ``partition`` is stateful: once its nth/p trigger fires, the
+    fault latches and EVERY subsequent matching frame raises
+    ConnectionError — one side of a network partition, not a one-shot
+    disconnect. The latch counts as a single fire in the audit log.
     """
 
     kind: str
     target: str = "send"
     cmd: Optional[str] = None
+    endpoint: Optional[str] = None
     nth: Any = None
     p: float = 0.0
     delay_s: float = 0.01
@@ -87,11 +95,14 @@ class Fault:
         else:
             self.nth_set = frozenset(int(n) for n in self.nth)
 
-    def matches(self, target: str, cmd: Optional[str]) -> bool:
+    def matches(self, target: str, cmd: Optional[str],
+                endpoint: Optional[str] = None) -> bool:
         if self.target == "chain":
             if not target.startswith("chain:"):
                 return False
         elif self.target != target:
+            return False
+        if self.endpoint is not None and self.endpoint != endpoint:
             return False
         return self.cmd is None or self.cmd == cmd
 
@@ -112,6 +123,10 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._counts = [0] * len(self.faults)
         self._fires = [0] * len(self.faults)
+        # partition faults latch: once triggered they fire on every
+        # subsequent matching frame until the plan is uninstalled
+        self._latched = [False] * len(self.faults)
+        self._latch_pending: List[Fault] = []
         # per-fault PRNG, seeded from (seed, index) mixed into one int
         # (tuple seeding is deprecated); large odd multiplier keeps
         # nearby seeds from producing overlapping streams
@@ -127,13 +142,19 @@ class FaultPlan:
         faults = [Fault(**f) for f in spec.get("faults", ())]
         return cls(faults, seed=int(spec.get("seed", 0)))
 
-    def decide(self, target: str, cmd: Optional[str] = None) -> List[Fault]:
+    def decide(self, target: str, cmd: Optional[str] = None,
+               endpoint: Optional[str] = None) -> List[Fault]:
         """Advance the schedule one call at ``target``; returns the
         faults that fire on this call (usually zero or one)."""
         hits: List[Fault] = []
         with self._lock:
             for i, f in enumerate(self.faults):
-                if not f.matches(target, cmd):
+                if not f.matches(target, cmd, endpoint):
+                    continue
+                if self._latched[i]:
+                    # partition already triggered: fires silently on
+                    # every matching frame (audited once, at the latch)
+                    hits.append(f)
                     continue
                 self._counts[i] += 1
                 n = self._counts[i]
@@ -147,10 +168,32 @@ class FaultPlan:
                 if fire and (f.max_fires is None
                              or self._fires[i] < f.max_fires):
                     self._fires[i] += 1
+                    if f.kind == "partition":
+                        self._latched[i] = True
+                        self._latch_pending.append(f)
                     self.fired.append({"kind": f.kind, "target": target,
-                                       "cmd": cmd, "call": n})
+                                       "cmd": cmd, "endpoint": endpoint,
+                                       "call": n})
                     hits.append(f)
         return hits
+
+    def heal(self) -> None:
+        """Release every latched partition (the net heals); the rest of
+        the schedule continues where it left off."""
+        with self._lock:
+            self._latched = [False] * len(self.faults)
+            self._latch_pending.clear()
+
+    def take_latch_notice(self, f: Fault) -> bool:
+        """True exactly once per latch of ``f`` — lets the hook emit
+        the partition event/log at the latch moment instead of on
+        every subsequently blocked frame."""
+        with self._lock:
+            try:
+                self._latch_pending.remove(f)
+                return True
+            except ValueError:
+                return False
 
 
 _ACTIVE: Optional[FaultPlan] = None
@@ -177,16 +220,32 @@ def _fire(f: Fault, target: str, detail: str) -> None:
 
 
 def _wire_hook(direction: str, cmd: Any, meta: Dict[str, Any],
-               payload: bytes) -> Optional[bytes]:
+               payload: bytes,
+               endpoint: Optional[str] = None) -> Optional[bytes]:
     """Installed as ``protocol.CHAOS_HOOK``. Returns the (possibly
     corrupted) payload, or None to drop the frame; raises
-    ConnectionError for an injected disconnect."""
+    ConnectionError for an injected disconnect or an active partition.
+    ``endpoint`` is the socket's peer (``"host:port"``) when the
+    protocol layer can resolve it — how endpoint-scoped faults single
+    out one backend of a routed set."""
     plan = _ACTIVE
     if plan is None:
         return payload
     name = getattr(cmd, "name", str(cmd))
-    for f in plan.decide(direction, name):
-        _fire(f, direction, f"cmd={name}")
+    for f in plan.decide(direction, name, endpoint):
+        if f.kind == "partition":
+            # frames keep dying while the partition holds, but the
+            # event/log land once, at the latch; the counter tracks
+            # every blackholed frame
+            if plan.take_latch_notice(f):
+                _fire(f, direction, f"cmd={name} endpoint={endpoint}")
+            else:
+                _INJECTED_TOTAL.labels(f.kind).inc()
+            raise ConnectionError(
+                f"chaos: partition active ({direction} {name} "
+                f"endpoint={endpoint})")
+        _fire(f, direction, f"cmd={name}" if endpoint is None
+              else f"cmd={name} endpoint={endpoint}")
         if f.kind == "delay":
             time.sleep(f.delay_s)
         elif f.kind == "disconnect":

@@ -63,6 +63,12 @@ _FALLBACK_TOTAL = _reg.counter(
     "nnstpu_resilience_fallback_total",
     "Buffers routed to a local fallback instead of the remote path",
     ("element",))
+#: hedged sends are spent capacity, not free latency wins — account
+#: every one so operators can see what the P95 tail costs
+_HEDGE_TOTAL = _reg.counter(
+    "nnstpu_resilience_hedges_total",
+    "Hedged duplicate dispatches issued against slow primaries",
+    ("element",))
 #: 0=closed 1=half-open 2=open; sampled at collection time through a
 #: weakref so the registry never pins a retired breaker
 _BREAKER_STATE = _reg.gauge(
@@ -332,3 +338,17 @@ def record_fallback(element: str, message: str, **attrs: Any) -> None:
     """Account one buffer routed to a local fallback path."""
     _FALLBACK_TOTAL.labels(element).inc()
     _events.record("resilience.fallback", message, element=element, **attrs)
+
+
+def record_hedge(element: str, message: str, **attrs: Any) -> None:
+    """Account one hedged duplicate dispatch (query.router)."""
+    _HEDGE_TOTAL.labels(element).inc()
+    _events.record("resilience.hedge", message, element=element, **attrs)
+
+
+def backend_breaker_name(owner: str, endpoint: str) -> str:
+    """Canonical breaker name for one backend of a routed set —
+    ``query:<owner>:<endpoint>`` — so the per-breaker state gauge
+    separates backends instead of aggregating a fleet into one series.
+    Cardinality is bounded by the configured backend set."""
+    return f"query:{owner}:{endpoint}"

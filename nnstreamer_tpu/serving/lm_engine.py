@@ -284,6 +284,11 @@ class _Request:
     #: resilience.policy.Deadline (or None): checked at submit and again
     #: at admission — expired work is shed, not prefilled
     deadline: Any = None
+    #: session affinity key (query.router consistent-hashes it so this
+    #: engine keeps seeing the session whose prefix cache it holds);
+    #: informational here — tagged on the request span and available
+    #: to KV policies, never used for scheduling
+    session: Optional[str] = None
     #: kv_cache.PageLease while admitted under paging (None otherwise):
     #: the request's page-table bookkeeping, released at retirement
     kv_lease: Any = None
@@ -518,7 +523,8 @@ class LMEngine:
     def submit(self, prompt: Sequence[int], max_new: int,
                eos: Optional[int] = None, *, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-               deadline: Any = None) -> int:
+               deadline: Any = None,
+               session: Optional[str] = None) -> int:
         """Queue a generation request; returns its request id.
 
         ``temperature``/``top_k``/``top_p`` select the decoding mode per
@@ -530,7 +536,10 @@ class LMEngine:
         whose deadline has already expired — at submit or later while
         still queued at admission — finishes empty immediately
         (``resilience.shed`` event + counter) instead of occupying a
-        slot behind the admission-stall watchdog.
+        slot behind the admission-stall watchdog. ``session`` is the
+        routing affinity key (query/router.py pins a session to one
+        engine so its radix prefix cache keeps hitting): recorded on
+        the request and its span, not a scheduling input.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         if p.size < 1:
@@ -565,7 +574,8 @@ class LMEngine:
         req = _Request(
             rid, p, max_new, eos, temperature=float(temperature),
             top_k=int(top_k), top_p=float(top_p), seed=int(seed),
-            t_submit=time.monotonic(), deadline=deadline)
+            t_submit=time.monotonic(), deadline=deadline,
+            session=str(session) if session is not None else None)
         if deadline is not None and deadline.expired():
             # shed at the door: the caller's budget is already spent,
             # so queueing would only delay everyone behind it
@@ -579,6 +589,8 @@ class LMEngine:
                 "serving.request", parent=_tracing.current_context(),
                 attrs={"engine": self._engine_label, "rid": rid,
                        "prompt_len": int(p.size), "max_new": int(max_new)})
+            if req.session is not None:
+                req.span.set_attribute("session", req.session)
             if req.span.recording and req.span.context.parent_id is not None:
                 # remote-parented request (came in over the query wire):
                 # mark the trace so fleet push exports the engine-side

@@ -5,7 +5,7 @@ types against the repo naming conventions.
 Metric convention (docs/observability.md): every metric is
 ``nnstpu_<layer>_<name>_<unit>`` with
 
-  * layer  in {pipeline, query, serving, resilience, chaos},
+  * layer  in {pipeline, query, serving, resilience, chaos, router},
   * counters    ending in ``_total``,
   * histograms  ending in ``_seconds``,
   * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes`` /
@@ -41,6 +41,14 @@ package never registers under another layer's name. check_resilience
 enforces both directions so policy telemetry can't drift into ad-hoc
 per-module names.
 
+Router placement (docs/resilience.md "Fleet routing & failover"): the
+``router`` metric/span/event layer belongs to
+nnstreamer_tpu/query/router.py — the multi-backend dispatch telemetry
+(placement, failover, backend lifecycle) is registered there only.
+check_router enforces it, mirroring check_resilience. Cardinality note:
+the ``backend`` label on router series carries configured ``host:port``
+endpoints — bounded by fleet size, NEVER per-request/session values.
+
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
 calls, ``.start_span(...)`` / ``start_span(...)`` tracing calls, and
@@ -63,7 +71,8 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parents[1]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
-LAYERS = ("pipeline", "query", "serving", "resilience", "chaos")
+LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
+          "router")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -71,15 +80,17 @@ UNIT_BY_TYPE = {
     # _pages: KV-page pool occupancy (serving kv_ family only)
     "gauge": ("depth", "slots", "bytes", "state", "pages"),
 }
-#: span layers add "device" — device.xprof has no metric series
-SPAN_LAYERS = ("pipeline", "query", "serving", "device")
+#: span layers add "device" — device.xprof has no metric series —
+#: and "router" (the dispatch span, query/router.py)
+SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
 #: event layers additionally allow "core" (the core/log.py bridge),
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
-#: and "resilience"/"chaos" (fault-policy decisions + injected faults,
-#: nnstreamer_tpu/resilience/)
+#: "resilience"/"chaos" (fault-policy decisions + injected faults,
+#: nnstreamer_tpu/resilience/), and "router" (multi-backend placement:
+#: failover/drain/spill audit trail, query/router.py)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
-                "fleet", "resilience", "chaos")
+                "fleet", "resilience", "chaos", "router")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -90,6 +101,12 @@ RESILIENCE_DIR = "resilience"
 #: ``pages`` gauge unit: both must stay inside KV_DIR (see module doc)
 KV_BODY_PREFIX = "kv_"
 KV_DIR = "serving"
+
+#: the ``router`` metric/span/event layer is owned by the query
+#: router module alone (see module doc); the path is matched on its
+#: final two parts so the lint follows the file, not an absolute root
+ROUTER_LAYER = "router"
+ROUTER_FILE = ("query", "router.py")
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -254,6 +271,50 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_events(root)
     problems += check_resilience(root)
     problems += check_kv(root)
+    problems += check_router(root)
+    return problems
+
+
+def _is_router_file(path: Path) -> bool:
+    return tuple(path.parts[-2:]) == ROUTER_FILE
+
+
+def check_router(root: Path = SOURCE_ROOT):
+    """Placement lint for the multi-backend routing telemetry: every
+    ``router``-layer metric, span, and event is emitted from
+    nnstreamer_tpu/query/router.py (other modules reach routing through
+    QueryRouter, never by minting router.* names). The reverse
+    direction stays loose on purpose — router.py legitimately emits
+    under ``resilience`` via the policy helpers."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{ROUTER_LAYER!r} layer outside "
+                f"nnstreamer_tpu/query/router.py — routing telemetry "
+                f"lives with the router")
+    for path, lineno, name in iter_span_sites(root):
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: span {name!r} uses the "
+                f"{ROUTER_LAYER!r} layer outside "
+                f"nnstreamer_tpu/query/router.py")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{ROUTER_LAYER!r} layer outside "
+                f"nnstreamer_tpu/query/router.py")
     return problems
 
 

@@ -199,6 +199,54 @@ def test_kv_flag_validation_rejected(argv, monkeypatch):
     assert "NNS_LM_KV_PAGES" not in os.environ
 
 
+@pytest.mark.parametrize("argv", [
+    ["--hedge-ms", "5"],                                # hedging is routed-only
+    ["--backends", "nonsense"],                         # not host:port
+    ["--backends", "127.0.0.1:1,127.0.0.1:1"],          # duplicate endpoint
+    ["--backends", "127.0.0.1:1,x:70000"],              # port out of range
+    ["--backends", "127.0.0.1:1", "--hedge-ms", "5"],   # hedge needs >= 2
+    ["--backends", "127.0.0.1:1,127.0.0.1:2", "--hedge-ms", "0"],
+], ids=["hedge-alone", "bad-endpoint", "dup-endpoint", "bad-port",
+        "hedge-single-backend", "zero-hedge"])
+def test_backends_flag_validation_rejected(argv):
+    with pytest.raises(SystemExit) as ei:
+        cli_main(argv + ["videotestsrc num-buffers=1 ! tensor_converter "
+                         "! tensor_query_client ! tensor_sink"])
+    assert ei.value.code == 2
+
+
+def test_backends_flag_needs_a_query_client():
+    with pytest.raises(SystemExit) as ei:
+        cli_main(["--backends", "127.0.0.1:1",
+                  "videotestsrc num-buffers=1 ! tensor_converter ! "
+                  "tensor_sink"])
+    assert ei.value.code == 2
+
+
+def test_backends_flag_wires_router_with_fallback_last_resort():
+    # both endpoints dead: the routed client exhausts its backends and
+    # takes the local fallback — the run COMPLETES (rc 0), the fleet
+    # flags reached the element through the real CLI path
+    import socket
+
+    def _free():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    eps = f"127.0.0.1:{_free()},127.0.0.1:{_free()}"
+    rc = cli_main(["--backends", eps, "--hedge-ms", "5",
+                   "--fallback", "passthrough", "--timeout", "60",
+                   "videotestsrc num-buffers=2 width=8 height=8 ! "
+                   "tensor_converter ! "
+                   "tensor_query_client max-request-retry=1 timeout-s=0.3 "
+                   "retry-base-s=0.001 retry-max-s=0.002 "
+                   "breaker-threshold=1 ! tensor_sink"])
+    assert rc == 0
+
+
 def test_list_models_includes_zoo_families():
     import io
     from contextlib import redirect_stdout
