@@ -269,7 +269,7 @@ class Pipeline:
         self.name = name
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
-        self._sinks_eos: set = set()
+        self._sinks_eos: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self.running = False
         #: fuse transform→filter chains into one XLA program at start
@@ -311,7 +311,10 @@ class Pipeline:
     def start(self) -> None:
         if self.running:
             return
-        self._sinks_eos.clear()
+        with self._lock:
+            # start() racing a late _sink_eos from the previous run must
+            # not lose the wipe (set.clear vs add interleave)
+            self._sinks_eos.clear()
         self.bus.clear()
         for el in self.elements.values():
             self._validate_links(el)

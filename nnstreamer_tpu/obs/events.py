@@ -76,10 +76,10 @@ class EventRing:
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
                  enabled: bool = False):
         self._lock = threading.Lock()
-        self._dq: "deque[Dict[str, Any]]" = deque(maxlen=int(capacity))
+        self._dq: "deque[Dict[str, Any]]" = deque(maxlen=int(capacity))  # guarded-by: _lock
         self._enabled = bool(enabled)
-        self._seq = 0
-        self._dropped = 0
+        self._seq = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
 
     # -- enable/disable ------------------------------------------------ #
     @property

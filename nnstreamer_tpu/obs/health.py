@@ -195,7 +195,7 @@ class HealthRegistry:
     def __init__(self, enabled: bool = False):
         self._lock = threading.Lock()
         self._enabled = bool(enabled)
-        self._components: "OrderedDict[str, Component]" = OrderedDict()
+        self._components: "OrderedDict[str, Component]" = OrderedDict()  # guarded-by: _lock
         #: readiness conditions: name -> fn() -> True/False, or None to
         #: self-retire (weakref-backed: owner collected)
         self._conditions: "OrderedDict[str, Callable]" = OrderedDict()

@@ -182,7 +182,7 @@ class _Family:
         self.type = mtype
         self.labelnames = labelnames
         self._buckets = buckets
-        self._children: Dict[Tuple[str, ...], _Child] = {}
+        self._children: Dict[Tuple[str, ...], _Child] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def labels(self, *values: Any, **kv: Any) -> Any:
@@ -227,7 +227,7 @@ class MetricsRegistry:
     """
 
     def __init__(self, enabled: bool = True):
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._enabled = bool(enabled)
 

@@ -225,8 +225,8 @@ class SpanStore:
                  max_spans_per_trace: int = 512, enabled: bool = False,
                  sample_every: int = 1):
         self._lock = threading.Lock()
-        self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
-        self._slow: Dict[str, int] = {}  # trace_id -> duration_ns
+        self._traces: "OrderedDict[str, _Trace]" = OrderedDict()  # guarded-by: _lock
+        self._slow: Dict[str, int] = {}  # trace_id->duration_ns # guarded-by: _lock
         self.max_traces = int(max_traces)
         self.keep_slowest = int(keep_slowest)
         self.max_spans_per_trace = int(max_spans_per_trace)
@@ -322,7 +322,7 @@ class SpanStore:
                 self._rank_slow(tid, tr.duration_ns)
             self._evict_locked()
 
-    def _rank_slow(self, tid: str, duration_ns: int) -> None:
+    def _rank_slow(self, tid: str, duration_ns: int) -> None:  # guarded-by: _lock
         # maintain the protected slowest-N set (store lock held)
         prev = self._slow.get(tid)
         if prev is not None:
@@ -513,7 +513,11 @@ class SpanStore:
                 span.start_ns = int(span.wall * 1e9) + offset_ns
                 span.end_ns = span.start_ns + max(int(d["dur_ns"]), 0)
                 span._token = None
-            except (KeyError, TypeError, ValueError):
+            except Exception:
+                # the docstring's "never raised" is load-bearing: any
+                # malformed field shape (not just the anticipated
+                # KeyError/TypeError/ValueError) must skip the entry,
+                # not 500 the aggregator
                 continue
             # bypass Span.end(): end_ns is already set, record directly
             tid = span.context.trace_id

@@ -240,8 +240,13 @@ class TensorQueryClient(Element):
                 send_message(sock, Cmd.INFO_REQ,
                              {"caps": str(self.sink_pad.caps or "")})
                 cmd, meta, _ = recv_message(sock)
+                if cmd is Cmd.INFO_DENY:
+                    raise ConnectionError(
+                        f"server denied connection: "
+                        f"{meta.get('error', meta)}")
                 if cmd is not Cmd.INFO_APPROVE:
-                    raise ConnectionError(f"server denied connection: {meta}")
+                    raise ConnectionError(f"unexpected handshake reply "
+                                          f"{cmd}: {meta}")
                 self._m_reconnects.inc()
                 self._hc.count("reconnect")  # watchdog storm-rule input
                 self._hc.beat()

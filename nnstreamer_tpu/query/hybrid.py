@@ -18,6 +18,8 @@ import socketserver
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..graph.element import join_or_warn
+
 
 class DiscoveryBroker:
     """Line-JSON TCP name service: {"op":"register","topic":t,"host":h,"port":p}
@@ -75,6 +77,13 @@ class DiscoveryBroker:
 
     def stop(self) -> None:
         self._server.shutdown()
+        # join between shutdown() and server_close(): serve_forever may
+        # still be inside its poll when close() pulls the socket away,
+        # and the leaked thread then outlives the broker object
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            join_or_warn(t, "query-broker", timeout=2.0)
+        self._thread = None
         self._server.server_close()
 
 

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..core.log import logger
+from ..graph.element import join_or_warn
 from .protocol import recv_exact as _recv_exact
 
 log = logger("mqtt")
@@ -310,6 +311,9 @@ def ntp_epoch_us(hosts: Sequence[Tuple[str, int]] = (),
             data, _ = sock.recvfrom(48)
             if len(data) < 48:
                 raise OSError("short NTP response")
+            # SNTP (RFC 4330) reply parsing: the pack side lives on the
+            # NTP server, not in this codebase
+            # nnslint: disable=wire/struct-format
             sec, frac = struct.unpack_from(">II", data, 40)
             if sec <= NTP_DELTA:
                 raise OSError(f"NTP transmit timestamp invalid: {sec}")
@@ -344,11 +348,11 @@ class MqttBroker:
     spec-conforming client."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 1883):
-        self._subs: List[Tuple[str, socket.socket]] = []
+        self._subs: List[Tuple[str, socket.socket]] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         #: per-subscriber write locks: concurrent publishers must not
         #: interleave frame bytes on one subscriber socket
-        self._wlocks: Dict[int, threading.Lock] = {}
+        self._wlocks: Dict[int, threading.Lock] = {}  # guarded-by: _lock
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -446,6 +450,13 @@ class MqttBroker:
             self._listener.close()
         except OSError:
             pass
+        # join the accept thread: its (timeout-bounded) accept() keeps
+        # the kernel LISTEN socket alive past close(), so an immediate
+        # broker restart on the same port races EADDRINUSE without this
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            join_or_warn(t, "mqtt-broker", timeout=2.0)
+        self._thread = None
 
 
 # --------------------------------------------------------------------------- #

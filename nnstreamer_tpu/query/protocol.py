@@ -97,11 +97,8 @@ def _peer_of(sock: socket.socket) -> Optional[str]:
     failure is answered with None, never an exception."""
     try:
         peer = sock.getpeername()
-    except OSError:
-        return None
-    try:
         return f"{peer[0]}:{peer[1]}"
-    except (TypeError, IndexError):
+    except Exception:
         return None
 
 #: max bytes per wire chunk; also the granularity of receive timeouts

@@ -176,9 +176,14 @@ class Backend:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             send_message(sock, Cmd.INFO_REQ, {"caps": caps})
             cmd, meta, _ = recv_message(sock)
+            if cmd is Cmd.INFO_DENY:
+                raise ConnectionError(
+                    f"{self.endpoint}: server denied connection: "
+                    f"{meta.get('error', meta)}")
             if cmd is not Cmd.INFO_APPROVE:
                 raise ConnectionError(
-                    f"{self.endpoint}: server denied connection: {meta}")
+                    f"{self.endpoint}: unexpected handshake reply "
+                    f"{cmd}: {meta}")
         except BaseException:
             try:
                 sock.close()
@@ -290,8 +295,8 @@ class BackendSet:
         self._breaker_threshold = int(breaker_threshold)
         self._breaker_reset_s = float(breaker_reset_s)
         self._lock = threading.Lock()
-        self._backends: Dict[str, Backend] = {}
-        self._ring: List[Tuple[int, str]] = []
+        self._backends: Dict[str, Backend] = {}  # guarded-by: _lock
+        self._ring: List[Tuple[int, str]] = []  # guarded-by: _lock
         self._rng = rng if rng is not None else random.Random()
         for host, port in endpoints:
             self.add(f"{host}:{port}")
@@ -367,7 +372,7 @@ class BackendSet:
                            f"closed", element=self.owner,
                            backend=be.endpoint)
 
-    def _rebuild_ring(self) -> None:
+    def _rebuild_ring(self) -> None:  # guarded-by: _lock
         """Affinity ring over ACTIVE backends (draining/closed members
         take no new sessions). Caller holds ``_lock``."""
         ring: List[Tuple[int, str]] = []

@@ -84,10 +84,10 @@ class TensorQueryServerSrc(SourceElement):
         self.types: Optional[str] = None
         super().__init__(name, **props)
         self._listener: Optional[socket.socket] = None
-        self._conns: Dict[int, socket.socket] = {}
-        self._conn_seq = 0
+        self._conns: Dict[int, socket.socket] = {}  # guarded-by: _lock
+        self._conn_seq = 0  # guarded-by: _lock
         self._inbox: "__import__('queue').Queue" = None
-        self._threads = []
+        self._threads = []  # guarded-by: _lock
         # server-side offload telemetry (message/byte counts live at the
         # protocol layer): accepted connections, and inbox depth read at
         # collection time
@@ -134,8 +134,11 @@ class TensorQueryServerSrc(SourceElement):
             _server_pairs[int(self.id)] = self
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name=f"qsrv-accept:{self.name}")
+        # register BEFORE start: stop() snapshots _threads under _lock,
+        # so a started-but-unregistered worker would be unjoinable
+        with self._lock:
+            self._threads.append(t)
         t.start()
-        self._threads.append(t)
         self.bound_port = self._listener.getsockname()[1]
         return self.caps
 
@@ -164,8 +167,9 @@ class TensorQueryServerSrc(SourceElement):
                            element=self.name, client=cid)
             t = threading.Thread(target=self._client_loop, args=(cid, conn),
                                  daemon=True, name=f"qsrv-conn{cid}")
+            with self._lock:
+                self._threads.append(t)
             t.start()
-            self._threads.append(t)
 
     def _client_loop(self, cid: int, conn: socket.socket) -> None:
         try:
@@ -177,6 +181,19 @@ class TensorQueryServerSrc(SourceElement):
                     # The fleet instance id joins this endpoint to its
                     # pushed health/queue-depth snapshots, so a router
                     # can place by live load instead of blind rotation.
+                    peer_caps = str(meta.get("caps") or "")
+                    peer_mt = peer_caps.split("(", 1)[0].strip()
+                    if peer_mt and self.caps is not None \
+                            and peer_mt != self.caps.media_type:
+                        # explicit deny beats letting the first DATA frame
+                        # die on a decode error: the client sees the reason
+                        # and its router can strike this backend cleanly
+                        send_message(conn, Cmd.INFO_DENY,
+                                     {"error": f"caps mismatch: server "
+                                      f"streams {self.caps.media_type}, "
+                                      f"client declared {peer_mt}",
+                                      "caps": str(self.caps)})
+                        continue
                     send_message(conn, Cmd.INFO_APPROVE,
                                  {"caps": str(self.caps), "client_id": cid,
                                   "instance": _fleet.default_instance()})
@@ -296,10 +313,12 @@ class TensorQueryServerSrc(SourceElement):
         # past close(), so returning before it exits races an immediate
         # rebind of the same port with EADDRINUSE (server restart)
         cur = threading.current_thread()
-        for t in self._threads:
+        with self._lock:
+            workers = list(self._threads)
+            self._threads = []
+        for t in workers:
             if t is not cur:
                 join_or_warn(t, self.name, timeout=2.0)
-        self._threads = []
 
 
 @register_element
@@ -322,10 +341,10 @@ class TensorQueryServerSink(Element):
         self.async_depth = 1
         super().__init__(name, **props)
         self.add_sink_pad(template=Caps.any_tensors())
-        self._dq: "__import__('collections').deque" = None
+        self._dq: "__import__('collections').deque" = None  # guarded-by: _cv
         self._cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
-        self._draining = False
+        self._draining = False  # guarded-by: _cv
 
     def _route(self, buf: Buffer) -> None:
         with _pairs_lock:
@@ -341,8 +360,11 @@ class TensorQueryServerSink(Element):
     def start(self) -> None:
         import collections
 
-        self._dq = collections.deque()
-        self._draining = True
+        # publish the fresh deque/flag under _cv: a chain() racing a
+        # restart must never observe the new deque with the old flag
+        with self._cv:
+            self._dq = collections.deque()
+            self._draining = True
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name=f"qsink:{self.name}")
         self._worker.start()
@@ -353,7 +375,7 @@ class TensorQueryServerSink(Element):
             self._cv.notify_all()
         w = self._worker
         if w is not None and w is not threading.current_thread():
-            w.join(timeout=5)
+            join_or_warn(w, self.name, timeout=5.0)
         self._worker = None
 
     def _drain(self) -> None:

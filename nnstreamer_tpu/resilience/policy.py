@@ -189,10 +189,10 @@ class CircuitBreaker:
         self.half_open_probes = int(half_open_probes)
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probes = 0
+        self._state = CLOSED  # guarded-by: _lock
+        self._failures = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probes = 0  # guarded-by: _lock
         ref = weakref.ref(self)
         _BREAKER_STATE.labels(name).set_function(
             lambda: (lambda b: 0 if b is None
@@ -242,7 +242,7 @@ class CircuitBreaker:
 
     # transitions run under self._lock (the event ring takes its own
     # independent lock; no ordering hazard)
-    def _to_open(self, why: str) -> None:
+    def _to_open(self, why: str) -> None:  # guarded-by: _lock
         self._state = OPEN
         self._opened_at = self._clock()
         self._probes = 0
@@ -251,14 +251,14 @@ class CircuitBreaker:
                        f"{self.name}: circuit opened ({why})",
                        severity="warning", breaker=self.name)
 
-    def _to_half_open(self) -> None:
+    def _to_half_open(self) -> None:  # guarded-by: _lock
         self._state = HALF_OPEN
         self._probes = 0
         _events.record("resilience.breaker_half_open",
                        f"{self.name}: cooldown elapsed, probing",
                        breaker=self.name)
 
-    def _to_closed(self) -> None:
+    def _to_closed(self) -> None:  # guarded-by: _lock
         self._state = CLOSED
         self._failures = 0
         self._probes = 0

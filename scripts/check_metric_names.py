@@ -1,446 +1,32 @@
 #!/usr/bin/env python
-"""Lint registered metric names, span names AND flight-recorder event
-types against the repo naming conventions.
+"""Compatibility shim: the metric/span/event/placement naming lint now
+lives in the nnslint registry (scripts/nnslint/naming_compat.py, run
+as the ``naming/*`` rule family by ``python -m scripts.nnslint``).
 
-Metric convention (docs/observability.md): every metric is
-``nnstpu_<layer>_<name>_<unit>`` with
-
-  * layer  in {pipeline, query, serving, resilience, chaos, router},
-  * counters    ending in ``_total``,
-  * histograms  ending in ``_seconds``,
-  * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes`` /
-    ``_state`` / ``_pages``,
-  * label keys matching ``[a-z_][a-z0-9_]*``, never the reserved
-    ``instance``/``role`` (appended by fleet federation) or ``le``
-    (histogram encoder), and at most 8 keys per family (cardinality
-    guard).
-
-Span convention (docs/observability.md "Tracing"): every span name is
-a literal lowercase dotted ``<layer>.<operation>`` with layer in
-{pipeline, query, serving, device} — e.g. ``serving.prefill``.
-
-Event convention (docs/observability.md "Health & flight recorder"):
-every flight-recorder event type is the same lowercase dotted
-``<layer>.<event>`` shape, with layer additionally allowing {core, obs}
-(the log bridge and the obs subsystem itself emit events) — e.g.
-``pipeline.stall``, ``query.reconnect_storm``, ``core.log``.
-
-KV-cache placement (docs/performance.md "Paged KV cache"): every
-``serving`` metric whose body starts with ``kv_`` belongs to the paged
-KV cache and is registered in nnstreamer_tpu/serving/ — no other
-package invents ``kv_*`` serving series, and the ``pages`` gauge unit
-is reserved for those bodies (a ``_pages`` gauge outside the kv family
-is a naming drift, not a new convention). check_kv enforces both
-directions, mirroring check_resilience.
-
-Resilience placement (docs/resilience.md): the ``resilience``/``chaos``
-metric + event layers belong to nnstreamer_tpu/resilience/ — every
-CircuitBreaker/RetryPolicy/FaultPlan series is registered there (other
-modules record through its helpers), and conversely the resilience
-package never registers under another layer's name. check_resilience
-enforces both directions so policy telemetry can't drift into ad-hoc
-per-module names.
-
-Router placement (docs/resilience.md "Fleet routing & failover"): the
-``router`` metric/span/event layer belongs to
-nnstreamer_tpu/query/router.py — the multi-backend dispatch telemetry
-(placement, failover, backend lifecycle) is registered there only.
-check_router enforces it, mirroring check_resilience. Cardinality note:
-the ``backend`` label on router series carries configured ``host:port``
-endpoints — bounded by fleet size, NEVER per-request/session values.
-
-The check greps source for literal first arguments of
-``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
-calls, ``.start_span(...)`` / ``start_span(...)`` tracing calls, and
-``events.record(...)`` / ``_events.record(...)`` / bare ``record(...)``
-flight-recorder calls, so drift fails CI (wired as a tier-1 test:
-tests/test_metric_names.py) the moment an off-convention name lands.
-Registrations built from non-literal names are invisible to this lint —
-keep names literal.
-
-Exit 0 when clean; exit 1 listing every violation.
+This path keeps the original module API — ``check``, ``check_names``,
+``check_labels``, ``check_spans``, ``check_events``,
+``check_resilience``, ``check_kv``, ``check_router``, the ``iter_*``
+helpers, the convention constants, and ``main`` — so
+tests/test_metric_names.py and any external callers keep working
+unchanged.
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-REPO_ROOT = Path(__file__).resolve().parents[1]
-SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
+# the shim is imported both as a bare module (tests put scripts/ on
+# sys.path) and run as a script; either way the repo root must be
+# importable for the scripts.nnslint package
+_REPO_ROOT = str(Path(__file__).resolve().parents[1])
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router")
-UNIT_BY_TYPE = {
-    "counter": ("total",),
-    "histogram": ("seconds",),
-    # _state: enumerated-condition gauges (e.g. breaker 0/1/2);
-    # _pages: KV-page pool occupancy (serving kv_ family only)
-    "gauge": ("depth", "slots", "bytes", "state", "pages"),
-}
-#: span layers add "device" — device.xprof has no metric series —
-#: and "router" (the dispatch span, query/router.py)
-SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
-#: event layers additionally allow "core" (the core/log.py bridge),
-#: "obs" (the obs subsystem's own events), "fleet" (cross-process
-#: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
-#: "resilience"/"chaos" (fault-policy decisions + injected faults,
-#: nnstreamer_tpu/resilience/), and "router" (multi-backend placement:
-#: failover/drain/spill audit trail, query/router.py)
-EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
-                "fleet", "resilience", "chaos", "router")
-
-#: layers OWNED by the resilience package: registrations under these
-#: names must live in RESILIENCE_DIR and vice versa (see module doc)
-RESILIENCE_LAYERS = frozenset({"resilience", "chaos"})
-RESILIENCE_DIR = "resilience"
-
-#: the paged KV cache owns the ``kv_``-prefixed serving bodies and the
-#: ``pages`` gauge unit: both must stay inside KV_DIR (see module doc)
-KV_BODY_PREFIX = "kv_"
-KV_DIR = "serving"
-
-#: the ``router`` metric/span/event layer is owned by the query
-#: router module alone (see module doc); the path is matched on its
-#: final two parts so the lint follows the file, not an absolute root
-ROUTER_LAYER = "router"
-ROUTER_FILE = ("query", "router.py")
-
-#: label names must be legal Prometheus label identifiers
-LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
-#: labels the fleet layer/format owns: ``instance``/``role`` are
-#: appended by the aggregator to every federated series, ``le`` by the
-#: histogram encoder — a user metric declaring them would collide
-RESERVED_LABELS = frozenset({"instance", "role", "le"})
-#: cardinality guard: a family declaring more label keys than this is
-#: a combinatorial-explosion bug, not a schema
-MAX_LABEL_KEYS = 8
-
-#: reg.counter("name"... — dotted call so plain functions named e.g.
-#: ``gauge()`` elsewhere don't false-positive
-_CALL_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
-
-_NAME_RE = re.compile(
-    r"^nnstpu_(?P<layer>[a-z0-9]+)_(?P<body>[a-z0-9_]+)_(?P<unit>[a-z0-9]+)$")
-
-#: start_span("name"... — both module-level and store-method calls;
-#: \b keeps e.g. ``restart_spanner(`` from matching
-_SPAN_CALL_RE = re.compile(r"\bstart_span\(\s*[\"']([^\"']+)[\"']")
-
-_SPAN_NAME_RE = re.compile(
-    r"^(?P<layer>[a-z]+)\.(?P<op>[a-z][a-z0-9_]*)$")
-
-#: events.record("type"... / _events.record("type"... / a bare
-#: record("type"... (module-internal call in obs/events.py). The
-#: lookbehind keeps method calls on OTHER objects — ``stats.record(``,
-#: ``._record(`` — from matching; those take no literal name anyway.
-_EVENT_CALL_RE = re.compile(
-    r"(?:(?<![\w.])record|\b(?:events|_events)\.record)"
-    r"\(\s*[\"']([^\"']+)[\"']")
-
-_EVENT_NAME_RE = re.compile(
-    r"^(?P<layer>[a-z]+)\.(?P<event>[a-z][a-z0-9_]*)$")
-
-
-def iter_registrations(root: Path = SOURCE_ROOT):
-    """Yield (path, lineno, metric_type, name) for every literal-name
-    registry call under ``root``."""
-    for path in sorted(root.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        # whole-file scan: registrations routinely wrap the name onto
-        # the line after the open paren (\s* spans newlines)
-        for m in _CALL_RE.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            yield path, lineno, m.group(1), m.group(2)
-
-
-def iter_label_decls(root: Path = SOURCE_ROOT):
-    """Yield (path, lineno, name, labelnames) for every registry call
-    whose label tuple/list is written as literals. AST-based (unlike
-    the name greps) because label tuples routinely share lines with
-    help strings containing parens; only literal elements are visible —
-    keep label schemas literal, same rule as names."""
-    for path in sorted(root.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"))
-        except SyntaxError:
-            continue
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in UNIT_BY_TYPE):
-                continue
-            name = None
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                name = node.args[0].value
-            if name is None:
-                continue
-            labels_node = node.args[2] if len(node.args) > 2 else None
-            if labels_node is None:
-                for kw in node.keywords:
-                    if kw.arg == "labelnames":
-                        labels_node = kw.value
-            if not isinstance(labels_node, (ast.Tuple, ast.List)):
-                continue
-            labels = [e.value for e in labels_node.elts
-                      if isinstance(e, ast.Constant)
-                      and isinstance(e.value, str)]
-            yield path, node.lineno, name, labels
-
-
-def check_labels(root: Path = SOURCE_ROOT):
-    """Label-name violations: illegal identifiers, reserved names, and
-    families declaring more than MAX_LABEL_KEYS keys."""
-    problems = []
-    for path, lineno, name, labels in iter_label_decls(root):
-        where = _where(path, lineno)
-        for lbl in labels:
-            if not LABEL_NAME_RE.match(lbl):
-                problems.append(
-                    f"{where}: {name!r} label {lbl!r} does not match "
-                    f"{LABEL_NAME_RE.pattern}")
-            elif lbl in RESERVED_LABELS:
-                problems.append(
-                    f"{where}: {name!r} label {lbl!r} is reserved "
-                    f"(fleet federation appends instance/role; the "
-                    f"histogram encoder owns le)")
-        if len(labels) > MAX_LABEL_KEYS:
-            problems.append(
-                f"{where}: {name!r} declares {len(labels)} label keys "
-                f"(> {MAX_LABEL_KEYS}) — cardinality guard")
-    return problems
-
-
-def iter_span_sites(root: Path = SOURCE_ROOT):
-    """Yield (path, lineno, span_name) for every literal-name
-    ``start_span`` call under ``root``."""
-    for path in sorted(root.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for m in _SPAN_CALL_RE.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            yield path, lineno, m.group(1)
-
-
-def iter_event_sites(root: Path = SOURCE_ROOT):
-    """Yield (path, lineno, event_type) for every literal-type
-    flight-recorder ``record`` call under ``root``."""
-    for path in sorted(root.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for m in _EVENT_CALL_RE.finditer(text):
-            lineno = text.count("\n", 0, m.start()) + 1
-            yield path, lineno, m.group(1)
-
-
-def _where(path: Path, lineno: int) -> str:
-    rel = path.relative_to(REPO_ROOT) if REPO_ROOT in path.parents else path
-    return f"{rel}:{lineno}"
-
-
-def check(root: Path = SOURCE_ROOT):
-    """Return a list of violation strings (empty = clean)."""
-    problems = []
-    found = 0
-    for path, lineno, mtype, name in iter_registrations(root):
-        found += 1
-        where = _where(path, lineno)
-        m = _NAME_RE.match(name)
-        if m is None:
-            problems.append(
-                f"{where}: {name!r} does not match "
-                "nnstpu_<layer>_<name>_<unit>")
-            continue
-        if m.group("layer") not in LAYERS:
-            problems.append(
-                f"{where}: {name!r} layer {m.group('layer')!r} not in "
-                f"{LAYERS}")
-        units = UNIT_BY_TYPE[mtype]
-        if m.group("unit") not in units:
-            problems.append(
-                f"{where}: {name!r} is a {mtype} but unit "
-                f"{m.group('unit')!r} not in {units}")
-    if found == 0:
-        problems.append(
-            f"no metric registrations found under {root} — "
-            "lint regex out of sync with the registry API?")
-    problems += check_labels(root)
-    problems += check_spans(root)
-    problems += check_events(root)
-    problems += check_resilience(root)
-    problems += check_kv(root)
-    problems += check_router(root)
-    return problems
-
-
-def _is_router_file(path: Path) -> bool:
-    return tuple(path.parts[-2:]) == ROUTER_FILE
-
-
-def check_router(root: Path = SOURCE_ROOT):
-    """Placement lint for the multi-backend routing telemetry: every
-    ``router``-layer metric, span, and event is emitted from
-    nnstreamer_tpu/query/router.py (other modules reach routing through
-    QueryRouter, never by minting router.* names). The reverse
-    direction stays loose on purpose — router.py legitimately emits
-    under ``resilience`` via the policy helpers."""
-    problems = []
-    for path, lineno, _mtype, name in iter_registrations(root):
-        m = _NAME_RE.match(name)
-        if m is None:
-            continue  # shape violations already reported by check()
-        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
-            problems.append(
-                f"{_where(path, lineno)}: {name!r} uses the "
-                f"{ROUTER_LAYER!r} layer outside "
-                f"nnstreamer_tpu/query/router.py — routing telemetry "
-                f"lives with the router")
-    for path, lineno, name in iter_span_sites(root):
-        m = _SPAN_NAME_RE.match(name)
-        if m is None:
-            continue
-        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
-            problems.append(
-                f"{_where(path, lineno)}: span {name!r} uses the "
-                f"{ROUTER_LAYER!r} layer outside "
-                f"nnstreamer_tpu/query/router.py")
-    for path, lineno, name in iter_event_sites(root):
-        m = _EVENT_NAME_RE.match(name)
-        if m is None:
-            continue
-        if m.group("layer") == ROUTER_LAYER and not _is_router_file(path):
-            problems.append(
-                f"{_where(path, lineno)}: event {name!r} uses the "
-                f"{ROUTER_LAYER!r} layer outside "
-                f"nnstreamer_tpu/query/router.py")
-    return problems
-
-
-def check_kv(root: Path = SOURCE_ROOT):
-    """Placement lint for the paged-KV-cache telemetry: every
-    ``serving`` metric with a ``kv_``-prefixed body is registered under
-    nnstreamer_tpu/serving/ (the cache records its own pool/prefix
-    series — other modules read them through the registry), and the
-    ``pages`` gauge unit never appears outside that family."""
-    problems = []
-    for path, lineno, _mtype, name in iter_registrations(root):
-        m = _NAME_RE.match(name)
-        if m is None:
-            continue  # shape violations already reported by check()
-        is_kv = (m.group("layer") == "serving"
-                 and m.group("body").startswith(KV_BODY_PREFIX))
-        in_pkg = KV_DIR in path.parts
-        if is_kv and not in_pkg:
-            problems.append(
-                f"{_where(path, lineno)}: {name!r} uses the serving "
-                f"{KV_BODY_PREFIX}* body outside "
-                f"nnstreamer_tpu/{KV_DIR}/ — the paged KV cache owns "
-                f"that family")
-        elif m.group("unit") == "pages" and not is_kv:
-            problems.append(
-                f"{_where(path, lineno)}: {name!r} uses the 'pages' "
-                f"gauge unit reserved for serving "
-                f"{KV_BODY_PREFIX}* bodies")
-    return problems
-
-
-def check_resilience(root: Path = SOURCE_ROOT):
-    """Placement lint for the fault-policy telemetry: every metric in
-    the ``resilience``/``chaos`` layers is registered under
-    nnstreamer_tpu/resilience/ (breaker/retry/shed/fallback series are
-    the policy objects' own — other modules go through their helpers),
-    and the resilience package registers under no other layer."""
-    problems = []
-    for path, lineno, _mtype, name in iter_registrations(root):
-        m = _NAME_RE.match(name)
-        if m is None:
-            continue  # shape violations already reported by check()
-        layer = m.group("layer")
-        in_pkg = RESILIENCE_DIR in path.parts
-        if layer in RESILIENCE_LAYERS and not in_pkg:
-            problems.append(
-                f"{_where(path, lineno)}: {name!r} uses the {layer!r} "
-                f"layer outside nnstreamer_tpu/{RESILIENCE_DIR}/ — "
-                f"record through resilience.policy/chaos helpers instead")
-        elif in_pkg and layer not in RESILIENCE_LAYERS:
-            problems.append(
-                f"{_where(path, lineno)}: {name!r} registered inside "
-                f"nnstreamer_tpu/{RESILIENCE_DIR}/ must use a layer in "
-                f"{sorted(RESILIENCE_LAYERS)}, not {layer!r}")
-    return problems
-
-
-def check_spans(root: Path = SOURCE_ROOT):
-    """Span-name violations under ``root``. Zero span sites is only a
-    problem for the real source tree (the metric check already guards
-    arbitrary roots; the tracing API might legitimately be absent from
-    a tree under test)."""
-    problems = []
-    found = 0
-    for path, lineno, name in iter_span_sites(root):
-        found += 1
-        where = _where(path, lineno)
-        m = _SPAN_NAME_RE.match(name)
-        if m is None:
-            problems.append(
-                f"{where}: span {name!r} does not match lowercase "
-                "<layer>.<operation>")
-            continue
-        if m.group("layer") not in SPAN_LAYERS:
-            problems.append(
-                f"{where}: span {name!r} layer {m.group('layer')!r} "
-                f"not in {SPAN_LAYERS}")
-    if found == 0 and root == SOURCE_ROOT:
-        problems.append(
-            f"no start_span call sites found under {root} — "
-            "lint regex out of sync with the tracing API?")
-    return problems
-
-
-def check_events(root: Path = SOURCE_ROOT):
-    """Event-type violations under ``root``. Mirrors check_spans: zero
-    event sites only flags the real source tree."""
-    problems = []
-    found = 0
-    for path, lineno, name in iter_event_sites(root):
-        found += 1
-        where = _where(path, lineno)
-        m = _EVENT_NAME_RE.match(name)
-        if m is None:
-            problems.append(
-                f"{where}: event {name!r} does not match lowercase "
-                "<layer>.<event>")
-            continue
-        if m.group("layer") not in EVENT_LAYERS:
-            problems.append(
-                f"{where}: event {name!r} layer {m.group('layer')!r} "
-                f"not in {EVENT_LAYERS}")
-    if found == 0 and root == SOURCE_ROOT:
-        problems.append(
-            f"no event record call sites found under {root} — "
-            "lint regex out of sync with the events API?")
-    return problems
-
-
-def main() -> int:
-    problems = check()
-    if problems:
-        for p in problems:
-            print(p, file=sys.stderr)
-        print(f"{len(problems)} naming violation(s)", file=sys.stderr)
-        return 1
-    n = sum(1 for _ in iter_registrations())
-    nl = sum(len(labels) for *_x, labels in iter_label_decls())
-    ns = sum(1 for _ in iter_span_sites())
-    ne = sum(1 for _ in iter_event_sites())
-    print(f"metric names OK ({n} registrations checked); "
-          f"labels OK ({nl} label keys checked); "
-          f"span names OK ({ns} call sites checked); "
-          f"event names OK ({ne} call sites checked)")
-    return 0
-
+from scripts.nnslint.naming_compat import *  # noqa: F401,F403,E402
+from scripts.nnslint.naming_compat import (  # noqa: F401,E402 — underscore + explicit names star-import misses
+    _CALL_RE, _EVENT_CALL_RE, _EVENT_NAME_RE, _NAME_RE, _SPAN_CALL_RE,
+    _SPAN_NAME_RE, _where, main)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,14 @@
+"""nnslint — project-wide static analysis for concurrency discipline,
+hot-path contracts, JAX tracing hazards, wire-protocol completeness,
+and telemetry naming. See docs/analysis.md.
+
+Entry points:
+
+* CLI: ``python -m scripts.nnslint [--json] [--update-baseline]``
+* API: :func:`run_lint` returning :class:`LintResult`
+* tier-1: ``tests/test_nnslint.py`` fails on any non-baselined finding
+"""
+
+from .core import (DEFAULT_ROOT, REPO_ROOT, FileContext, Finding,  # noqa: F401
+                   LintResult, Rule, all_rules, register_rule, run_lint)
+from .baseline import DEFAULT_BASELINE  # noqa: F401

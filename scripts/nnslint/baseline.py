@@ -1,0 +1,60 @@
+"""Baseline handling: grandfathered findings committed next to the
+engine so the tree lints clean while the debt is paid down.
+
+The file is a sorted JSON list of finding keys plus the human-readable
+context that produced them (rule/path/anchor/message). Matching is by
+:attr:`Finding.key` — rule + path + anchor — deliberately excluding
+line numbers so unrelated edits don't churn the baseline. Workflow:
+
+* a *new* finding (not in the baseline) fails the lint;
+* a baselined finding that disappears is reported as stale by
+  ``--update-baseline`` (run it and commit the shrunken file — the
+  diff is the review);
+* ``--update-baseline`` rewrites the file from the current findings.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load(path: Path = DEFAULT_BASELINE) -> Set[str]:
+    """The set of grandfathered finding keys (empty when no file)."""
+    if not path.exists():
+        return set()
+    entries = json.loads(path.read_text(encoding="utf-8"))
+    return {e["key"] for e in entries}
+
+
+def save(findings: Sequence[Finding], path: Path = DEFAULT_BASELINE) -> int:
+    """Rewrite the baseline from ``findings``; returns the entry count.
+    Entries carry the message/line for reviewers — only ``key`` is
+    matched."""
+    entries: List[Dict[str, object]] = [
+        {"key": f.key, "rule": f.rule, "path": f.path, "line": f.line,
+         "message": f.message}
+        for f in sorted(findings, key=lambda f: f.key)
+    ]
+    path.write_text(json.dumps(entries, indent=1) + "\n", encoding="utf-8")
+    return len(entries)
+
+
+def split(findings: Sequence[Finding], baseline_keys: Set[str]
+          ) -> Tuple[List[Finding], List[Finding], Set[str]]:
+    """Partition into (new, grandfathered, stale_keys)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen: Set[str] = set()
+    for f in findings:
+        if f.key in baseline_keys:
+            old.append(f)
+            seen.add(f.key)
+        else:
+            new.append(f)
+    return new, old, baseline_keys - seen

@@ -1,0 +1,106 @@
+"""nnslint CLI — ``python -m scripts.nnslint [paths] [options]``.
+
+Exit codes (stable, scripted against by CI):
+
+* ``0`` — no non-baselined findings (and no stale baseline entries
+  when ``--strict-baseline``);
+* ``1`` — at least one new finding;
+* ``2`` — usage or internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import baseline as _baseline
+from .core import DEFAULT_ROOT, REPO_ROOT, all_rules, run_lint
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m scripts.nnslint",
+        description=("Project static analysis: concurrency discipline, "
+                     "hot-path contracts, JAX tracing hazards, wire "
+                     "completeness, telemetry naming."))
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint "
+                        "(default: nnstreamer_tpu/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--select", action="append", default=None,
+                   metavar="RULE",
+                   help="run only these rule ids or families "
+                        "(repeatable)")
+    p.add_argument("--baseline", type=Path,
+                   default=_baseline.DEFAULT_BASELINE,
+                   help="baseline file (default: %(default)s)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "and exit 0 (review the diff)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as e:
+        return EXIT_ERROR if e.code not in (0, None) else EXIT_CLEAN
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid:28s} {rule.description}")
+        return EXIT_CLEAN
+    roots = [Path(p) for p in args.paths] if args.paths else [DEFAULT_ROOT]
+    for r in roots:
+        if not r.exists():
+            print(f"nnslint: no such path: {r}", file=sys.stderr)
+            return EXIT_ERROR
+    try:
+        result = run_lint(roots, select=args.select)
+    except Exception as e:  # noqa: BLE001 — tool crash is exit 2, not a lint verdict
+        print(f"nnslint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return EXIT_ERROR
+    if args.update_baseline:
+        n = _baseline.save(result.findings, args.baseline)
+        print(f"nnslint: baseline rewritten with {n} entr"
+              f"{'y' if n == 1 else 'ies'} at {args.baseline}")
+        return EXIT_CLEAN
+    keys = set() if args.no_baseline else _baseline.load(args.baseline)
+    new, grandfathered, stale = _baseline.split(result.findings, keys)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "grandfathered": [f.to_json() for f in grandfathered],
+            "stale_baseline_keys": sorted(stale),
+            "suppressed": result.suppressed,
+            "files": result.files,
+            "rules": result.rules,
+        }, indent=1))
+    else:
+        for f in new:
+            print(str(f), file=sys.stderr)
+        if new:
+            print(f"nnslint: {len(new)} finding(s) "
+                  f"({len(grandfathered)} baselined, "
+                  f"{result.suppressed} suppressed)", file=sys.stderr)
+        else:
+            print(f"nnslint OK: {result.files} files, {result.rules} "
+                  f"rules, {len(grandfathered)} baselined finding(s), "
+                  f"{result.suppressed} suppressed")
+            if stale:
+                print(f"nnslint: note: {len(stale)} stale baseline "
+                      f"entr{'y' if len(stale) == 1 else 'ies'} — run "
+                      f"--update-baseline and commit the shrink")
+    return EXIT_FINDINGS if new else EXIT_CLEAN

@@ -1,0 +1,244 @@
+"""nnslint core: findings, rule registry, suppression parsing, engine.
+
+The codebase grew a set of invariants that were, until this module,
+enforced only by convention: lock-guarded attributes, daemon/joined
+worker threads, never-raise wire boundaries, zero-overhead hook gates,
+JAX tracing purity, wire-protocol completeness, and telemetry naming.
+nnslint turns each into a registered :class:`Rule` that runs over the
+parsed AST of every source file, so the invariant fails tier-1 CI the
+moment a violation lands instead of waiting for a reviewer (or an
+outage) to notice.
+
+Vocabulary:
+
+* **Finding** — one violation: ``(rule, path, line, message, anchor)``.
+  The ``anchor`` is a short, line-number-free symbol (attribute name,
+  function name, format string) so baseline entries survive unrelated
+  line drift.
+* **Rule** — a checker registered under ``<family>/<name>``. Per-file
+  rules implement ``visit_file(ctx)``; cross-file rules (wire
+  completeness, naming placement) implement ``finalize(ctxs)`` which
+  runs once after every file has been parsed.
+* **Suppression** — ``# nnslint: disable=<rule>[,<rule>…]`` on the
+  finding line or the line directly above it. ``<rule>`` may be a full
+  id, a bare family (``concurrency``), or ``all``. Suppressions are
+  for *reviewed* exceptions (happens-before init, parsing a foreign
+  protocol); new code should not need them.
+* **Baseline** — grandfathered findings committed in
+  ``scripts/nnslint/baseline.json`` (see baseline.py); the engine
+  subtracts them so the tree lints clean while the debt is paid down.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+#: the tree linted by default (and by the tier-1 test)
+DEFAULT_ROOT = REPO_ROOT / "nnstreamer_tpu"
+
+_SUPPRESS_RE = re.compile(r"#\s*nnslint:\s*disable=([A-Za-z0-9_/,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    #: stable symbol for baseline matching (never a line number)
+    anchor: str = ""
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: survives line drift, not symbol renames."""
+        return f"{self.rule}::{self.path}::{self.anchor or self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "anchor": self.anchor}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file: text, AST, and suppression map."""
+
+    def __init__(self, path: Path, root: Path = REPO_ROOT):
+        self.path = path
+        try:
+            self.rel = path.resolve().relative_to(root).as_posix()
+        except ValueError:
+            self.rel = path.as_posix()
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as e:
+            self.parse_error = e
+        #: line -> frozenset of suppressed rule tokens on that line
+        self.suppressions: Dict[int, frozenset] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                toks = frozenset(
+                    t.strip() for t in m.group(1).split(",") if t.strip())
+                self.suppressions[i] = toks
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when ``rule`` is disabled on ``line`` — by a trailing
+        comment on the line itself or a comment-only line directly
+        above (the two shapes reviewers actually write)."""
+        for ln in (line, line - 1):
+            toks = self.suppressions.get(ln)
+            if not toks:
+                continue
+            if ln == line - 1 and not self.lines[ln - 1].lstrip().startswith("#"):
+                continue  # code line above: its suppression is its own
+            family = rule.split("/", 1)[0]
+            if "all" in toks or rule in toks or family in toks:
+                return True
+        return False
+
+
+class Rule:
+    """Base rule. Subclasses set ``id`` (``family/name``) and
+    ``description`` and override ``visit_file`` and/or ``finalize``."""
+
+    id: str = ""
+    description: str = ""
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        return ()
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and register under ``cls.id``."""
+    if not cls.id or "/" not in cls.id:
+        raise ValueError(f"rule id must be family/name, got {cls.id!r}")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    from . import rules  # noqa: F401 — importing registers the families
+
+    return dict(_RULES)
+
+
+def iter_py_files(roots: Sequence[Path]) -> List[Path]:
+    out: List[Path] = []
+    for root in roots:
+        if root.is_file():
+            out.append(root)
+        else:
+            out.extend(sorted(root.rglob("*.py")))
+    return out
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    files: int
+    rules: int
+
+
+def run_lint(roots: Optional[Sequence[Path]] = None,
+             select: Optional[Sequence[str]] = None) -> LintResult:
+    """Run every registered rule (or the ``select`` id/family prefixes)
+    over ``roots`` and return surviving findings, sorted by location.
+    Suppressed findings are counted, not returned."""
+    roots = [Path(r) for r in (roots or [DEFAULT_ROOT])]
+    rules = all_rules()
+    if select:
+        rules = {rid: r for rid, r in rules.items()
+                 if any(rid == s or rid.startswith(s.rstrip("/") + "/")
+                        or rid.split("/")[0] == s for s in select)}
+    ctxs = [FileContext(p) for p in iter_py_files(roots)]
+    by_rel = {c.rel: c for c in ctxs}
+    raw: List[Finding] = []
+    for rule in rules.values():
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            raw.extend(rule.visit_file(ctx))
+        raw.extend(rule.finalize(ctxs))
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        ctx = by_rel.get(f.path)
+        if ctx is not None and ctx.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed,
+                      files=len(ctxs), rules=len(rules))
+
+
+# --------------------------------------------------------------------------- #
+# shared AST helpers used by several rule families
+# --------------------------------------------------------------------------- #
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+              ) -> Iterable[ast.AST]:
+    while node in parents:
+        node = parents[node]
+        yield node
+
+
+def is_self_attr(node: ast.AST, attr: Optional[str] = None
+                 ) -> Optional[str]:
+    """Return the attribute name when ``node`` is ``self.<attr>``
+    (matching ``attr`` if given), else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr)):
+        return node.attr
+    return None
+
+
+def func_docstring(node: ast.AST) -> str:
+    try:
+        return ast.get_docstring(node) or ""
+    except TypeError:
+        return ""

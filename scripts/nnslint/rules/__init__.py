@@ -1,0 +1,7 @@
+"""Rule families. Importing this package registers every rule."""
+
+from . import concurrency  # noqa: F401
+from . import contracts  # noqa: F401
+from . import jax_rules  # noqa: F401
+from . import naming  # noqa: F401
+from . import wire  # noqa: F401

@@ -1,0 +1,340 @@
+"""Concurrency discipline rules.
+
+The pipeline/query/obs layers run ~30 worker threads against
+lock-guarded shared state; these rules mechanize the two conventions
+that keep that safe (docs/analysis.md "Concurrency"):
+
+* ``concurrency/guarded-by`` — an attribute whose declaration line
+  carries ``# guarded-by: <lock>`` may only be *mutated* inside a
+  ``with self.<lock>:`` block (Condition objects count — ``with
+  self._cv:`` acquires the underlying lock). The declaring method
+  (normally ``__init__``, before the object is shared) is exempt.
+* ``concurrency/thread-daemon`` — every ``threading.Thread(...)`` sets
+  ``daemon=`` explicitly: the flag decides whether a leaked worker can
+  hang interpreter exit, so it must be a reviewed decision, never the
+  inherited default.
+* ``concurrency/thread-join`` — a Thread stored on ``self`` (directly
+  or appended to a ``self.<list>``) must be joined somewhere in its
+  class (``join_or_warn(...)`` or ``.join(...)``), i.e. reachable from
+  a stop()/close() path; a worker nobody joins keeps element state
+  alive past stop and can wake on a reused port or queue.
+* ``concurrency/join-or-warn`` — in modules that import
+  ``join_or_warn``, thread joins go through it (bounded wait + leak
+  telemetry) instead of a bare ``.join()`` whose timeout expiry is
+  silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import (FileContext, Finding, Rule, ancestors, dotted_name,
+                    is_self_attr, parent_map, register_rule)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: method calls that mutate their receiver in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse",
+})
+
+
+def _thread_ctor(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return name in {"threading.Thread", "Thread"}
+
+
+def _enclosing_funcs(node: ast.AST, parents) -> List[ast.AST]:
+    return [a for a in ancestors(node, parents)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _func_holds_lock(ctx: FileContext, func: ast.AST, lock: str) -> bool:
+    """Caller-holds-lock helpers: a method named ``*_locked`` (the
+    repo's convention — e.g. SpanStore._evict_locked) is exempt for
+    every lock; a def line carrying ``# guarded-by: <lock>`` documents
+    which lock its callers hold."""
+    if func.name.endswith("_locked"):
+        return True
+    line = ctx.lines[func.lineno - 1] if func.lineno <= len(ctx.lines) else ""
+    m = _GUARDED_RE.search(line)
+    return bool(m) and m.group(1) == lock
+
+
+def _with_locks(node: ast.AST, parents) -> Set[str]:
+    """Names X for every enclosing ``with self.X`` block."""
+    locks: Set[str] = set()
+    for a in ancestors(node, parents):
+        if isinstance(a, ast.With):
+            for item in a.items:
+                attr = is_self_attr(item.context_expr)
+                if attr:
+                    locks.add(attr)
+    return locks
+
+
+@register_rule
+class GuardedByRule(Rule):
+    id = "concurrency/guarded-by"
+    description = ("attributes annotated '# guarded-by: <lock>' are only "
+                   "mutated inside 'with self.<lock>'")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        parents = parent_map(ctx.tree)
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded: Dict[str, str] = {}       # attr -> lock name
+            declared_in: Dict[str, ast.AST] = {}  # attr -> declaring func
+            for node in ast.walk(cls):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    attr = is_self_attr(tgt)
+                    if attr is None:
+                        continue
+                    line = ctx.lines[node.lineno - 1] \
+                        if node.lineno <= len(ctx.lines) else ""
+                    m = _GUARDED_RE.search(line)
+                    if m:
+                        guarded[attr] = m.group(1)
+                        funcs = _enclosing_funcs(node, parents)
+                        if funcs:
+                            declared_in[attr] = funcs[0]
+            if not guarded:
+                continue
+            findings.extend(self._check_class(ctx, cls, parents, guarded,
+                                              declared_in))
+        return findings
+
+    def _check_class(self, ctx, cls, parents, guarded, declared_in
+                     ) -> Iterable[Finding]:
+        for node in ast.walk(cls):
+            attr = mutation = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    attr = is_self_attr(tgt)
+                    if attr is None and isinstance(tgt, ast.Subscript):
+                        attr = is_self_attr(tgt.value)
+                    if attr in guarded:
+                        mutation = "assignment"
+                        break
+                    attr = None
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    a = is_self_attr(tgt)
+                    if a is None and isinstance(tgt, ast.Subscript):
+                        a = is_self_attr(tgt.value)
+                    if a in guarded:
+                        attr, mutation = a, "del"
+                        break
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS):
+                a = is_self_attr(node.func.value)
+                if a in guarded:
+                    attr, mutation = a, f".{node.func.attr}()"
+            if attr is None:
+                continue
+            funcs = _enclosing_funcs(node, parents)
+            if funcs and funcs[0] is declared_in.get(attr):
+                continue  # declaring method: object not shared yet
+            lock = guarded[attr]
+            if lock in _with_locks(node, parents):
+                continue
+            if funcs and _func_holds_lock(ctx, funcs[0], lock):
+                continue  # caller-holds-lock helper
+            yield Finding(
+                rule=self.id, path=ctx.rel, line=node.lineno,
+                anchor=f"{cls.name}.{attr}",
+                message=(f"{cls.name}.{attr} is guarded by self.{lock} "
+                         f"but this {mutation} is outside any "
+                         f"'with self.{lock}' block"))
+
+
+@register_rule
+class ThreadDaemonRule(Rule):
+    id = "concurrency/thread-daemon"
+    description = "threading.Thread(...) must pass daemon= explicitly"
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _thread_ctor(node)):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            yield Finding(
+                rule=self.id, path=ctx.rel, line=node.lineno,
+                anchor=f"L:{_thread_anchor(node)}",
+                message=("threading.Thread(...) without an explicit "
+                         "daemon= — whether a leaked worker may hang "
+                         "interpreter exit is a reviewed decision"))
+
+
+def _thread_anchor(node: ast.Call) -> str:
+    """Stable-ish anchor: the thread's target/name kwarg if literal."""
+    for kw in node.keywords:
+        if kw.arg == "target":
+            return dotted_name(kw.value) or "thread"
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+    return "thread"
+
+
+class _ClassThreads(ast.NodeVisitor):
+    """Per-class collection of thread-holding attrs and join evidence."""
+
+    def __init__(self):
+        #: attr -> lineno of the Thread() (direct ``self.X = Thread()``)
+        self.direct: Dict[str, int] = {}
+        #: list attrs that received a Thread via .append()
+        self.lists: Dict[str, int] = {}
+        #: attrs with any join evidence (join_or_warn or .join)
+        self.joined: Set[str] = set()
+        #: attrs joined ONLY via bare .join (never join_or_warn)
+        self.bare_join_lines: Dict[str, int] = {}
+        self.join_or_warn_attrs: Set[str] = set()
+
+
+def _analyze_class(cls: ast.ClassDef) -> _ClassThreads:
+    info = _ClassThreads()
+    for func in (n for n in ast.walk(cls)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        #: local name -> self attr it aliases (w = self._worker;
+        #: for t in self._threads)
+        alias: Dict[str, str] = {}
+        thread_locals: Set[str] = set()
+        # ast.walk is breadth-first, so aliases nested deeper than their
+        # use site (workers = list(self._threads) inside a with-block,
+        # consumed by a sibling for-loop) would be missed in one pass:
+        # collect Assign aliases first, then For-target aliases on top
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+                attr = is_self_attr(tgt)
+                if attr and isinstance(val, ast.Call) and _thread_ctor(val):
+                    info.direct.setdefault(attr, node.lineno)
+                elif isinstance(tgt, ast.Name):
+                    src = is_self_attr(val)
+                    if src is None and isinstance(val, ast.Call) \
+                            and isinstance(val.func, ast.Name) \
+                            and val.func.id in ("list", "tuple", "sorted",
+                                                "reversed") \
+                            and len(val.args) == 1:
+                        # snapshot copy: workers = list(self._threads)
+                        src = is_self_attr(val.args[0])
+                    if src:
+                        alias[tgt.id] = src
+                    elif isinstance(val, ast.Call) and _thread_ctor(val):
+                        thread_locals.add(tgt.id)
+        for node in ast.walk(func):
+            if isinstance(node, ast.For):
+                src = _resolve_attr(node.iter, alias)
+                if src and isinstance(node.target, ast.Name):
+                    alias[node.target.id] = src
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            # self.<list>.append(<thread local>)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and node.args and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in thread_locals):
+                attr = is_self_attr(node.func.value)
+                if attr:
+                    info.lists.setdefault(attr, node.lineno)
+            # join_or_warn(X, ...)
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "join_or_warn" and node.args):
+                attr = _resolve_attr(node.args[0], alias)
+                if attr:
+                    info.joined.add(attr)
+                    info.join_or_warn_attrs.add(attr)
+            # X.join(...)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                attr = _resolve_attr(node.func.value, alias)
+                if attr:
+                    info.joined.add(attr)
+                    info.bare_join_lines.setdefault(attr, node.lineno)
+    return info
+
+
+def _resolve_attr(node: ast.AST, alias: Dict[str, str]) -> Optional[str]:
+    attr = is_self_attr(node)
+    if attr:
+        return attr
+    if isinstance(node, ast.Name):
+        return alias.get(node.id)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("list", "tuple", "sorted", "reversed") \
+            and len(node.args) == 1:
+        # snapshot copy in iter position: for t in list(self._threads)
+        return _resolve_attr(node.args[0], alias)
+    return None
+
+
+@register_rule
+class ThreadJoinRule(Rule):
+    id = "concurrency/thread-join"
+    description = ("threads stored on self must be joined (join_or_warn "
+                   "or .join) from some method of their class")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _analyze_class(cls)
+            holders: List[Tuple[str, int]] = (
+                list(info.direct.items()) + list(info.lists.items()))
+            for attr, line in holders:
+                if attr in info.joined:
+                    continue
+                yield Finding(
+                    rule=self.id, path=ctx.rel, line=line,
+                    anchor=f"{cls.name}.{attr}",
+                    message=(f"{cls.name}.{attr} holds a worker thread "
+                             f"that no method of the class ever joins — "
+                             f"stop()/close() must reach it via "
+                             f"join_or_warn"))
+
+
+@register_rule
+class JoinOrWarnRule(Rule):
+    id = "concurrency/join-or-warn"
+    description = ("modules importing join_or_warn join their threads "
+                   "through it, not a silent bare .join()")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if "join_or_warn" not in ctx.text:
+            return
+        imports_it = any(
+            isinstance(n, ast.ImportFrom)
+            and any(a.name == "join_or_warn" for a in n.names)
+            for n in ast.walk(ctx.tree))
+        if not imports_it:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            info = _analyze_class(cls)
+            held = set(info.direct) | set(info.lists)
+            for attr, line in info.bare_join_lines.items():
+                if attr not in held or attr in info.join_or_warn_attrs:
+                    continue
+                yield Finding(
+                    rule=self.id, path=ctx.rel, line=line,
+                    anchor=f"{cls.name}.{attr}",
+                    message=(f"{cls.name}.{attr} is joined with a bare "
+                             f".join() although this module imports "
+                             f"join_or_warn — a timed-out join here "
+                             f"leaks the worker silently"))

@@ -1,0 +1,189 @@
+"""Hot-path contract rules.
+
+* ``contracts/never-raise`` — a function whose docstring declares a
+  never-raise boundary ("never raises", "never raise", "never an
+  exception", "must not raise") must actually contain a broad
+  ``except Exception``/bare ``except`` handler somewhere. These
+  boundaries sit where telemetry or peer input meets a data stream
+  (``ingest_wire``, flight-recorder logging, OBS_PUSH fire-and-forget);
+  a narrow except list silently converts "never raises" into "raises
+  on the one type nobody enumerated".
+* ``contracts/hook-gate`` — module-global hot-path hooks (names
+  matching ``*_HOOK``) are consumed behind an ``is None`` gate —
+  either ``if X is not None: X(...)`` (including the and-chain form
+  ``if X is not None and X(...)``) or an early ``if X is None:
+  return`` guard. The disabled path must stay one global load + one
+  None check; an unguarded call turns "zero overhead when off" into a
+  TypeError when off.
+* ``contracts/hook-default`` — the module defining a ``*_HOOK`` global
+  initializes it to ``None``: installed-by-default hooks silently
+  repeal the zero-overhead contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from ..core import (FileContext, Finding, Rule, ancestors, func_docstring,
+                    parent_map, register_rule)
+
+_NEVER_RAISE_RE = re.compile(
+    r"never[\s-]+raise[sd]?\b|never\s+an\s+exception|must\s+not\s+raise",
+    re.IGNORECASE)
+
+_HOOK_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*_HOOK$")
+
+
+def _has_broad_except(func: ast.AST) -> bool:
+    # manual stack instead of ast.walk: nested defs guard their own
+    # bodies, so their handlers must not satisfy the outer boundary
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            return True
+        t = node.type
+        if isinstance(t, ast.Tuple):
+            names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
+        else:
+            names = [getattr(t, "id", getattr(t, "attr", ""))]
+        if "Exception" in names or "BaseException" in names:
+            return True
+    return False
+
+
+@register_rule
+class NeverRaiseRule(Rule):
+    id = "contracts/never-raise"
+    description = ("functions declaring a never-raise boundary contain "
+                   "a broad except")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            doc = func_docstring(node)
+            if not doc or not _NEVER_RAISE_RE.search(doc):
+                continue
+            if _has_broad_except(node):
+                continue
+            yield Finding(
+                rule=self.id, path=ctx.rel, line=node.lineno,
+                anchor=node.name,
+                message=(f"{node.name}() declares a never-raise boundary "
+                         f"in its docstring but has no broad 'except "
+                         f"Exception' — the contract leaks every type "
+                         f"outside its narrow except list"))
+
+
+def _gated_by(node: ast.AST, hook: str, parents) -> bool:
+    """True when a hook *call site* is behind an ``is None`` gate."""
+    for anc in ancestors(node, parents):
+        if isinstance(anc, ast.If) and _test_checks(anc.test, hook):
+            return True
+        if isinstance(anc, ast.IfExp) and _test_checks(anc.test, hook):
+            return True
+        # early-guard form: a preceding `if X is None: return/raise` in
+        # the same statement list
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in anc.body:
+                if stmt.lineno >= node.lineno:
+                    break
+                if (isinstance(stmt, ast.If)
+                        and _is_none_bailout(stmt, hook)):
+                    return True
+            return False
+    return False
+
+
+def _test_checks(test: ast.AST, hook: str) -> bool:
+    """Does ``test`` contain ``<hook> is not None``? (Direct compare or
+    any value of an and-chain.)"""
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Compare)
+                and isinstance(node.left, ast.Name)
+                and node.left.id == hook
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], ast.IsNot)
+                and isinstance(node.comparators[0], ast.Constant)
+                and node.comparators[0].value is None):
+            return True
+    return False
+
+
+def _is_none_bailout(stmt: ast.If, hook: str) -> bool:
+    test = stmt.test
+    is_none = (isinstance(test, ast.Compare)
+               and isinstance(test.left, ast.Name)
+               and test.left.id == hook
+               and len(test.ops) == 1
+               and isinstance(test.ops[0], ast.Is)
+               and isinstance(test.comparators[0], ast.Constant)
+               and test.comparators[0].value is None)
+    if not is_none or not stmt.body:
+        return False
+    last = stmt.body[-1]
+    return isinstance(last, (ast.Return, ast.Raise, ast.Continue))
+
+
+@register_rule
+class HookGateRule(Rule):
+    id = "contracts/hook-gate"
+    description = ("*_HOOK globals are called behind a single "
+                   "'is None' gate")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        hooks: Set[str] = {
+            n.id for node in ast.walk(ctx.tree)
+            for n in ast.walk(node)
+            if isinstance(n, ast.Name) and _HOOK_NAME_RE.match(n.id)}
+        if not hooks:
+            return
+        parents = parent_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in hooks):
+                continue
+            if _gated_by(node, node.func.id, parents):
+                continue
+            yield Finding(
+                rule=self.id, path=ctx.rel, line=node.lineno,
+                anchor=node.func.id,
+                message=(f"{node.func.id}(...) called without an "
+                         f"'is None' gate — the zero-overhead-when-off "
+                         f"contract requires 'if {node.func.id} is not "
+                         f"None' around every consumption"))
+
+
+@register_rule
+class HookDefaultRule(Rule):
+    id = "contracts/hook-default"
+    description = "module-global *_HOOK defaults are None"
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if not (isinstance(tgt, ast.Name)
+                        and _HOOK_NAME_RE.match(tgt.id)):
+                    continue
+                if isinstance(stmt.value, ast.Constant) \
+                        and stmt.value.value is None:
+                    continue
+                yield Finding(
+                    rule=self.id, path=ctx.rel, line=stmt.lineno,
+                    anchor=tgt.id,
+                    message=(f"{tgt.id} defaults to a non-None value at "
+                             f"module scope — hooks are installed at "
+                             f"runtime; the import-time default must be "
+                             f"None so the disabled path stays free"))

@@ -1,0 +1,205 @@
+"""JAX tracing hazard rules.
+
+``jax.jit`` / ``pl.pallas_call`` bodies execute at *trace* time; host
+side effects inside them either burn in a stale value (``time.time``,
+``random``) or silently force a device sync per call
+(``np.asarray`` on a tracer, ``.block_until_ready``, ``.item()``).
+Before the Pallas/autotuner arc adds more kernels, these rules make
+the boundary mechanical (docs/analysis.md "JAX tracing"):
+
+* ``jax/host-call-in-jit`` — no wall-clock reads, stdlib ``random``,
+  host numpy materialization, or explicit device syncs inside a traced
+  function. Traced = decorated with ``jax.jit``/``jit``/``pmap``/
+  ``pjit`` (directly or via ``partial(jax.jit, ...)``), wrapped as
+  ``g = jax.jit(f)``, or passed as the kernel to ``pl.pallas_call``.
+  Constant setup that legitimately runs once at trace time carries an
+  inline ``# nnslint: disable=jax/host-call-in-jit`` with a reason.
+* ``jax/mutable-default`` — no mutable defaults holding arrays:
+  ``def f(x, buf=np.zeros(8))`` evaluates once at import and every
+  call shares (and in-place ops mutate) the same array; in a traced
+  signature it additionally bakes one constant into the compiled
+  executable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import FileContext, Finding, Rule, dotted_name, register_rule
+
+#: decorator / wrapper spellings that make a function traced
+_JIT_NAMES = frozenset({
+    "jax.jit", "jit", "jax.pmap", "pmap", "pjit", "jax.pjit",
+})
+_PALLAS_NAMES = frozenset({"pl.pallas_call", "pallas_call"})
+
+#: host calls banned under trace: (dotted prefix, reason)
+_BANNED_CALLS = {
+    "time.time": "wall-clock read burns in the trace-time value",
+    "time.time_ns": "wall-clock read burns in the trace-time value",
+    "time.monotonic": "clock read burns in the trace-time value",
+    "time.monotonic_ns": "clock read burns in the trace-time value",
+    "time.perf_counter": "clock read burns in the trace-time value",
+    "time.sleep": "host sleep has no effect in the compiled function",
+    "np.asarray": "host materialization forces a device sync per call",
+    "np.array": "host materialization forces a device sync per call",
+    "numpy.asarray": "host materialization forces a device sync per call",
+    "numpy.array": "host materialization forces a device sync per call",
+    "jax.device_get": "explicit device sync inside the traced body",
+    "print": "traces once, not per call — use jax.debug.print",
+}
+#: stdlib random module (jax.random is fine and spelled jrandom/jax.random)
+_BANNED_MODULES = ("random.",)
+#: method calls that force a host sync on a traced value
+_BANNED_METHODS = frozenset({"block_until_ready", "item"})
+
+#: default-value constructors that allocate an array at def time
+_ARRAY_CTORS = ("np.", "numpy.", "jnp.", "jax.numpy.")
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES:
+            return True  # @jax.jit(static_argnums=...)
+        if fname in {"partial", "functools.partial"} and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _collect_traced(tree: ast.Module) -> Set[ast.AST]:
+    """Function nodes whose bodies run under JAX tracing."""
+    by_name = {}
+    bindings = {}  # local/module name -> last assigned value expr
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if any(_decorator_is_jit(d) for d in node.decorator_list):
+                traced.add(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            bindings[node.targets[0].id] = node.value
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        ref: Optional[ast.AST] = None
+        if fname in _JIT_NAMES and node.args:
+            ref = node.args[0]       # g = jax.jit(f)
+        elif fname in _PALLAS_NAMES and node.args:
+            ref = node.args[0]       # pl.pallas_call(kernel, ...)
+        elif fname in _PALLAS_NAMES:
+            for kw in node.keywords:
+                if kw.arg == "kernel":
+                    ref = kw.value
+        if ref is not None:
+            for name in _resolve_func_names(ref, bindings):
+                if name in by_name:
+                    traced.add(by_name[name])
+    return traced
+
+
+def _resolve_func_names(ref: ast.AST, bindings, depth: int = 0) -> Set[str]:
+    """Function names a kernel/jit argument can resolve to, through
+    the spellings the tree actually uses: a bare Name, a wrapper call
+    whose first positional arg is the function (``functools.partial``,
+    ``_shard_map``), an either-or ``IfExp``, and one level of local
+    rebinding (``kernel = partial(kfn, ...)``)."""
+    if depth > 4:
+        return set()
+    if isinstance(ref, ast.Name):
+        bound = bindings.get(ref.id)
+        if bound is not None and not isinstance(bound, ast.Name):
+            resolved = _resolve_func_names(bound, bindings, depth + 1)
+            if resolved:
+                return resolved
+        return {ref.id}
+    if isinstance(ref, ast.Call) and ref.args:
+        return _resolve_func_names(ref.args[0], bindings, depth + 1)
+    if isinstance(ref, ast.IfExp):
+        return (_resolve_func_names(ref.body, bindings, depth + 1)
+                | _resolve_func_names(ref.orelse, bindings, depth + 1))
+    return set()
+
+
+@register_rule
+class HostCallInJitRule(Rule):
+    id = "jax/host-call-in-jit"
+    description = ("no wall-clock, stdlib random, host numpy, or device "
+                   "syncs inside jit/pallas-traced functions")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if "jit" not in ctx.text and "pallas_call" not in ctx.text:
+            return
+        traced = _collect_traced(ctx.tree)
+        for func in traced:
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._banned(node)
+                if reason is None:
+                    continue
+                yield Finding(
+                    rule=self.id, path=ctx.rel, line=node.lineno,
+                    anchor=f"{func.name}:{dotted_name(node.func) or 'call'}",
+                    message=(f"{dotted_name(node.func) or 'host call'} "
+                             f"inside traced function {func.name}(): "
+                             f"{reason}"))
+
+    @staticmethod
+    def _banned(node: ast.Call) -> Optional[str]:
+        name = dotted_name(node.func)
+        if name is not None:
+            if name in _BANNED_CALLS:
+                return _BANNED_CALLS[name]
+            if any(name.startswith(p) for p in _BANNED_MODULES):
+                return ("stdlib random draws at trace time — use "
+                        "jax.random with an explicit key")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BANNED_METHODS):
+            return (f".{node.func.attr}() forces a host sync inside the "
+                    f"traced body")
+        return None
+
+
+@register_rule
+class MutableDefaultRule(Rule):
+    id = "jax/mutable-default"
+    description = ("no mutable default arguments holding arrays "
+                   "(np/jnp constructors, or containers of them)")
+
+    def visit_file(self, ctx: FileContext) -> Iterable[Finding]:
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            args = func.args
+            for default in list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None]:
+                if self._array_valued(default):
+                    yield Finding(
+                        rule=self.id, path=ctx.rel, line=default.lineno,
+                        anchor=func.name,
+                        message=(f"{func.name}() has an array-valued "
+                                 f"mutable default — it is allocated "
+                                 f"once at import and shared by every "
+                                 f"call; build it inside the body "
+                                 f"(default None) instead"))
+
+    @staticmethod
+    def _array_valued(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            return bool(name) and name.startswith(_ARRAY_CTORS)
+        if isinstance(node, (ast.List, ast.Set, ast.Tuple)):
+            return any(MutableDefaultRule._array_valued(e)
+                       for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(v is not None
+                       and MutableDefaultRule._array_valued(v)
+                       for v in node.values)
+        return False

@@ -1,0 +1,102 @@
+"""Telemetry naming rules — the original check_metric_names checks,
+registered as the fifth nnslint family so there is one lint engine.
+
+The implementation stays in :mod:`scripts.nnslint.naming_compat`
+(moved verbatim; ``scripts/check_metric_names.py`` is now a shim over
+it) because its string-returning API is public: tests and external
+callers drive ``check()``/``check_labels()``/… directly. The wrappers
+here parse those ``path:line: message`` strings into Findings, keyed
+for the baseline by the message body (naming violations are about a
+literal name, which IS the stable symbol).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Callable, Iterable, List, Sequence
+
+from .. import naming_compat as _compat
+from ..core import REPO_ROOT, FileContext, Finding, Rule, register_rule
+
+_LOC_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+): (?P<msg>.*)$",
+                     re.DOTALL)
+
+
+def _to_findings(rule_id: str, problems: Sequence[str]) -> List[Finding]:
+    out: List[Finding] = []
+    for p in problems:
+        m = _LOC_RE.match(p)
+        if m:
+            out.append(Finding(
+                rule=rule_id, path=Path(m.group("path")).as_posix(),
+                line=int(m.group("line")), message=m.group("msg"),
+                anchor=m.group("msg")))
+        else:
+            # tree-level problems ("no registrations found") anchor on
+            # the whole tree
+            out.append(Finding(rule=rule_id, path="nnstreamer_tpu",
+                               line=0, message=p, anchor=p))
+    return out
+
+
+def _root_of(ctxs: Sequence[FileContext]) -> Path:
+    """The common directory the engine is scanning — naming_compat
+    iterates files itself, so hand it the same root."""
+    if not ctxs:
+        return _compat.SOURCE_ROOT
+    paths = [ctx.path.resolve() for ctx in ctxs]
+    root = paths[0] if paths[0].is_dir() else paths[0].parent
+    for p in paths[1:]:
+        while root not in p.parents and root != p:
+            root = root.parent
+    return root
+
+
+class _NamingRule(Rule):
+    checks: Sequence[Callable[[Path], List[str]]] = ()
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        root = _root_of(ctxs)
+        problems: List[str] = []
+        for chk in type(self).checks:
+            problems.extend(chk(root))
+        return _to_findings(self.id, problems)
+
+
+@register_rule
+class MetricNameRule(_NamingRule):
+    id = "naming/metric-name"
+    description = "metric names follow nnstpu_<layer>_<name>_<unit>"
+    checks = (_compat.check_names,)
+
+
+@register_rule
+class MetricLabelRule(_NamingRule):
+    id = "naming/metric-labels"
+    description = ("label keys are legal, non-reserved, and at most "
+                   f"{_compat.MAX_LABEL_KEYS} per family")
+    checks = (_compat.check_labels,)
+
+
+@register_rule
+class SpanNameRule(_NamingRule):
+    id = "naming/span-name"
+    description = "span names are lowercase <layer>.<operation>"
+    checks = (_compat.check_spans,)
+
+
+@register_rule
+class EventNameRule(_NamingRule):
+    id = "naming/event-name"
+    description = "flight-recorder event types are lowercase <layer>.<event>"
+    checks = (_compat.check_events,)
+
+
+@register_rule
+class PlacementRule(_NamingRule):
+    id = "naming/placement"
+    description = ("resilience/chaos, kv_*, and router telemetry are "
+                   "registered in their owning packages")
+    checks = (_compat.check_resilience, _compat.check_kv,
+              _compat.check_router)

@@ -1,0 +1,158 @@
+"""Wire-protocol completeness rules (cross-file).
+
+* ``wire/cmd-dispatch`` — every member of a wire-command enum (an
+  ``enum.IntEnum`` subclass named ``Cmd``) is referenced by at least
+  one dispatch site outside the enum definition. An unreferenced
+  member is a command one side can legally send and the other side
+  routes to the generic "unexpected cmd" arm — protocol drift that
+  only shows up as a live incident (the reference NNStreamer hit
+  exactly this with TRANSFER_* handling).
+* ``wire/struct-format`` — within one subpackage, every literal
+  ``struct.pack`` format string has a matching ``struct.unpack`` /
+  ``unpack_from`` of the same format somewhere, and vice versa
+  (``struct.Struct`` instances count for both directions: the object
+  is the send/recv pair). A one-sided format is a framing mismatch
+  waiting for the first peer running older code. Packages that only
+  ever read foreign formats (model file parsers) have no pack sites
+  and are skipped; single sites that parse a *foreign* wire format
+  inside a paired package carry an inline suppression naming the
+  protocol.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from ..core import FileContext, Finding, Rule, dotted_name, register_rule
+
+#: wire-command enum class names subject to the dispatch check
+_CMD_CLASS_NAMES = frozenset({"Cmd"})
+
+
+def _is_enum_base(base: ast.AST) -> bool:
+    name = dotted_name(base) or ""
+    return name.split(".")[-1].endswith("Enum")
+
+
+@register_rule
+class CmdDispatchRule(Rule):
+    id = "wire/cmd-dispatch"
+    description = ("every wire-command enum member has a dispatch branch "
+                   "referencing it outside the enum definition")
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        # pass 1: enum members, remembering the defining class span
+        members: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        spans: Dict[str, List[Tuple[str, int, int]]] = defaultdict(list)
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            for cls in ast.walk(ctx.tree):
+                if not (isinstance(cls, ast.ClassDef)
+                        and cls.name in _CMD_CLASS_NAMES
+                        and any(_is_enum_base(b) for b in cls.bases)):
+                    continue
+                end = max((n.lineno for n in ast.walk(cls)
+                           if hasattr(n, "lineno")), default=cls.lineno)
+                spans[cls.name].append((ctx.rel, cls.lineno, end))
+                for stmt in cls.body:
+                    if isinstance(stmt, ast.Assign):
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name) \
+                                    and tgt.id.isupper():
+                                members.setdefault(cls.name, {})[tgt.id] = \
+                                    (ctx.rel, stmt.lineno)
+        if not members:
+            return
+        # pass 2: Cmd.<member> references outside the defining class
+        referenced: Dict[str, Set[str]] = defaultdict(set)
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in members):
+                    continue
+                cls_name = node.value.id
+                if any(rel == ctx.rel and lo <= node.lineno <= hi
+                       for rel, lo, hi in spans[cls_name]):
+                    continue  # inside the enum body itself
+                referenced[cls_name].add(node.attr)
+        for cls_name, mems in members.items():
+            for member, (rel, line) in mems.items():
+                if member in referenced[cls_name]:
+                    continue
+                yield Finding(
+                    rule=self.id, path=rel, line=line,
+                    anchor=f"{cls_name}.{member}",
+                    message=(f"{cls_name}.{member} has no dispatch branch "
+                             f"anywhere — a peer sending it is routed to "
+                             f"the generic error arm (protocol drift)"))
+
+
+def _fmt(node: ast.Call) -> str:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value.replace(" ", "")
+    return ""
+
+
+def _package_of(rel: str) -> str:
+    parts = rel.split("/")
+    return "/".join(parts[:2]) if len(parts) > 2 else parts[0]
+
+
+@register_rule
+class StructFormatRule(Rule):
+    id = "wire/struct-format"
+    description = ("struct pack/unpack format strings agree across "
+                   "send/recv pairs within a subpackage")
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        # pkg -> fmt -> first (rel, line) per direction
+        packs: Dict[str, Dict[str, Tuple[str, int]]] = defaultdict(dict)
+        unpacks: Dict[str, Dict[str, Tuple[str, int]]] = defaultdict(dict)
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            pkg = _package_of(ctx.rel)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                fmt = _fmt(node)
+                if not fmt:
+                    continue
+                at = (ctx.rel, node.lineno)
+                if name in ("struct.pack", "struct.pack_into"):
+                    packs[pkg].setdefault(fmt, at)
+                elif name in ("struct.unpack", "struct.unpack_from"):
+                    unpacks[pkg].setdefault(fmt, at)
+                elif name == "struct.Struct":
+                    # the Struct object is its own send/recv pair
+                    packs[pkg].setdefault(fmt, at)
+                    unpacks[pkg].setdefault(fmt, at)
+        for pkg in set(packs) | set(unpacks):
+            if not packs[pkg] or not unpacks[pkg]:
+                continue  # read-only (or write-only) package: a parser
+            for fmt, (rel, line) in sorted(packs[pkg].items()):
+                if fmt not in unpacks[pkg]:
+                    yield Finding(
+                        rule=self.id, path=rel, line=line,
+                        anchor=f"pack:{fmt}",
+                        message=(f"struct format {fmt!r} is packed in "
+                                 f"{pkg} but never unpacked there — "
+                                 f"send/recv framing mismatch"))
+            for fmt, (rel, line) in sorted(unpacks[pkg].items()):
+                if fmt not in packs[pkg]:
+                    yield Finding(
+                        rule=self.id, path=rel, line=line,
+                        anchor=f"unpack:{fmt}",
+                        message=(f"struct format {fmt!r} is unpacked in "
+                                 f"{pkg} but never packed there — "
+                                 f"send/recv framing mismatch (foreign "
+                                 f"protocols: suppress inline, naming "
+                                 f"the protocol)"))

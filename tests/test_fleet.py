@@ -851,3 +851,24 @@ class TestZeroOverhead:
         with store.start_span("query.request"):
             pass
         assert len(store._export_pending) == 0
+
+
+class TestIngestNeverRaises:
+    """Regression (nnslint contracts/never-raise): ingest_remote's
+    docstring promises malformed entries are skipped, never raised —
+    including exception types outside the originally enumerated
+    (KeyError, TypeError, ValueError) narrow list."""
+
+    def test_entry_raising_arbitrary_exception_is_skipped(self):
+        class IndexableNoGet:
+            # __getitem__ works, .get() does not -> AttributeError,
+            # which the old narrow except list leaked to the caller
+            def __getitem__(self, key):
+                return {"tid": "t9", "sid": "s9",
+                        "wall": 1e9, "dur_ns": 5}[key]
+
+        store = SpanStore()
+        ok = {"tid": "t9", "sid": "s1", "par": None,
+              "name": "query.request", "wall": 1e9, "dur_ns": 5,
+              "attrs": {}}
+        assert store.ingest_remote([IndexableNoGet(), ok], "w") == 1

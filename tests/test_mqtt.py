@@ -391,3 +391,31 @@ class TestSparseLink:
             evil.close()
         finally:
             broker.stop()
+
+
+class TestBrokerLifecycle:
+    """Regression (nnslint concurrency/thread-join): stop() must join
+    the accept thread — returning while it is still inside its bounded
+    accept() keeps the LISTEN socket alive past close(), so an
+    immediate rebind of the same port races EADDRINUSE."""
+
+    def test_stop_joins_accept_thread_and_frees_port(self):
+        broker = mqtt.MqttBroker(port=0).start()
+        port = broker.port
+        worker = broker._thread
+        assert worker is not None and worker.is_alive()
+        broker.stop()
+        assert broker._thread is None
+        assert not worker.is_alive()
+        # deterministic rebind of the very same port
+        broker2 = mqtt.MqttBroker(port=port).start()
+        try:
+            c = mqtt.MqttClient(broker2.host, broker2.port, "rebind")
+            c.close()
+        finally:
+            broker2.stop()
+
+    def test_stop_is_reentrant(self):
+        broker = mqtt.MqttBroker(port=0).start()
+        broker.stop()
+        broker.stop()  # second stop: no thread left, must not raise

@@ -1,0 +1,604 @@
+"""nnslint engine + rule-family tests (scripts/nnslint/).
+
+Each rule family is exercised against a seeded fixture snippet that
+must fire and a clean twin that must stay silent — the "demonstrably
+catches a seeded regression" acceptance bar — plus engine-level tests
+for inline suppressions, the baseline round trip, and the CLI contract
+the tier-1 gate (test_repo_lints_clean) scripts against.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from scripts.nnslint import baseline as nnsl_baseline  # noqa: E402
+from scripts.nnslint.core import Finding, run_lint  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def lint_snippet(tmp_path, code, select, name="snippet.py"):
+    """Write ``code`` into an isolated tree and run the selected rules."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    res = run_lint([p], select=list(select))
+    return res
+
+
+def rules_fired(res):
+    return sorted({f.rule for f in res.findings})
+
+
+# --------------------------------------------------------------------------- #
+# concurrency family
+# --------------------------------------------------------------------------- #
+
+class TestConcurrencyRules:
+    def test_guarded_by_mutation_outside_lock_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+
+                def bad(self, x):
+                    self._items.append(x)
+            """, ["concurrency/guarded-by"])
+        assert len(res.findings) == 1
+        f = res.findings[0]
+        assert f.rule == "concurrency/guarded-by"
+        assert "Box._items" in f.anchor
+        assert "with self._lock" in f.message
+
+    def test_guarded_by_clean_twin_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+                    self._items.append(0)   # declaring method: exempt
+
+                def good(self, x):
+                    with self._lock:
+                        self._items.append(x)
+                        self._items = [x]
+                        del self._items[0]
+            """, ["concurrency/guarded-by"])
+        assert res.findings == []
+
+    def test_guarded_by_caller_holds_lock_helpers_exempt(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Breaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = "closed"  # guarded-by: _lock
+
+                def trip(self):
+                    with self._lock:
+                        self._to_open()
+                        self._reset_locked()
+
+                def _to_open(self):  # guarded-by: _lock
+                    self._state = "open"
+
+                def _reset_locked(self):
+                    self._state = "closed"
+            """, ["concurrency/guarded-by"])
+        assert res.findings == []
+
+    def test_guarded_by_subscript_and_augassign_fire(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Reg:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._map = {}  # guarded-by: _lock
+                    self._n = 0  # guarded-by: _lock
+
+                def bad(self, k, v):
+                    self._map[k] = v
+                    self._n += 1
+            """, ["concurrency/guarded-by"])
+        assert len(res.findings) == 2
+
+    def test_thread_daemon_missing_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+            """, ["concurrency/thread-daemon"])
+        assert rules_fired(res) == ["concurrency/thread-daemon"]
+
+    def test_thread_daemon_explicit_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+            """, ["concurrency/thread-daemon"])
+        assert res.findings == []
+
+    def test_unjoined_held_thread_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Owner:
+                def start(self):
+                    self._w = threading.Thread(target=print, daemon=True)
+                    self._w.start()
+
+                def stop(self):
+                    pass
+            """, ["concurrency/thread-join"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "Owner._w"
+
+    def test_joined_thread_silent_incl_snapshot_alias(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+
+            class Owner:
+                def start(self):
+                    self._w = threading.Thread(target=print, daemon=True)
+                    t = threading.Thread(target=print, daemon=True)
+                    self._pool.append(t)
+
+                def stop(self):
+                    w = self._w
+                    w.join(timeout=1)
+                    for t in list(self._pool):
+                        t.join(timeout=1)
+            """, ["concurrency/thread-join"])
+        assert res.findings == []
+
+    def test_bare_join_with_join_or_warn_imported_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+            from nnstreamer_tpu.graph.element import join_or_warn
+
+            class Owner:
+                def start(self):
+                    self._w = threading.Thread(target=print, daemon=True)
+                    self._w.start()
+
+                def stop(self):
+                    self._w.join(timeout=1)
+            """, ["concurrency/join-or-warn"])
+        assert len(res.findings) == 1
+        assert "bare .join()" in res.findings[0].message
+
+    def test_join_or_warn_usage_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import threading
+            from nnstreamer_tpu.graph.element import join_or_warn
+
+            class Owner:
+                def start(self):
+                    self._w = threading.Thread(target=print, daemon=True)
+                    self._w.start()
+
+                def stop(self):
+                    join_or_warn(self._w, "owner", timeout=1.0)
+            """, ["concurrency/join-or-warn"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------------- #
+# contracts family
+# --------------------------------------------------------------------------- #
+
+class TestContractRules:
+    def test_leaky_never_raise_boundary_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            def parse(x):
+                '''Best-effort parse; never raises.'''
+                try:
+                    return int(x)
+                except ValueError:
+                    return None
+            """, ["contracts/never-raise"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "parse"
+
+    def test_broad_except_satisfies_never_raise(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            def parse(x):
+                '''Best-effort parse; never raises.'''
+                try:
+                    return int(x)
+                except Exception:
+                    return None
+
+            def parse2(x):
+                '''Must not raise.'''
+                try:
+                    return int(x)
+                except (OSError, Exception):
+                    return None
+            """, ["contracts/never-raise"])
+        assert res.findings == []
+
+    def test_nested_def_broad_except_does_not_count(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            def outer(x):
+                '''never raises'''
+                def inner():
+                    try:
+                        return int(x)
+                    except Exception:
+                        return None
+                return inner()
+            """, ["contracts/never-raise"])
+        assert len(res.findings) == 1
+
+    def test_ungated_hook_call_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            CHAOS_HOOK = None
+
+            def fire(x):
+                CHAOS_HOOK(x)
+            """, ["contracts/hook-gate"])
+        assert len(res.findings) == 1
+        assert "is None" in res.findings[0].message
+
+    def test_gated_hook_calls_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            CHAOS_HOOK = None
+
+            def gated(x):
+                if CHAOS_HOOK is not None:
+                    CHAOS_HOOK(x)
+
+            def and_chain(x):
+                if CHAOS_HOOK is not None and CHAOS_HOOK(x):
+                    return True
+
+            def early_guard(x):
+                if CHAOS_HOOK is None:
+                    return None
+                return CHAOS_HOOK(x)
+            """, ["contracts/hook-gate"])
+        assert res.findings == []
+
+    def test_non_none_hook_default_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            BAD_HOOK = print
+            GOOD_HOOK = None
+            """, ["contracts/hook-default"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "BAD_HOOK"
+
+
+# --------------------------------------------------------------------------- #
+# jax family
+# --------------------------------------------------------------------------- #
+
+class TestJaxRules:
+    def test_host_call_in_jitted_function_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import time
+            import jax
+
+            @jax.jit
+            def f(x):
+                t = time.time()
+                return x * t
+            """, ["jax/host-call-in-jit"])
+        assert len(res.findings) == 1
+        assert "time.time" in res.findings[0].message
+
+    def test_wrapped_jit_and_partial_pallas_kernel_detected(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import functools
+            import random
+            import time
+            import jax
+
+            def _impl(x):
+                return x + random.random()
+
+            g = jax.jit(_impl)
+
+            def _kernel(ref, n):
+                time.sleep(0.1)
+
+            kernel = functools.partial(_kernel, n=4)
+            op = pl.pallas_call(kernel, out_shape=None)
+            """, ["jax/host-call-in-jit"])
+        assert len(res.findings) == 2
+        anchors = {f.anchor for f in res.findings}
+        assert any("_impl" in a for a in anchors)
+        assert any("_kernel" in a for a in anchors)
+
+    def test_host_call_outside_trace_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import time
+            import jax
+
+            def setup():
+                return time.time()
+
+            @jax.jit
+            def f(x):
+                return x * 2
+            """, ["jax/host-call-in-jit"])
+        assert res.findings == []
+
+    def test_array_valued_mutable_default_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import numpy as np
+
+            def f(x, buf=np.zeros(8)):
+                return x
+
+            def ok(x, buf=None, n=4):
+                return x
+            """, ["jax/mutable-default"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "f"
+
+
+# --------------------------------------------------------------------------- #
+# wire family
+# --------------------------------------------------------------------------- #
+
+class TestWireRules:
+    def test_enum_member_without_dispatch_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import enum
+
+            class Cmd(enum.IntEnum):
+                PING = 1
+                PONG = 2
+
+            def dispatch(c):
+                if c is Cmd.PING:
+                    return "pong"
+            """, ["wire/cmd-dispatch"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "Cmd.PONG"
+
+    def test_fully_dispatched_enum_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import enum
+
+            class Cmd(enum.IntEnum):
+                PING = 1
+                PONG = 2
+
+            def dispatch(c):
+                if c is Cmd.PING:
+                    return "pong"
+                if c is Cmd.PONG:
+                    return "ping"
+            """, ["wire/cmd-dispatch"])
+        assert res.findings == []
+
+    def test_one_sided_struct_format_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import struct
+
+            def send(sock, a, b):
+                sock.sendall(struct.pack("<II", a, b))
+                sock.sendall(struct.pack("<Q", a))
+
+            def recv(data):
+                return struct.unpack("<II", data)
+            """, ["wire/struct-format"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "pack:<Q"
+
+    def test_struct_struct_counts_both_directions(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import struct
+
+            _HDR = struct.Struct("<IBIQ")
+
+            def send(sock, *vals):
+                sock.sendall(_HDR.pack(*vals))
+            """, ["wire/struct-format"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------------- #
+# naming family (the migrated check_metric_names checks)
+# --------------------------------------------------------------------------- #
+
+class TestNamingRules:
+    def test_bad_metric_name_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            def setup(reg):
+                reg.counter("frames_total", "help", ())
+                reg.counter("nnstpu_pipeline_frames_total", "help", ())
+            """, ["naming/metric-name"])
+        assert len(res.findings) == 1
+        assert "nnstpu_<layer>_<name>_<unit>" in res.findings[0].message
+
+    def test_bad_span_name_fires(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            def handle(store):
+                with store.start_span("Query.ServerHandle"):
+                    pass
+                with store.start_span("query.server_handle"):
+                    pass
+            """, ["naming/span-name"])
+        assert len(res.findings) == 1
+
+
+# --------------------------------------------------------------------------- #
+# suppressions
+# --------------------------------------------------------------------------- #
+
+class TestSuppressions:
+    SEEDED = """
+        import threading
+
+        def spawn(fn):{trail}
+            t = threading.Thread(target=fn){same}
+            t.start()
+            return t
+        """
+
+    def test_same_line_suppression(self, tmp_path):
+        code = self.SEEDED.format(
+            trail="", same="  # nnslint: disable=concurrency/thread-daemon")
+        res = lint_snippet(tmp_path, code, ["concurrency/thread-daemon"])
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_comment_line_above_suppression(self, tmp_path):
+        code = """
+            import threading
+
+            def spawn(fn):
+                # nnslint: disable=concurrency/thread-daemon
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+            """
+        res = lint_snippet(tmp_path, code, ["concurrency/thread-daemon"])
+        assert res.findings == [] and res.suppressed == 1
+
+    def test_family_and_all_tokens(self, tmp_path):
+        for token in ("concurrency", "all"):
+            code = self.SEEDED.format(
+                trail="", same=f"  # nnslint: disable={token}")
+            res = lint_snippet(tmp_path, code,
+                               ["concurrency/thread-daemon"])
+            assert res.findings == [], token
+            assert res.suppressed == 1, token
+
+    def test_unrelated_rule_not_suppressed(self, tmp_path):
+        code = self.SEEDED.format(
+            trail="", same="  # nnslint: disable=wire/cmd-dispatch")
+        res = lint_snippet(tmp_path, code, ["concurrency/thread-daemon"])
+        assert len(res.findings) == 1 and res.suppressed == 0
+
+    def test_code_line_above_does_not_leak_suppression(self, tmp_path):
+        code = """
+            import threading
+
+            def spawn(fn):
+                x = 1  # nnslint: disable=concurrency/thread-daemon
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+            """
+        res = lint_snippet(tmp_path, code, ["concurrency/thread-daemon"])
+        assert len(res.findings) == 1
+
+
+# --------------------------------------------------------------------------- #
+# baseline round trip
+# --------------------------------------------------------------------------- #
+
+class TestBaseline:
+    def _finding(self, msg="m", anchor="a"):
+        return Finding(rule="concurrency/thread-daemon", path="x/y.py",
+                       line=10, message=msg, anchor=anchor)
+
+    def test_save_load_round_trip(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        f = self._finding()
+        n = nnsl_baseline.save([f], bl)
+        assert n == 1
+        keys = nnsl_baseline.load(bl)
+        assert keys == {f.key}
+        # keys are line-number free: drift must not invalidate them
+        drifted = Finding(rule=f.rule, path=f.path, line=999,
+                          message=f.message, anchor=f.anchor)
+        new, grandfathered, stale = nnsl_baseline.split([drifted], keys)
+        assert new == [] and grandfathered == [drifted] and stale == set()
+
+    def test_split_reports_new_and_stale(self, tmp_path):
+        old = self._finding(anchor="gone")
+        keys = {old.key}
+        fresh = self._finding(anchor="fresh")
+        new, grandfathered, stale = nnsl_baseline.split([fresh], keys)
+        assert new == [fresh]
+        assert grandfathered == []
+        assert stale == {old.key}
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert nnsl_baseline.load(tmp_path / "nope.json") == set()
+
+    def test_committed_baseline_is_small(self):
+        # ISSUE acceptance: the committed baseline stays <= 10 entries
+        entries = json.loads(nnsl_baseline.DEFAULT_BASELINE.read_text())
+        assert isinstance(entries, list) and len(entries) <= 10
+
+
+# --------------------------------------------------------------------------- #
+# CLI + tier-1 gate
+# --------------------------------------------------------------------------- #
+
+def _run_cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "scripts.nnslint", *args],
+        cwd=str(cwd), capture_output=True, text=True, timeout=300)
+
+
+@pytest.mark.slow
+class TestCli:
+    def test_repo_lints_clean(self):
+        """Tier-1 gate: the tree has no findings beyond the committed
+        baseline. A regression in any rule family fails this test."""
+        proc = _run_cli("--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["findings"] == []
+        assert report["stale_baseline_keys"] == []
+        assert report["files"] > 50
+        assert report["rules"] >= 16
+
+    def test_findings_exit_code_and_update_baseline(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import threading\n"
+                       "t = threading.Thread(target=print)\n")
+        bl = tmp_path / "bl.json"
+        proc = _run_cli(str(bad), "--baseline", str(bl),
+                        "--select", "concurrency/thread-daemon")
+        assert proc.returncode == 1
+        assert "thread-daemon" in proc.stderr
+        # --update-baseline grandfathers it and flips the verdict
+        proc = _run_cli(str(bad), "--baseline", str(bl),
+                        "--select", "concurrency/thread-daemon",
+                        "--update-baseline")
+        assert proc.returncode == 0
+        assert len(json.loads(bl.read_text())) == 1
+        proc = _run_cli(str(bad), "--baseline", str(bl),
+                        "--select", "concurrency/thread-daemon", "--json")
+        assert proc.returncode == 0
+        report = json.loads(proc.stdout)
+        assert report["findings"] == []
+        assert len(report["grandfathered"]) == 1
+
+    def test_list_rules_covers_all_families(self):
+        proc = _run_cli("--list-rules")
+        assert proc.returncode == 0
+        for family in ("concurrency/", "contracts/", "jax/", "wire/",
+                       "naming/"):
+            assert family in proc.stdout
+
+    def test_error_exit_on_bad_path(self):
+        proc = _run_cli("definitely/not/a/path.py")
+        assert proc.returncode == 2

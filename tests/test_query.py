@@ -735,3 +735,94 @@ class TestPipelinedOffload:
         finally:
             cp.stop()
             sp1.stop()
+
+
+class TestLintRegressions:
+    """Focused regressions for the true positives nnslint surfaced
+    (see docs/analysis.md): the INFO_DENY dispatch gap, thread-leak
+    joins, and the _peer_of never-raise boundary."""
+
+    def _serve(self, port):
+        sp = Pipeline("server")
+        ssrc = sp.add_new("tensor_query_serversrc", host="127.0.0.1",
+                          port=port, id=90, dims="4:1", types="float32")
+        filt = sp.add_new("tensor_filter", model=lambda x: x)
+        ssink = sp.add_new("tensor_query_serversink", id=90)
+        Pipeline.link(ssrc, filt, ssink)
+        sp.start()
+        time.sleep(0.2)
+        return sp
+
+    def test_server_denies_caps_mismatch_with_info_deny(self):
+        from nnstreamer_tpu.query.protocol import recv_message, send_message
+
+        port = free_port()
+        sp = self._serve(port)
+        try:
+            # wrong media type: explicit INFO_DENY naming the mismatch,
+            # not a generic error after the first DATA frame
+            with socket.create_connection(("127.0.0.1", port), 5) as s:
+                send_message(s, Cmd.INFO_REQ, {"caps": "video/x-raw(w=4)"})
+                cmd, meta, _ = recv_message(s)
+                assert cmd is Cmd.INFO_DENY
+                assert "caps mismatch" in meta["error"]
+            # compatible (and unknown) caps still approve
+            for caps in ("other/tensors(dims=4:1)", ""):
+                with socket.create_connection(("127.0.0.1", port), 5) as s:
+                    send_message(s, Cmd.INFO_REQ, {"caps": caps})
+                    cmd, meta, _ = recv_message(s)
+                    assert cmd is Cmd.INFO_APPROVE, caps
+        finally:
+            sp.stop()
+
+    def test_client_surfaces_deny_reason(self):
+        from nnstreamer_tpu.query.client import TensorQueryClient
+
+        port = free_port()
+        sp = self._serve(port)
+        try:
+            qc = TensorQueryClient(host="127.0.0.1", port=port,
+                                   timeout_s=2.0)
+            qc.sink_pad.caps = Caps("video/x-raw", {"w": 4})
+            with pytest.raises(ConnectionError, match="caps mismatch"):
+                qc._connect()
+        finally:
+            sp.stop()
+
+    def test_server_stop_joins_all_workers(self):
+        port = free_port()
+        sp = self._serve(port)
+        with socket.create_connection(("127.0.0.1", port), 5):
+            time.sleep(0.3)  # let the accept loop spawn the conn worker
+        sp.stop()
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("qsrv-")]
+        assert leaked == []
+
+    def test_discovery_broker_stop_joins_thread(self):
+        broker = DiscoveryBroker(port=0).start()
+        worker = broker._thread
+        assert worker is not None and worker.is_alive()
+        broker.stop()
+        assert broker._thread is None
+        assert not worker.is_alive()
+        # the joined listener releases the port for an immediate rebind
+        broker2 = DiscoveryBroker(port=broker.port).start()
+        broker2.stop()
+
+    def test_peer_of_never_raises(self):
+        from nnstreamer_tpu.query.protocol import _peer_of
+
+        class WeirdSock:
+            def getpeername(self):
+                raise RuntimeError("driver bug")  # outside OSError
+
+        class TupleLess:
+            def getpeername(self):
+                return 7  # peer[0] raises TypeError
+
+        s = socket.socket()
+        s.close()
+        assert _peer_of(s) is None            # OSError path
+        assert _peer_of(WeirdSock()) is None  # arbitrary exception
+        assert _peer_of(TupleLess()) is None
