@@ -10,6 +10,10 @@ Options: -t/--time limit, -v verbose bus messages, --list-elements,
 defaults, plus registered subplugin modes for filter/decoder/converter),
 --metrics-port/--trace/--watchdog/--events-dump (observability: metrics
 exporter, span tracing, health watchdog, flight-recorder dump),
+--profile[=N]/--profile-dump (device-time profiler: dispatch/compile/
+MFU telemetry, /debug/profile Perfetto timeline on --metrics-port, and
+(shape, dtype, fusion, device) → cost samples for the autotuner — see
+docs/observability.md "Profiling"),
 --obs-push/--obs-aggregate (fleet federation: push this process's
 snapshots to an aggregator / serve the merged fleet — see
 docs/observability.md), --deadline-ms/--fallback (resilience: per-buffer
@@ -31,6 +35,31 @@ import argparse
 import os
 import sys
 import time
+
+
+#: flags taking an optional numeric value (nargs="?"): bare forms must
+#: not swallow a following pipeline positional, which argparse would
+#: otherwise consume before type conversion rejects it.
+_BARE_OK_FLAGS = ("--profile", "--watchdog")
+
+
+def _normalize_argv(argv):
+    """Move a bare ``--profile``/``--watchdog`` to the end of argv when
+    the next token is not its numeric value, so ``--profile '<pipeline>'``
+    parses the pipeline as the positional (argparse otherwise consumes it
+    for the flag and dies on ``invalid int value``). A trailing flag with
+    nothing after it takes its ``const`` default."""
+    out, deferred = [], []
+    for i, tok in enumerate(argv):
+        if tok in _BARE_OK_FLAGS and i + 1 < len(argv) \
+                and not argv[i + 1].startswith("-"):
+            try:
+                float(argv[i + 1])
+            except ValueError:
+                deferred.append(tok)
+                continue
+        out.append(tok)
+    return out + deferred
 
 
 def main(argv=None) -> int:
@@ -59,6 +88,18 @@ def main(argv=None) -> int:
                     help="enable the flight recorder (obs.events) and dump "
                          "the event journal to PATH as JSON lines at exit "
                          "('-' dumps human-readable to stderr)")
+    ap.add_argument("--profile", type=int, nargs="?", const=4096,
+                    default=None, metavar="N",
+                    help="enable the device-time profiler (obs.profile) "
+                         "with an N-record ring (default 4096 when given "
+                         "bare); implies --trace, serves the Perfetto "
+                         "timeline at /debug/profile with --metrics-port, "
+                         "and prints the profile report at exit")
+    ap.add_argument("--profile-dump", metavar="PATH", default=None,
+                    help="write the profiler's (shape, dtype, fusion, "
+                         "device) -> cost samples to PATH as JSON at exit "
+                         "(the autotuner training substrate; needs "
+                         "--profile)")
     ap.add_argument("--obs-push", metavar="URL", default=None,
                     help="push metric/health/span snapshots to a fleet "
                          "aggregator (obs.fleet): http://host:port for a "
@@ -104,7 +145,8 @@ def main(argv=None) -> int:
                     help="zoo model names usable as model=zoo://<name>")
     ap.add_argument("--inspect", metavar="ELEMENT",
                     help="describe an element: pads, properties, defaults")
-    args = ap.parse_args(argv)
+    args = ap.parse_args(_normalize_argv(
+        sys.argv[1:] if argv is None else list(argv)))
 
     if args.list_elements:
         from .graph.element import all_element_names
@@ -139,6 +181,11 @@ def main(argv=None) -> int:
         if len(backend_eps) < 2:
             ap.error("--hedge-ms needs --backends with >= 2 endpoints "
                      "(a hedge must land on a different backend)")
+    if args.profile is not None and args.profile < 1:
+        ap.error("--profile must be >= 1 (ring capacity in records)")
+    if args.profile_dump is not None and args.profile is None:
+        ap.error("--profile-dump needs --profile (no samples are "
+                 "recorded without the profiler)")
     if args.kv_pages is not None and args.kv_page_size is None:
         ap.error("--kv-pages needs --kv-page-size (paging is off without "
                  "a page size)")
@@ -224,12 +271,21 @@ def main(argv=None) -> int:
         print(f"fleet: pushing as {psh.instance} "
               f"({'query-wire piggyback' if url is None else url})",
               file=sys.stderr)
-    if args.trace:
+    if args.trace or args.profile is not None:
         # like metrics: must be on BEFORE p.start() so the element
         # chains get the span-opening wrap at instrumentation time
+        # (--profile implies tracing: the Perfetto host lanes come
+        # from pipeline.element spans)
         from .obs import tracing
 
         tracing.enable()
+    if args.profile is not None:
+        # hooks install process-wide, so "before p.start()" is a
+        # convention here, not a requirement — but enabling early
+        # captures the warmup compiles too
+        from .obs import profile
+
+        profile.enable(max_records=args.profile)
     if args.watchdog is not None or args.events_dump is not None:
         # same start-time rule: health components and the event bridge
         # only attach to what is built/started AFTER enable()
@@ -283,6 +339,14 @@ def main(argv=None) -> int:
             from .obs import tracing
 
             print(tracing.element_stats_report(), file=sys.stderr)
+        if args.profile is not None:
+            from .obs import profile
+
+            print(profile.report(), file=sys.stderr)
+            if args.profile_dump is not None:
+                n = profile.dump_samples(args.profile_dump)
+                print(f"profile: {n} cost samples -> "
+                      f"{args.profile_dump}", file=sys.stderr)
         if args.events_dump is not None:
             from .obs import events
 

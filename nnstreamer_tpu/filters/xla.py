@@ -45,6 +45,7 @@ from ..core.buffer import TensorMemory
 from ..core.log import logger
 from ..core.types import TensorInfo, TensorsInfo
 from ..models.zoo import ModelBundle, get_model
+from ..obs import profile as _profile
 from .base import FilterFramework, FilterProps, register_filter
 
 log = logger("xla")
@@ -362,6 +363,8 @@ class XLAFilter(FilterFramework):
         if cache is not None:
             hit = cache.get(cache_key)
             if hit is not None:
+                if _profile.DISPATCH_HOOK is not None:
+                    _profile.DISPATCH_HOOK.on_jit_cache("bundle", True)
                 self._jitted = hit
                 return
 
@@ -386,6 +389,8 @@ class XLAFilter(FilterFramework):
         self._jitted = jax.jit(wrapped, **kw)
         if cache is not None:
             cache[cache_key] = self._jitted
+            if _profile.DISPATCH_HOOK is not None:
+                _profile.DISPATCH_HOOK.on_jit_cache("bundle", False)
 
     def close(self) -> None:
         self._jitted = None
@@ -440,7 +445,12 @@ class XLAFilter(FilterFramework):
         if orig_batch is None:
             arrays = [m.device(self._device) for m in inputs]
         with self._lock:
-            outs = self._jitted(*arrays)
+            # profiled dispatch: one module load + None check when off
+            prof = _profile.DISPATCH_HOOK
+            if prof is not None:
+                outs = prof.dispatch(self, arrays)
+            else:
+                outs = self._jitted(*arrays)
         if orig_batch is not None:
             # sharded_bundle's out_shardings put every output's leading
             # axis over the data mesh axis, so outputs are batch-led by
@@ -487,7 +497,11 @@ class XLAFilter(FilterFramework):
                 static_argnums=0)
         batch = self._stack_fn(bucket - n, *arrays)
         with self._lock:
-            outs = self._jitted(batch)
+            prof = _profile.DISPATCH_HOOK
+            if prof is not None:
+                outs = prof.dispatch(self, [batch])
+            else:
+                outs = self._jitted(batch)
         if self._sync:
             for o in outs:
                 o.block_until_ready()

@@ -57,6 +57,12 @@ def join_or_warn(t: threading.Thread, owner: str,
 #: path onto the bus. Disabled cost: one global load + None check.
 CHAOS_CHAIN_HOOK = None
 
+#: profiler timing point (obs/profile.py installs/clears this): called
+#: as ``hook(peer_pad, buf)`` IN PLACE of ``peer.element._chain_entry``
+#: — it runs the chain itself, timed, and returns the chain's
+#: FlowReturn. Same disabled cost contract as CHAOS_CHAIN_HOOK.
+PROFILE_CHAIN_HOOK = None
+
 
 class FlowReturn(enum.Enum):
     OK = "ok"
@@ -111,7 +117,10 @@ class Pad:
             if CHAOS_CHAIN_HOOK is not None \
                     and CHAOS_CHAIN_HOOK(peer.element.name, buf):
                 return FlowReturn.OK  # buffer dropped by the fault plan
-            ret = peer.element._chain_entry(peer, buf)
+            if PROFILE_CHAIN_HOOK is not None:
+                ret = PROFILE_CHAIN_HOOK(peer, buf)
+            else:
+                ret = peer.element._chain_entry(peer, buf)
             return ret if ret is not None else FlowReturn.OK
         except Exception as e:  # noqa: BLE001 — element errors become bus messages
             peer.element.post_error(f"chain error: {type(e).__name__}: {e}", exc=e)

@@ -10,12 +10,16 @@ verdicts, and a flight-recorder event ring (``/debug/events``). See
 docs/observability.md for the metric/span/event name catalogs and
 usage.
 
-Metrics, tracing, health, and events are independently switchable
-(``enable()`` / ``tracing.enable()`` / ``health.enable()`` /
-``events.enable()``); each is a flag-check no-op when off. The fleet
-layer (obs/fleet.py) federates all four across processes: workers
-push snapshots over the query wire or plain HTTP, and one aggregator
-re-exposes the merged fleet on its exporter.
+Metrics, tracing, health, events, and profiling are independently
+switchable (``enable()`` / ``tracing.enable()`` / ``health.enable()``
+/ ``events.enable()`` / ``profile.enable()``); each is a flag-check
+no-op when off. The fleet layer (obs/fleet.py) federates metrics,
+health, and spans across processes: workers push snapshots over the
+query wire or plain HTTP, and one aggregator re-exposes the merged
+fleet on its exporter. The profiler (obs/profile.py) adds device-time
+attribution: per-dispatch host/device timing, jit-cache and compile
+telemetry, live MFU/roofline gauges, and a Perfetto timeline at
+``/debug/profile``.
 """
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
@@ -25,17 +29,20 @@ from .instrument import instrument_pipeline
 from . import events
 from . import fleet
 from . import health
+from . import profile
 from . import tracing
 from .events import EventRing
 from .fleet import FleetAggregator, FleetPusher
 from .health import Component, HealthRegistry, Status
+from .profile import Profiler, perfetto_trace
 from .tracing import Span, SpanContext, SpanStore, start_span
 
 __all__ = [
     "Component", "DEFAULT_LATENCY_BUCKETS", "EventRing",
     "FleetAggregator", "FleetPusher", "HealthRegistry",
-    "MetricsRegistry", "MetricsExporter", "Span", "SpanContext",
-    "SpanStore", "Status", "disable", "enable", "enabled", "events",
-    "fleet", "health", "instrument_pipeline", "registry",
-    "start_exporter", "start_span", "tracing",
+    "MetricsRegistry", "MetricsExporter", "Profiler", "Span",
+    "SpanContext", "SpanStore", "Status", "disable", "enable",
+    "enabled", "events", "fleet", "health", "instrument_pipeline",
+    "perfetto_trace", "profile", "registry", "start_exporter",
+    "start_span", "tracing",
 ]

@@ -22,6 +22,9 @@ health and debug surfaces:
     (obs/events.py), oldest first; ``?n=<int>`` keeps the newest N
   * ``GET /debug/fleet``             — per-instance fleet state when
     this process aggregates (obs/fleet.py); 503 otherwise
+  * ``GET /debug/profile``           — Chrome trace_event / Perfetto
+    JSON timeline (obs/profile.py): host lanes per pipeline thread,
+    device lanes per dispatch label, serving lanes + occupancy counter
   * ``POST /fleet/push``             — snapshot-push ingestion for
     workers without a query wire; 503 unless aggregating
 
@@ -31,8 +34,10 @@ serves the merged fleet exposition (every instance's series with
 worst-of-fleet rollups — checked per request, so no restart is needed
 to switch roles.
 
-Routes live in a dispatch table; the 404 hint is derived from it, so
-a new endpoint can never be forgotten from the hint.
+All routes — GET and POST — live in ONE ``(method, path)`` dispatch
+table; the 404 hint is derived from it, so a new endpoint can never be
+forgotten from the hint, and adding one is a single table entry
+regardless of method.
 
 No new dependencies: ``ThreadingHTTPServer`` handles concurrent
 scrapes and the GIL is irrelevant at scrape rates.
@@ -59,6 +64,7 @@ from . import events as _events
 from . import fleet as _fleet
 from . import health as _health
 from . import metrics as _metrics
+from . import profile as _profile
 from . import tracing as _tracing
 
 __all__ = ["MetricsExporter", "start_exporter"]
@@ -78,16 +84,36 @@ class MetricsExporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                self._dispatch("POST")
+
+            def _dispatch(self, method):
+                """One (method, path) table serves every verb — a new
+                endpoint is one entry, GET or POST alike."""
                 path, _, query = self.path.partition("?")
-                handler = self._ROUTES.get(path)
+                handler = self._ROUTES.get((method, path))
                 if handler is not None:
                     handler(self, query)
                     return
-                for prefix, ph in self._PREFIX_ROUTES:
-                    if path.startswith(prefix):
+                for (m, prefix), ph in self._PREFIX_ROUTES:
+                    if m == method and path.startswith(prefix):
                         ph(self, path[len(prefix):], query)
                         return
                 self._reply(404, "text/plain", self._HINT)
+
+            def _read_body(self):
+                """Size-checked request body for POST handlers; replies
+                413 and returns None when over MAX_PUSH_BYTES."""
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    n = -1
+                if n < 0 or n > _fleet.MAX_PUSH_BYTES:
+                    self._json(413, {"error": "push body too large"})
+                    return None
+                return self.rfile.read(n)
 
             # -- routes ------------------------------------------------ #
             # /metrics, /healthz, /readyz consult the fleet aggregator
@@ -176,22 +202,17 @@ class MetricsExporter:
                     "events": ring.snapshot(n if n >= 0 else None),
                 })
 
-            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path, _, _query = self.path.partition("?")
-                handler = self._POST_ROUTES.get(path)
-                if handler is None:
-                    self._reply(404, "text/plain", self._HINT)
-                    return
-                try:
-                    n = int(self.headers.get("Content-Length") or 0)
-                except ValueError:
-                    n = -1
-                if n < 0 or n > _fleet.MAX_PUSH_BYTES:
-                    self._json(413, {"error": "push body too large"})
-                    return
-                handler(self, self.rfile.read(n))
+            def _get_profile(self, query):
+                # always 200: a valid (possibly sparse) trace with the
+                # enable flags in otherData beats a 503 the viewer
+                # cannot load
+                self._json(200, _profile.perfetto_trace(
+                    span_store=_tracing.store()))
 
-            def _post_fleet_push(self, body):
+            def _post_fleet_push(self, query):
+                body = self._read_body()
+                if body is None:
+                    return
                 agg = _fleet.aggregator()
                 if agg is None:
                     self._json(503, {"error": "this process is not a "
@@ -204,23 +225,25 @@ class MetricsExporter:
                     return
                 self._json(200, {"ok": True})
 
-            #: THE route table — the 404 hint below derives from it, so
-            #: adding an endpoint here is the whole registration
+            #: THE route table — GET and POST share it, and the 404
+            #: hint below derives from it, so adding an endpoint here
+            #: is the whole registration
             _ROUTES = {
-                "/metrics": _get_metrics,
-                "/healthz": _get_healthz,
-                "/readyz": _get_readyz,
-                "/debug/traces": _get_traces,
-                "/debug/pipeline": _get_pipeline,
-                "/debug/events": _get_events,
-                "/debug/fleet": _get_fleet,
+                ("GET", "/metrics"): _get_metrics,
+                ("GET", "/healthz"): _get_healthz,
+                ("GET", "/readyz"): _get_readyz,
+                ("GET", "/debug/traces"): _get_traces,
+                ("GET", "/debug/pipeline"): _get_pipeline,
+                ("GET", "/debug/events"): _get_events,
+                ("GET", "/debug/fleet"): _get_fleet,
+                ("GET", "/debug/profile"): _get_profile,
+                ("POST", "/fleet/push"): _post_fleet_push,
             }
-            _PREFIX_ROUTES = (("/debug/traces/", _get_trace),)
-            _POST_ROUTES = {"/fleet/push": _post_fleet_push}
-            _HINT = ("not found (try " + ", ".join(
-                sorted(list(_ROUTES)
-                       + [p + "<id>" for p, _ in _PREFIX_ROUTES]
-                       + [f"POST {p}" for p in _POST_ROUTES]))
+            _PREFIX_ROUTES = ((("GET", "/debug/traces/"), _get_trace),)
+            _HINT = ("not found (try " + ", ".join(sorted(
+                [p if m == "GET" else f"{m} {p}" for m, p in _ROUTES]
+                + [(p if m == "GET" else f"{m} {p}") + "<id>"
+                   for (m, p), _ in _PREFIX_ROUTES]))
                 + ")").encode("utf-8")
 
             def _json(self, code, obj):

@@ -37,6 +37,14 @@ The disabled fast path is structural: when neither metrics, tracing,
 nor health are on at start time nothing here runs, element
 ``_chain_entry`` stays the plain class method, and the hot path pays
 nothing (tests/test_obs.py pins this).
+
+The profiler (obs/profile.py) deliberately does NOT ride this wrap: it
+times chains through ``graph.element.PROFILE_CHAIN_HOOK`` (the chaos-
+hook pattern — installed on ``profile.enable()``, None when off), so a
+profile-only capture needs no pipeline restart and adds nothing to the
+wrap above. Its host-lane element records are the tracing-off fallback
+for ``/debug/profile``; with tracing on, the richer
+``pipeline.element`` spans opened here are the host lanes.
 """
 
 from __future__ import annotations

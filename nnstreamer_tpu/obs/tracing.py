@@ -124,7 +124,7 @@ class Span:
     context manager: exceptions set ``error=True`` before ending."""
 
     __slots__ = ("name", "context", "start_ns", "end_ns", "wall",
-                 "attrs", "_store", "_token")
+                 "attrs", "tid", "_store", "_token")
     recording = True
 
     def __init__(self, store: "SpanStore", name: str, context: SpanContext,
@@ -136,6 +136,9 @@ class Span:
         self.start_ns = time.monotonic_ns()
         self.wall = time.time()
         self.end_ns: Optional[int] = None
+        # creating thread: the Perfetto exporter lays host spans out in
+        # one lane per pipeline thread
+        self.tid = threading.get_ident()
         self._token = None
 
     def set_attribute(self, key: str, value: Any) -> None:
@@ -430,6 +433,21 @@ class SpanStore:
             for el, v in agg.items()
         }
 
+    def snapshot_spans(self, max_spans: int = 20000) -> List[Span]:
+        """Flat snapshot of recorded spans across all retained traces
+        (completed spans only), for timeline exporters (obs/profile.py's
+        Perfetto view). Bounded: retention already caps traces, this
+        caps the flattened view."""
+        out: List[Span] = []
+        with self._lock:
+            for tr in self._traces.values():
+                for s in tr.spans:
+                    if s.end_ns is not None:
+                        out.append(s)
+                        if len(out) >= max_spans:
+                            return out
+        return out
+
     # -- fleet span export/ingest (obs/fleet.py) ------------------------ #
     def set_export(self, on: bool) -> None:
         """Flip fleet span export. Off (the default) keeps _record's
@@ -512,6 +530,7 @@ class SpanStore:
                 span.wall = float(d["wall"])
                 span.start_ns = int(span.wall * 1e9) + offset_ns
                 span.end_ns = span.start_ns + max(int(d["dur_ns"]), 0)
+                span.tid = 0  # remote thread idents are meaningless here
                 span._token = None
             except Exception:
                 # the docstring's "never raised" is load-bearing: any

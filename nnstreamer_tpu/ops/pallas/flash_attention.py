@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs import profile as _profile
 from .preprocess import _on_tpu
 
 _NEG_INF = -1e30  # mask value; finite so (m - m) stays NaN-free
@@ -154,6 +155,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if pl is None:  # pragma: no cover
         raise RuntimeError("pallas unavailable in this jax build")
+    if _profile.KERNEL_HOOK is not None:  # trace-time kernel label
+        _profile.KERNEL_HOOK("pallas.flash_attention", q.shape, q.dtype)
     if interpret is None:
         interpret = not _on_tpu()
     b, h, L, d_orig = q.shape

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs import profile as _profile
+
 
 def _on_tpu() -> bool:
     try:
@@ -60,6 +62,8 @@ def normalize_u8(x: jax.Array, scale: float = 1.0 / 127.5,
     path when not on TPU (unless interpret=True for testing)."""
     if not (interpret or _on_tpu()):
         return normalize_u8_reference(x, scale, bias, out_dtype)
+    if _profile.KERNEL_HOOK is not None:  # trace-time kernel label
+        _profile.KERNEL_HOOK("pallas.normalize_u8", x.shape, x.dtype)
     from jax.experimental import pallas as pl
 
     orig_shape = x.shape
@@ -108,6 +112,8 @@ def quantize_affine(x: jax.Array, scale: float, zero_point: int = 0,
                     interpret: bool = False) -> jax.Array:
     if not (interpret or _on_tpu()):
         return quantize_affine_reference(x, scale, zero_point)
+    if _profile.KERNEL_HOOK is not None:  # trace-time kernel label
+        _profile.KERNEL_HOOK("pallas.quantize_affine", x.shape, x.dtype)
     from jax.experimental import pallas as pl
 
     orig_shape = x.shape

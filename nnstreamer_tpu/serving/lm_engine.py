@@ -66,6 +66,7 @@ from ..models import causal_lm
 from ..obs import events as _events
 from ..obs import health as _health
 from ..obs import metrics as _obs
+from ..obs import profile as _profile
 from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
 from ..resilience import policy as _rp
@@ -718,6 +719,8 @@ class LMEngine:
                 pspan = _tracing.start_span(
                     "serving.prefill", parent=req.span.context,
                     attrs={"bucket": tb, "slot": slot})
+            tp0 = time.monotonic_ns() \
+                if _profile.ENGINE_HOOK is not None else 0
             if self._kv is None:
                 first = self._prefill_into(
                     slot, padded, t, skey, temp, tk, tp)
@@ -745,6 +748,14 @@ class LMEngine:
             # once that D2H read completes
             self._m_ttft.observe(time.monotonic() - req.t_submit)
             pspan.end()  # prefill span covers through first-token D2H
+            if _profile.ENGINE_HOOK is not None:
+                # the int(first) D2H above synced the prefill, so the
+                # interval is device-bound; first_use intervals are
+                # compile-dominated and recorded as such
+                _profile.ENGINE_HOOK.record_engine(
+                    self, "prefill", tp0, time.monotonic_ns(),
+                    tokens=t, steps=1, compiled=first_use,
+                    bucket=blabel, slot=slot)
             if req.span is not None:
                 req.decode_span = _tracing.start_span(
                     "serving.decode", parent=req.span.context,
@@ -893,6 +904,13 @@ class LMEngine:
         t0 = time.monotonic()
         outs = np.asarray(self._run_chunk(n))  # (S, n)
         self._m_tok_lat.observe((time.monotonic() - t0) / n)
+        if _profile.ENGINE_HOOK is not None:
+            # np.asarray blocked on the chunk: wall ≈ device time; the
+            # occupancy sample drives the Perfetto serving counter lane
+            _profile.ENGINE_HOOK.record_engine(
+                self, "decode", int(t0 * 1e9), time.monotonic_ns(),
+                tokens=n * len(active), steps=n, active=len(active),
+                queued=len(self._queue), slots=self.n_slots)
         for s in range(self.n_slots):
             self._pos_host[s] += n  # device pos advances for EVERY slot
         self.stats["decode_steps"] += n
@@ -971,6 +989,12 @@ class LMEngine:
         accepted = float(np.mean(m[active])) if active else 1.0
         self._m_tok_lat.observe(
             (time.monotonic() - t0) / max(accepted, 1.0))
+        if _profile.ENGINE_HOOK is not None:
+            _profile.ENGINE_HOOK.record_engine(
+                self, "verify", int(t0 * 1e9), time.monotonic_ns(),
+                tokens=int(np.sum(m[active])) if active else 0, steps=1,
+                active=len(active), queued=len(self._queue),
+                slots=self.n_slots, draft=g)
         for s in range(self.n_slots):
             # unlike chunks, per-slot advance is data-dependent — the
             # mirror updates from the fetched acceptance counts

@@ -47,6 +47,14 @@ other instance, so the aggregator needs no sharding awareness either.
 Deadline load shedding (resilience/policy.py) is inherited the same
 way: submit/_admit shed past-deadline requests before any sharded
 prefill is dispatched, emitting ``resilience.shed`` with engine="tp".
+The profiler (obs/profile.py) is inherited too: _admit/_decode's
+``ENGINE_HOOK`` call sites record prefill/decode/verify intervals and
+batch occupancy with ``_engine_label`` = "tp", so a sharded engine gets
+its own ``nnstpu_profile_mfu_ratio{engine="tp"}`` / roofline gauges and
+serving lanes in ``/debug/profile`` with zero TP-specific code (param
+count for the FLOPs model comes from the engine's sharded tree — leaf
+``.size`` is the GLOBAL logical size, so the MFU denominator is still
+the whole model).
 """
 
 from __future__ import annotations

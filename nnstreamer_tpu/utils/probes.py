@@ -32,17 +32,43 @@ PEAK_FLOPS = {
 }
 DEFAULT_PEAK = 197e12
 
+#: per-chip peak HBM bandwidth (bytes/s), same device_kind keying —
+#: the roofline's memory ceiling (public chip specs).
+PEAK_HBM_BW = {
+    "v5 lite": 819e9,  # TPU v5e
+    "v5e": 819e9,
+    "v4": 1228e9,
+    "v5p": 2765e9,
+    "v6": 1640e9,  # Trillium
+    "cpu": 50e9,  # nominal DDR figure; roofline on CPU is not meaningful
+}
+DEFAULT_HBM_BW = 819e9
 
-def chip_peak_flops(device: Any = None) -> float:
+
+def _by_device_kind(table: Dict[str, float], default: float,
+                    device: Any = None) -> float:
     import jax
 
     device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "") or str(device)
-    kind = kind.lower()
-    for key, val in PEAK_FLOPS.items():
+    kind = (getattr(device, "device_kind", "") or str(device)).lower()
+    for key, val in table.items():
         if key in kind:
             return val
-    return DEFAULT_PEAK
+    return default
+
+
+def chip_peak_flops(device: Any = None) -> float:
+    return _by_device_kind(PEAK_FLOPS, DEFAULT_PEAK, device)
+
+
+def chip_peak_hbm_bw(device: Any = None) -> float:
+    return _by_device_kind(PEAK_HBM_BW, DEFAULT_HBM_BW, device)
+
+
+def ridge_intensity(device: Any = None) -> float:
+    """Roofline ridge point (FLOPs/byte): operational intensity below
+    this is memory-bound, above it compute-bound, on this chip."""
+    return chip_peak_flops(device) / chip_peak_hbm_bw(device)
 
 
 def model_flops(fn: Callable, *example_args: Any) -> Optional[float]:
@@ -63,9 +89,25 @@ def model_flops(fn: Callable, *example_args: Any) -> Optional[float]:
 
 def mfu(flops_per_frame: Optional[float], fps: float,
         device: Any = None) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over chip peak. Only an
+    *MFU* when fps is measured over device-busy time (a saturating or
+    synced loop). For an end-to-end pipeline rate — where batching
+    budgets, tunnel RTT, and host stages sit between frames — use
+    ``pipeline_util``, which is the same ratio under its honest name."""
     if not flops_per_frame or not np.isfinite(fps):
         return None
     return flops_per_frame * fps / chip_peak_flops(device)
+
+
+def pipeline_util(flops_per_frame: Optional[float], fps: float,
+                  device: Any = None) -> Optional[float]:
+    """Fraction of chip peak consumed by a pipeline running end-to-end
+    at ``fps``: (per-frame FLOPs × fps) / peak. Deliberately NOT called
+    MFU: wall-clock fps includes everything that is not the chip
+    (batch-formation budgets, queue waits, host pre/post, wire RTT), so
+    tiny values mean "the chip is mostly idle between frames", not "the
+    model runs inefficiently"."""
+    return mfu(flops_per_frame, fps, device)
 
 
 def _pipelined(run_one: Callable[[int], Any], k: int,

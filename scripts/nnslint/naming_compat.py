@@ -7,11 +7,12 @@ types against the repo naming conventions.
 Metric convention (docs/observability.md): every metric is
 ``nnstpu_<layer>_<name>_<unit>`` with
 
-  * layer  in {pipeline, query, serving, resilience, chaos, router},
+  * layer  in {pipeline, query, serving, resilience, chaos, router,
+    profile},
   * counters    ending in ``_total``,
   * histograms  ending in ``_seconds``,
   * gauges      ending in one of ``_depth`` / ``_slots`` / ``_bytes`` /
-    ``_state`` / ``_pages``,
+    ``_state`` / ``_pages`` / ``_ratio`` / ``_flops``,
   * label keys matching ``[a-z_][a-z0-9_]*``, never the reserved
     ``instance``/``role`` (appended by fleet federation) or ``le``
     (histogram encoder), and at most 8 keys per family (cardinality
@@ -42,6 +43,16 @@ modules record through its helpers), and conversely the resilience
 package never registers under another layer's name. check_resilience
 enforces both directions so policy telemetry can't drift into ad-hoc
 per-module names.
+
+Profile placement (docs/observability.md "Profiling"): the
+``profile`` metric + event layer belongs to nnstreamer_tpu/obs/
+profile.py — dispatch timing, jit-cache/compile telemetry, and the
+MFU/roofline gauges are registered there only (other modules feed them
+through the profiler hooks, never by minting profile.* names), and the
+dimensionless ``ratio`` and ``flops`` gauge units are reserved to that
+layer (an efficiency ratio elsewhere should be a profile gauge, not a
+convention fork). check_profile enforces both directions, mirroring
+check_resilience.
 
 Router placement (docs/resilience.md "Fleet routing & failover"): the
 ``router`` metric/span/event layer belongs to
@@ -74,13 +85,15 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router")
+          "router", "profile")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
     # _state: enumerated-condition gauges (e.g. breaker 0/1/2);
-    # _pages: KV-page pool occupancy (serving kv_ family only)
-    "gauge": ("depth", "slots", "bytes", "state", "pages"),
+    # _pages: KV-page pool occupancy (serving kv_ family only);
+    # _ratio/_flops: utilization + roofline gauges (profile layer only)
+    "gauge": ("depth", "slots", "bytes", "state", "pages", "ratio",
+              "flops"),
 }
 #: span layers add "device" — device.xprof has no metric series —
 #: and "router" (the dispatch span, query/router.py)
@@ -89,10 +102,11 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
 #: "resilience"/"chaos" (fault-policy decisions + injected faults,
-#: nnstreamer_tpu/resilience/), and "router" (multi-backend placement:
-#: failover/drain/spill audit trail, query/router.py)
+#: nnstreamer_tpu/resilience/), "router" (multi-backend placement:
+#: failover/drain/spill audit trail, query/router.py), and "profile"
+#: (capture start/stop audit trail, obs/profile.py)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
-                "fleet", "resilience", "chaos", "router")
+                "fleet", "resilience", "chaos", "router", "profile")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -109,6 +123,13 @@ KV_DIR = "serving"
 #: final two parts so the lint follows the file, not an absolute root
 ROUTER_LAYER = "router"
 ROUTER_FILE = ("query", "router.py")
+
+#: the ``profile`` metric/event layer is owned by the profiler module
+#: alone, and the ``ratio``/``flops`` gauge units are reserved to it
+#: (see module doc); matched like ROUTER_FILE
+PROFILE_LAYER = "profile"
+PROFILE_FILE = ("obs", "profile.py")
+PROFILE_UNITS = frozenset({"ratio", "flops"})
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -282,6 +303,54 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_resilience(root)
     problems += check_kv(root)
     problems += check_router(root)
+    problems += check_profile(root)
+    return problems
+
+
+def _is_profile_file(path: Path) -> bool:
+    return tuple(path.parts[-2:]) == PROFILE_FILE
+
+
+def check_profile(root: Path = SOURCE_ROOT):
+    """Placement lint for the profiler telemetry: every ``profile``-
+    layer metric and event is emitted from nnstreamer_tpu/obs/
+    profile.py (dispatch sites feed the profiler through its hooks,
+    never by minting profile.* names), the profiler module registers
+    under no other layer, and the dimensionless ``ratio``/``flops``
+    gauge units stay reserved to the profile layer. Mirrors
+    check_resilience + the check_kv unit reservation."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_file = _is_profile_file(path)
+        if layer == PROFILE_LAYER and not in_file:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{PROFILE_LAYER!r} layer outside "
+                f"nnstreamer_tpu/obs/profile.py — feed the profiler "
+                f"through its hooks instead")
+        elif in_file and layer != PROFILE_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/obs/profile.py must use the "
+                f"{PROFILE_LAYER!r} layer, not {layer!r}")
+        elif m.group("unit") in PROFILE_UNITS and layer != PROFILE_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{m.group('unit')!r} gauge unit reserved for the "
+                f"{PROFILE_LAYER!r} layer")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == PROFILE_LAYER and not _is_profile_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{PROFILE_LAYER!r} layer outside "
+                f"nnstreamer_tpu/obs/profile.py")
     return problems
 
 

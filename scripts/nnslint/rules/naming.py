@@ -100,3 +100,11 @@ class PlacementRule(_NamingRule):
                    "registered in their owning packages")
     checks = (_compat.check_resilience, _compat.check_kv,
               _compat.check_router)
+
+
+@register_rule
+class ProfileRule(_NamingRule):
+    id = "naming/profile"
+    description = ("profile telemetry is registered in obs/profile.py "
+                   "and owns the ratio/flops gauge units")
+    checks = (_compat.check_profile,)

@@ -1,0 +1,107 @@
+"""scripts/bench_compare.py: lane extraction from plain and wrapped
+bench artifacts, direction-aware regression detection, rename aliases,
+and the nonzero-exit CI contract."""
+
+import json
+
+import pytest
+
+from scripts.bench_compare import (LANES, compare, lane_value,
+                                   load_lanes, main, newest_baseline)
+
+
+def _write(path, doc):
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+BASE = {
+    "composite_lstm_query_fps_median": 100.0,
+    "composite_roundtrip_p50_us": 500.0,
+    "adaptive_batch16_mfu": 0.000965,     # pre-rename lane name
+}
+
+
+class TestLaneExtraction:
+    def test_plain_result_dict(self, tmp_path):
+        lanes = load_lanes(_write(tmp_path / "r.json", BASE))
+        assert lanes["composite_lstm_query_fps_median"] == 100.0
+        assert lanes["adaptive_batch16_mfu"] == 0.000965
+
+    def test_wrapped_artifact_with_parsed(self, tmp_path):
+        doc = {"n": 1, "cmd": "python bench.py", "rc": 0,
+               "tail": "ignored", "parsed": BASE}
+        assert load_lanes(_write(tmp_path / "r.json", doc)) \
+            == pytest.approx(BASE)
+
+    def test_wrapped_artifact_tail_fallback(self, tmp_path):
+        """parsed=None (BENCH_r01/r05 shape): lanes are regexed out of
+        the possibly head-truncated tail text."""
+        doc = {"n": 5, "cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": 'b16_fps": 12.5, "adaptive_batch16_mfu": 0.000965,'
+                       ' "composite_roundtrip_p50_us": 432.1}'}
+        lanes = load_lanes(_write(tmp_path / "r.json", doc))
+        assert lanes["adaptive_batch16_mfu"] == 0.000965
+        assert lanes["composite_roundtrip_p50_us"] == 432.1
+
+    def test_rename_alias_reads_old_baseline(self):
+        assert lane_value(BASE, "adaptive_batch16_pipeline_util") \
+            == 0.000965
+
+    def test_newest_baseline_in_repo(self):
+        import os
+        path = newest_baseline(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        assert path is not None and "BENCH_r" in path
+
+
+class TestCompare:
+    def test_direction_awareness(self):
+        fresh = {"composite_lstm_query_fps_median": 80.0,   # -20% BAD
+                 "composite_roundtrip_p50_us": 400.0,       # -20% good
+                 "adaptive_batch16_pipeline_util": 0.00097}
+        reg, ok, skipped = compare(fresh, BASE, 0.10, list(LANES))
+        assert [r[0] for r in reg] == ["composite_lstm_query_fps_median"]
+        assert {r[0] for r in ok} == {"composite_roundtrip_p50_us",
+                                      "adaptive_batch16_pipeline_util"}
+        assert all(r[3] is None for r in skipped)
+
+    def test_latency_increase_is_a_regression(self):
+        fresh = {"composite_roundtrip_p50_us": 600.0}       # +20% BAD
+        reg, _ok, _sk = compare(fresh, BASE, 0.10,
+                                ["composite_roundtrip_p50_us"])
+        assert len(reg) == 1
+
+    def test_within_threshold_passes(self):
+        fresh = {"composite_lstm_query_fps_median": 95.0}   # -5% ok
+        reg, ok, _sk = compare(fresh, BASE, 0.10,
+                               ["composite_lstm_query_fps_median"])
+        assert reg == [] and len(ok) == 1
+
+
+@pytest.mark.slow
+class TestMainSmoke:
+    def test_exit_codes(self, tmp_path, capsys):
+        base = _write(tmp_path / "BENCH_r98.json", BASE)
+        good = _write(tmp_path / "fresh_good.json",
+                      {**BASE, "composite_lstm_query_fps_median": 101.0})
+        bad = _write(tmp_path / "fresh_bad.json",
+                     {**BASE, "composite_lstm_query_fps_median": 50.0})
+        assert main([good, "--baseline", base]) == 0
+        assert "within threshold" in capsys.readouterr().out
+        assert main([bad, "--baseline", base]) == 1
+        assert "REGRESSED composite_lstm_query_fps_median" \
+            in capsys.readouterr().out
+
+    def test_missing_fresh_file_is_config_error(self, tmp_path):
+        base = _write(tmp_path / "BENCH_r98.json", BASE)
+        assert main([str(tmp_path / "nope.json"),
+                     "--baseline", base]) == 2
+
+    def test_lane_subset_flag(self, tmp_path):
+        base = _write(tmp_path / "BENCH_r98.json", BASE)
+        bad = _write(tmp_path / "fresh.json",
+                     {**BASE, "composite_lstm_query_fps_median": 50.0})
+        # the regressed lane excluded -> clean exit
+        assert main([bad, "--baseline", base,
+                     "--lanes", "composite_roundtrip_p50_us"]) == 0
