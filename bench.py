@@ -400,6 +400,82 @@ def _adaptive_bench(labels_path: str) -> dict:
         return {}
 
 
+def _multiplex_lane(flops, device) -> dict:
+    """N concurrent pipelines over ONE zoo bundle through one
+    sched.DeviceEngine: the single dispatch loop coalesces same-shape
+    head-of-line work across tenants into wide device batches, so the
+    chip stops idling between per-pipeline frames. The serial
+    utilization BENCH_r05 published (adaptive_batch16_pipeline_util =
+    0.000965 — chip idle 99.9%) is the baseline this lane must beat;
+    scripts/bench_compare.py aliases it for the cross-round delta."""
+    import traceback
+
+    try:
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.sched import DeviceEngine
+        from nnstreamer_tpu.utils import probes
+
+        n_pipes = int(os.environ.get("BENCH_SCHED_PIPES", "8"))
+        warm, frames = 8, 56
+        eng = DeviceEngine("bench", autostart=True,
+                           max_coalesce=max(n_pipes, 8))
+        builts = []
+        waits_ms = []
+        try:
+            for i in range(n_pipes):
+                p = Pipeline(scheduler=eng)
+                src = p.add_new("videotestsrc", width=SIZE, height=SIZE,
+                                num_buffers=warm + frames,
+                                pattern="random", seed=7 + i)
+                conv = p.add_new("tensor_converter")
+                filt = p.add_new("tensor_filter", framework="xla-tpu",
+                                 model=MODEL)
+                sink = p.add_new("tensor_sink")
+                arrivals = []
+                sink.new_data = (lambda buf, a=arrivals:
+                                 a.append(time.monotonic()))
+                Pipeline.link(src, conv, filt, sink)
+                builts.append((p, arrivals))
+            for p, _ in builts:
+                p.start()
+            for p, _ in builts:
+                if not p.wait_eos(600):
+                    raise TimeoutError("multiplex lane: EOS timeout")
+            # per-tenant submit->dispatch waits, read BEFORE stop()
+            # detaches the tenants
+            waits_ms = [t.wait_stats()["median_s"] * 1e3
+                        for t in eng.tenants() if t.wait_stats()["n"]]
+        finally:
+            for p, _ in builts:
+                p.stop()
+            cs = eng.coalesce_stats()
+            occ = eng.occupancy()
+            eng.stop()
+        merged = sorted(t for _, a in builts for t in a)
+        peak, med = _windowed_fps(merged, warm * n_pipes, 0,
+                                  window=8 * n_pipes)
+        if not np.isfinite(med):
+            return {}
+        row = {
+            "multiplex_n_pipelines": n_pipes,
+            "multiplex_fps": round(float(peak), 2),
+            "multiplex_fps_median": round(float(med), 2),
+            "multiplex_coalesce_width_median": round(cs["median"], 2),
+            "multiplex_occupancy": round(occ, 4),
+        }
+        if waits_ms:
+            row["multiplex_tenant_wait_median_ms"] = round(
+                float(np.median(waits_ms)), 3)
+        util = probes.pipeline_util(flops, med, device)
+        if util is not None:
+            row["multiplex_pipeline_util"] = round(util, 6)
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _batched_point(labels_path: str, batch: int, quant: str = "",
                    n_batches: int = 24, warm: int = 4) -> tuple:
     """(fps, fps_median) for frames-per-tensor serving at ``batch`` —
@@ -1601,6 +1677,9 @@ def main() -> None:
                 result.update(_serving_paged_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
+            if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":
+                _mark("multi-tenant multiplex lane starting")
+                result.update(_multiplex_lane(flops, device))
             if flops and result.get("adaptive_batch16_fps_median"):
                 # honest label: end-to-end pipeline rate × per-frame
                 # FLOPs over peak is *pipeline utilization* (the chip is

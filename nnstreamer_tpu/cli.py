@@ -24,7 +24,10 @@ tensor_query_client across N servers with failover and optional
 hedged dispatch — docs/resilience.md "Fleet routing & failover"),
 --kv-page-size/--kv-pages (serving: paged KV cache geometry for any
 LMEngine the pipeline constructs, exported via the NNS_LM_KV_* env —
-see docs/performance.md "Paged KV cache"). Setting the
+see docs/performance.md "Paged KV cache"),
+--sched[=WIDTH]/--sched-tenants (multi-tenant device scheduler: one
+dispatch loop per chip coalescing same-shape work across pipelines and
+serving engines, weighted-DRR fair — docs/scheduler.md). Setting the
 ``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
 harness for the run (docs/resilience.md "Chaos harness").
 """
@@ -40,25 +43,28 @@ import time
 #: flags taking an optional numeric value (nargs="?"): bare forms must
 #: not swallow a following pipeline positional, which argparse would
 #: otherwise consume before type conversion rejects it.
-_BARE_OK_FLAGS = ("--profile", "--watchdog")
+_BARE_OK_FLAGS = ("--profile", "--watchdog", "--sched")
 
 
 def _normalize_argv(argv):
-    """Move a bare ``--profile``/``--watchdog`` to the end of argv when
-    the next token is not its numeric value, so ``--profile '<pipeline>'``
-    parses the pipeline as the positional (argparse otherwise consumes it
-    for the flag and dies on ``invalid int value``). A trailing flag with
-    nothing after it takes its ``const`` default."""
+    """Move a bare ``--profile``/``--watchdog``/``--sched`` to the end
+    of argv when the token that would follow it at parse time is not
+    its numeric value, so ``--sched '<pipeline>'`` parses the pipeline
+    as the positional (argparse otherwise consumes it for the flag and
+    dies on ``invalid int value``). Scans right-to-left so CHAINED bare
+    flags compose: in ``--sched --profile <pipeline>`` deferring
+    ``--profile`` slides the pipeline next to ``--sched``, which must
+    then defer too. A trailing flag with nothing after it takes its
+    ``const`` default."""
     out, deferred = [], []
-    for i, tok in enumerate(argv):
-        if tok in _BARE_OK_FLAGS and i + 1 < len(argv) \
-                and not argv[i + 1].startswith("-"):
+    for tok in reversed(argv):
+        if tok in _BARE_OK_FLAGS and out and not out[0].startswith("-"):
             try:
-                float(argv[i + 1])
+                float(out[0])
             except ValueError:
                 deferred.append(tok)
                 continue
-        out.append(tok)
+        out.insert(0, tok)
     return out + deferred
 
 
@@ -140,6 +146,20 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pages", type=int, default=None, metavar="N",
                     help="KV page-pool size shared by all slots (sets "
                          "NNS_LM_KV_PAGES; needs --kv-page-size)")
+    ap.add_argument("--sched", type=int, nargs="?", const=8,
+                    default=None, metavar="WIDTH",
+                    help="route tensor_filter invokes through the "
+                         "multi-tenant device scheduler (sched."
+                         "DeviceEngine); WIDTH caps the coalesce "
+                         "width per device batch (default 8 when bare) "
+                         "— see docs/scheduler.md")
+    ap.add_argument("--sched-tenants", metavar="NAME:W[:PRIO][,...]",
+                    default=None,
+                    help="per-tenant admission presets for --sched: "
+                         "weight (relative share) and optional strict "
+                         "priority class per tenant name; names match "
+                         "the pipeline name and serving-engine labels "
+                         "(e.g. cam:2,lm:1:1)")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -186,6 +206,26 @@ def main(argv=None) -> int:
     if args.profile_dump is not None and args.profile is None:
         ap.error("--profile-dump needs --profile (no samples are "
                  "recorded without the profiler)")
+    if args.sched is not None and args.sched < 1:
+        ap.error("--sched must be >= 1 (max coalesce width)")
+    sched_presets = []
+    if args.sched_tenants is not None:
+        if args.sched is None:
+            ap.error("--sched-tenants needs --sched (presets configure "
+                     "the device scheduler)")
+        for spec in args.sched_tenants.split(","):
+            parts = spec.strip().split(":")
+            try:
+                if len(parts) not in (2, 3) or not parts[0]:
+                    raise ValueError
+                w = float(parts[1])
+                prio = int(parts[2]) if len(parts) == 3 else 0
+                if w <= 0:
+                    raise ValueError
+            except ValueError:
+                ap.error(f"--sched-tenants: bad spec {spec!r} "
+                         "(want name:weight[:priority], weight > 0)")
+            sched_presets.append((parts[0], w, prio))
     if args.kv_pages is not None and args.kv_page_size is None:
         ap.error("--kv-pages needs --kv-page-size (paging is off without "
                  "a page size)")
@@ -286,6 +326,17 @@ def main(argv=None) -> int:
         from .obs import profile
 
         profile.enable(max_records=args.profile)
+    sched_engine = None
+    if args.sched is not None:
+        # before p.start(): the install sets the pipeline scheduler
+        # hook, and start() is where a pipeline enrolls its filters
+        from . import sched
+
+        sched_engine = sched.install(max_coalesce=args.sched)
+        for name, w, prio in sched_presets:
+            sched_engine.preset(name, weight=w, priority=prio)
+        print(f"sched: {sched_engine.name} multiplexing "
+              f"(coalesce<={args.sched})", file=sys.stderr)
     if args.watchdog is not None or args.events_dump is not None:
         # same start-time rule: health components and the event bridge
         # only attach to what is built/started AFTER enable()
@@ -301,6 +352,10 @@ def main(argv=None) -> int:
         p.start()
     except Exception as e:  # noqa: BLE001
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
+        if sched_engine is not None:
+            from . import sched
+
+            sched.uninstall()
         if args.obs_push is not None or args.obs_aggregate:
             from .obs import fleet
 
@@ -328,6 +383,18 @@ def main(argv=None) -> int:
             return 2
     finally:
         p.stop()
+        if sched_engine is not None:
+            # AFTER p.stop(): chain threads must be gone before the
+            # dispatch loop dies, or a chain could block on a future
+            # nobody resolves until the join timeout
+            from . import sched
+
+            cs = sched_engine.coalesce_stats()
+            print(f"sched: {sched_engine.stats['batches']} batches / "
+                  f"{sched_engine.stats['items']} items, median width "
+                  f"{cs['median']:.1f}, occupancy "
+                  f"{sched_engine.occupancy():.3f}", file=sys.stderr)
+            sched.uninstall()
         if args.obs_push is not None or args.obs_aggregate:
             from .obs import fleet
 

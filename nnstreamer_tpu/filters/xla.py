@@ -55,7 +55,8 @@ log = logger("xla")
 #: stripped before model resolution so identical model specs memoize to one
 #: bundle (and thus one compile) regardless of filter-level settings
 _FILTER_ONLY_OPTS = frozenset(
-    {"sync", "precision", "donate", "bucket", "resize", "arch", "quant"})
+    {"sync", "precision", "donate", "bucket", "bucket_max", "resize",
+     "arch", "quant"})
 
 
 def _model_options(options: Dict[str, str]) -> Dict[str, str]:
@@ -221,6 +222,13 @@ class XLAFilter(FilterFramework):
         self._precision = opts.get("precision", "")
         self._donate = opts.get("donate", "false").lower() in ("1", "true", "yes")
         self._bucket = int(opts.get("bucket", "0") or 0)
+        # bounded bucket ladder: padded sizes are bucket, 2*bucket, ...
+        # up to bucket_max (default 8*bucket). A frame with more tensors
+        # than the cap is chunked into cap-sized invokes instead of
+        # compiling an ever-larger shape (see _invoke_bucketed).
+        bmax = int(opts.get("bucket_max", "0") or 0)
+        self._bucket_max = max(bmax, self._bucket) if bmax > 0 \
+            else self._bucket * 8
         # inputlayout/outputlayout=NCHW: the stream is channel-first while
         # XLA/zoo models are channel-last — the permutes compile INTO the
         # XLA program (free to fuse, never a host-side copy). Normalized
@@ -244,6 +252,14 @@ class XLAFilter(FilterFramework):
             self._bundle.out_info, self._out_layout)
         if self._in_info is not None and self._out_info is None:
             self._out_info = self._infer_out_info(self._in_info)
+        # cross-filter coalesce anchor (sched.DeviceEngine): two filter
+        # instances sharing one resolved bundle (the zoo memoizes equal
+        # specs) and identical result-affecting config compute the same
+        # function, so the scheduler may batch their work together
+        self.coalesce_token = (
+            "xla", id(self._bundle), str(self._device), self._precision,
+            self._donate, self._bucket, self._bucket_max, self._in_layout,
+            self._out_layout, self._resize)
         log.info("xla-tpu opened model=%s device=%s sync=%s",
                  self._bundle.name, self._device, self._sync)
 
@@ -470,13 +486,32 @@ class XLAFilter(FilterFramework):
     def _invoke_bucketed(self, inputs: Sequence[TensorMemory]) -> Sequence[TensorMemory]:
         """N tensors → one padded-batch invoke → one (N, ...) result per
         model output. jax.jit's shape-keyed cache makes each bucket size
-        compile exactly once; zero rows are masked off by slicing."""
+        compile exactly once; zero rows are masked off by slicing.
+
+        The ladder is BOUNDED: padded sizes stop at ``bucket_max``
+        (default 8*bucket). A frame with more tensors than the cap used
+        to silently compile a fresh, ever-larger shape; now it is
+        chunked into cap-sized invokes whose stacked outputs are
+        concatenated, and a ``sched.bucket_miss`` event records the
+        overflow. Hit/pad-waste counters ride ``nnstpu_sched_bucket_*``
+        (sched/telemetry.py) so pad waste is observable."""
         import jax
         import jax.numpy as jnp
+
+        from ..sched import telemetry as _sched_tel
 
         n = len(inputs)
         if n == 0:
             return []
+        cap = self._bucket_max
+        if n > cap:
+            _sched_tel.record_bucket_miss(
+                n, cap, label=self._bundle.name if self._bundle else "")
+            chunks = [self._invoke_bucketed(inputs[i:i + cap])
+                      for i in range(0, n, cap)]
+            return [TensorMemory(jnp.concatenate(
+                        [c[j].device(self._device) for c in chunks]))
+                    for j in range(len(chunks[0]))]
         if self._resize is not None:
             arrays = [self._resize_region(m) for m in inputs]
         else:
@@ -487,6 +522,7 @@ class XLAFilter(FilterFramework):
                 f"bucketed invoke needs same-shape tensors, got {shapes} "
                 "(add custom=\"resize=H:W\" for image regions)")
         bucket = -(-n // self._bucket) * self._bucket
+        _sched_tel.record_bucket_hit(bucket - n)
         if not hasattr(self, "_stack_fn"):
             # stack+pad inside one jit so the pad constant folds and the
             # whole prep is a single dispatch
@@ -506,6 +542,66 @@ class XLAFilter(FilterFramework):
             for o in outs:
                 o.block_until_ready()
         return [TensorMemory(o[:n]) for o in outs]
+
+    def invoke_coalesced(
+            self, groups: Sequence[Sequence[TensorMemory]]
+    ) -> List[Sequence[TensorMemory]]:
+        """Sched-engine coalesced dispatch: several tenants' work items
+        with identical input signatures execute as ONE device batch and
+        scatter back per item (sched/engine.py ``_dispatch``).
+
+        The DeviceEngine only coalesces items whose (shape, dtype)
+        signatures match exactly, so every group here is uniform: for
+        bucketed filters the groups flatten straight through
+        ``_invoke_bucketed``; for batch-led models each input position
+        concatenates along axis 0, giving at most ``max_coalesce``
+        distinct batch shapes (a bounded compile set). Raises when the
+        model's outputs are not batch-led — the engine then falls back
+        to serial invokes (``sched.coalesce_fallback``)."""
+        import jax.numpy as jnp
+
+        if len(groups) == 1:
+            return [self.invoke(groups[0])]
+        if self._bucket > 0:
+            counts = [len(g) for g in groups]
+            flat = [m for g in groups for m in g]
+            stacked = self._invoke_bucketed(flat)
+            results: List[Sequence[TensorMemory]] = []
+            off = 0
+            for cnt in counts:
+                results.append(
+                    [TensorMemory(o.device(self._device)[off:off + cnt])
+                     for o in stacked])
+                off += cnt
+            return results
+        npos = len(groups[0])
+        if any(len(g) != npos for g in groups):
+            raise ValueError("coalesce: input arity mismatch across items")
+        rows = [int(g[0].shape[0]) for g in groups]
+        total = sum(rows)
+        arrays = [jnp.concatenate([g[j].device(self._device)
+                                   for g in groups])
+                  for j in range(npos)]
+        with self._lock:
+            prof = _profile.DISPATCH_HOOK
+            if prof is not None:
+                outs = prof.dispatch(self, arrays)
+            else:
+                outs = self._jitted(*arrays)
+        if self._sync:
+            for o in outs:
+                o.block_until_ready()
+        scattered: List[List[TensorMemory]] = [[] for _ in groups]
+        for o in outs:
+            if getattr(o, "ndim", 0) == 0 or o.shape[0] != total:
+                raise ValueError(
+                    "coalesce: output not batch-led; cannot scatter "
+                    f"(shape {getattr(o, 'shape', ())}, rows {total})")
+            off = 0
+            for i, cnt in enumerate(rows):
+                scattered[i].append(TensorMemory(o[off:off + cnt]))
+                off += cnt
+        return scattered
 
     def _resize_region(self, mem: TensorMemory):
         """Bilinear-resize a variable-size region to the static target with a

@@ -158,6 +158,11 @@ class Element:
         self.pipeline: Optional[Any] = None
         self.started = False
         self._quitting = False  # set by Pipeline.stop's pre-pass
+        #: scheduler executor (sched.DeviceEngine attach): None on the
+        #: un-scheduled path — consumers gate on it, so the default hot
+        #: path pays one attribute None check (same contract as the
+        #: CHAOS/PROFILE chain hooks above)
+        self._sched_exec = None
         self._lock = threading.RLock()
         self._eos_pads: set = set()
         self._unknown_props = {}
@@ -257,6 +262,17 @@ class Element:
         promptly instead of stalling the source joins. Overrides should
         call super() and wake their condition variables."""
         self._quitting = True
+
+    # -- scheduler opt-in (sched/engine.py DeviceEngine.attach_pipeline) ---- #
+    def sched_enroll(self, engine: Any, tenant: Any) -> None:
+        """Offered to every element when its pipeline attaches to a
+        DeviceEngine. Base elements have no device work to route —
+        tensor_filter overrides to install ``self._sched_exec`` so its
+        invokes coalesce across tenants. Must be idempotent."""
+
+    def sched_detach(self) -> None:
+        """Inverse of ``sched_enroll``: back to direct dispatch."""
+        self._sched_exec = None
 
     # -- entry points (locking + dispatch) ----------------------------------- #
     def _chain_entry(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:

@@ -50,7 +50,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import events as _events
 from . import metrics as _metrics
@@ -59,7 +59,7 @@ from . import tracing as _tracing
 __all__ = [
     "Profiler", "profiler", "enabled", "enable", "disable",
     "perfetto_trace", "samples", "dump_samples", "report",
-    "DISPATCH_HOOK", "ENGINE_HOOK", "KERNEL_HOOK",
+    "DISPATCH_HOOK", "ENGINE_HOOK", "KERNEL_HOOK", "SCHED_HOOK",
 ]
 
 #: Hook consumed by filters/xla.py around ``self._jitted(*arrays)``.
@@ -75,6 +75,12 @@ ENGINE_HOOK: Optional["Profiler"] = None
 #: which Pallas kernels (label, shape, dtype) end up inside compiled
 #: programs — device-lane labels for fused dispatches.
 KERNEL_HOOK = None  # Optional[Callable[[str, Any, Any], None]]
+
+#: Hook consumed by sched/engine.py after each coalesced device batch:
+#: records per-batch dispatch intervals (engine lane, coalesce width,
+#: tenants served, queue depth) so the multiplexed dispatch stream gets
+#: its own Perfetto process group next to host/device/serving.
+SCHED_HOOK: Optional["Profiler"] = None
 
 #: default ring capacity / sync-probe cadence (every Nth dispatch pays
 #: a block_until_ready to measure device time)
@@ -439,6 +445,32 @@ class Profiler:
         if not compiled:  # first-use intervals are compile, not compute
             self._update_util(name, flops, bytes_, dur_ns / 1e9)
 
+    # -- scheduler batches (sched/engine.py SCHED_HOOK) ----------------- #
+    def record_sched(self, engine: str, label: str, t0_ns: int,
+                     t1_ns: int, *, width: int = 1,
+                     tenants: Optional[Sequence[str]] = None,
+                     queued: int = 0, inflight: int = 0) -> None:
+        """One coalesced device batch from a DeviceEngine dispatch loop:
+        the interval covers dispatch through result scatter (host view;
+        device time for the batch shows on the device lane's dispatch
+        record). ``width`` is the coalesce width, ``tenants`` the names
+        served, ``queued``/``inflight`` the post-batch engine state —
+        rendered as both a slice lane per work label and a counter
+        track, so dispatch-queue gaps and multiplexing density read
+        straight off the trace."""
+        self._append({
+            "kind": "sched", "label": f"{engine}.{label}",
+            "t0_ns": t0_ns, "dur_ns": max(int(t1_ns - t0_ns), 0),
+            "device_ns": None, "gap_ns": None,
+            "tid": threading.get_ident(),
+            "args": {"engine": engine, "width": int(width),
+                     "tenants": list(tenants or ()),
+                     "queued": int(queued), "inflight": int(inflight)},
+        })
+        if self._m is not None:
+            self._m["dispatch"].labels("sched", "host").observe(
+                max(t1_ns - t0_ns, 0) / 1e9)
+
     # -- kernel labels (ops/pallas) ------------------------------------- #
     def record_kernel(self, name: str, shape: Any, dtype: Any) -> None:
         """Trace-time Pallas kernel label: which kernels (with what
@@ -539,7 +571,7 @@ class Profiler:
 # Perfetto / Chrome trace_event export
 # --------------------------------------------------------------------------- #
 
-_PID_HOST, _PID_DEVICE, _PID_SERVING = 1, 2, 3
+_PID_HOST, _PID_DEVICE, _PID_SERVING, _PID_SCHED = 1, 2, 3, 4
 
 
 def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
@@ -557,6 +589,9 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
       * pid 3 **serving** — serving.* spans in one lane per phase
         (admission_wait / prefill / decode …) + a slot-occupancy
         counter track from engine records
+      * pid 4 **sched** — DeviceEngine coalesced-batch slices, one lane
+        per work label, plus a coalesce-width / queue-depth counter
+        track (multi-tenant multiplexing density at a glance)
 
     All timestamps share the process monotonic clock (µs)."""
     store = span_store if span_store is not None else _tracing.store()
@@ -570,11 +605,20 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
     meta(_PID_HOST, 0, "process_name", "host")
     meta(_PID_DEVICE, 0, "process_name", "device")
     meta(_PID_SERVING, 0, "process_name", "serving")
+    meta(_PID_SCHED, 0, "process_name", "sched")
 
     thread_names = {t.ident: t.name for t in threading.enumerate()}
     named_host: set = set()
     serving_rows: Dict[str, int] = {}
     device_rows: Dict[str, int] = {}
+    sched_rows: Dict[str, int] = {}
+
+    def sched_row(label: str) -> int:
+        row = sched_rows.get(label)
+        if row is None:
+            row = sched_rows[label] = len(sched_rows) + 1
+            meta(_PID_SCHED, row, "thread_name", label)
+        return row
 
     def serving_row(phase: str) -> int:
         row = serving_rows.get(phase)
@@ -635,6 +679,20 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
                 "ts": r["t0_ns"] / 1e3, "pid": _PID_DEVICE,
                 "tid": device_row(r["label"]), "args": r["args"],
             })
+        elif kind == "sched":
+            ev.append({
+                "name": r["label"], "cat": "sched", "ph": "X",
+                "ts": r["t0_ns"] / 1e3, "dur": r["dur_ns"] / 1e3,
+                "pid": _PID_SCHED, "tid": sched_row(r["label"]),
+                "args": r["args"],
+            })
+            ev.append({
+                "name": f"{r['args']['engine']}.coalesce", "ph": "C",
+                "ts": r["t0_ns"] / 1e3, "pid": _PID_SCHED, "tid": 0,
+                "args": {"width": r["args"]["width"],
+                         "queued": r["args"]["queued"],
+                         "inflight": r["args"]["inflight"]},
+            })
         elif kind == "occupancy":
             ev.append({
                 "name": f"{r['label']}.slots", "ph": "C",
@@ -685,7 +743,7 @@ def enable(max_records: Optional[int] = None,
     """Turn profiling on: register metric families and install every
     hook. ``max_records`` resizes the ring (``--profile=N``);
     ``sample_every`` sets the device-sync probe cadence."""
-    global DISPATCH_HOOK, ENGINE_HOOK, KERNEL_HOOK
+    global DISPATCH_HOOK, ENGINE_HOOK, KERNEL_HOOK, SCHED_HOOK
     p = _PROFILER
     if max_records is not None:
         p.resize(max_records)
@@ -696,6 +754,7 @@ def enable(max_records: Optional[int] = None,
     DISPATCH_HOOK = p
     ENGINE_HOOK = p
     KERNEL_HOOK = p.record_kernel
+    SCHED_HOOK = p
     try:
         from ..graph import element as _gel
         _gel.PROFILE_CHAIN_HOOK = p.profiled_chain
@@ -709,7 +768,7 @@ def enable(max_records: Optional[int] = None,
 def disable() -> None:
     """Turn profiling off and clear every hook — hot paths are back to
     one None check. Recorded data stays readable until reset()."""
-    global DISPATCH_HOOK, ENGINE_HOOK, KERNEL_HOOK
+    global DISPATCH_HOOK, ENGINE_HOOK, KERNEL_HOOK, SCHED_HOOK
     p = _PROFILER
     if p._enabled:
         _events.record("profile.capture_stop",
@@ -718,6 +777,7 @@ def disable() -> None:
     DISPATCH_HOOK = None
     ENGINE_HOOK = None
     KERNEL_HOOK = None
+    SCHED_HOOK = None
     try:
         from ..graph import element as _gel
         _gel.PROFILE_CHAIN_HOOK = None

@@ -202,7 +202,10 @@ def _tp_verify_fn(mesh: Mesh, axis: str, n_heads: int, max_len: int,
 
 class TPLMEngine(LMEngine):
     """Continuous-batching engine with the KV cache head-sharded over
-    ``mesh[axis]``. Same public API and outputs as `LMEngine`."""
+    ``mesh[axis]``. Same public API and outputs as `LMEngine` —
+    including ``enroll``/``unenroll`` sched.DeviceEngine tenancy, since
+    ``step_iteration`` is inherited (the tenant label defaults to the
+    overridden ``_engine_label`` "tp")."""
 
     #: serving metrics series carry engine="tp" so single-device and
     #: mesh-sharded engines are separable on one scrape endpoint

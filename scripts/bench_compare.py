@@ -54,11 +54,18 @@ LANES: Dict[str, int] = {
     "composite_roundtrip_p50_us": -1,
     "transformer_roofline_step_s_median": -1,
     "lm_serving_continuous_waste_frac": -1,
+    "multiplex_fps_median": +1,
+    "multiplex_pipeline_util": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines
 ALIASES: Dict[str, Tuple[str, ...]] = {
     "adaptive_batch16_pipeline_util": ("adaptive_batch16_mfu",),
+    # the multi-tenant scheduler lane supersedes the serial utilization
+    # number: older baselines carry only the 1-pipeline figure, and the
+    # whole point of sched.DeviceEngine is the delta against it
+    "multiplex_pipeline_util": ("adaptive_batch16_pipeline_util",
+                                "adaptive_batch16_mfu"),
 }
 
 _NUM_RE = re.compile(r'"([A-Za-z0-9_]+)":\s*(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)')
@@ -106,7 +113,12 @@ def compare(fresh: Dict[str, float], base: Dict[str, float],
     regressions, ok, skipped = [], [], []
     for name in lane_names:
         sign = LANES.get(name, +1)
-        b, f = lane_value(base, name), lane_value(fresh, name)
+        # aliases resolve the BASELINE side only: a fresh artifact may
+        # legitimately carry both a lane and the older lane it
+        # supersedes (multiplex_pipeline_util next to
+        # adaptive_batch16_pipeline_util) — the old value must never
+        # stand in for a missing new reading
+        b, f = lane_value(base, name), fresh.get(name)
         if b is None or f is None or b == 0:
             skipped.append((name, b, f, None))
             continue

@@ -85,7 +85,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router", "profile")
+          "router", "profile", "sched")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -104,9 +104,12 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
 #: "resilience"/"chaos" (fault-policy decisions + injected faults,
 #: nnstreamer_tpu/resilience/), "router" (multi-backend placement:
 #: failover/drain/spill audit trail, query/router.py), and "profile"
-#: (capture start/stop audit trail, obs/profile.py)
+#: (capture start/stop audit trail, obs/profile.py), and "sched" (the
+#: multi-tenant device scheduler: tenant lifecycle, bucket misses,
+#: starvation reliefs — nnstreamer_tpu/sched/)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
-                "fleet", "resilience", "chaos", "router", "profile")
+                "fleet", "resilience", "chaos", "router", "profile",
+                "sched")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -130,6 +133,14 @@ ROUTER_FILE = ("query", "router.py")
 PROFILE_LAYER = "profile"
 PROFILE_FILE = ("obs", "profile.py")
 PROFILE_UNITS = frozenset({"ratio", "flops"})
+
+#: the ``sched`` metric/event layer is owned by the multi-tenant device
+#: scheduler package (sched/telemetry.py centralizes every
+#: registration; engine code and the xla bucket counters go through its
+#: helpers — see module doc); matched on the package dir like
+#: RESILIENCE_DIR
+SCHED_LAYER = "sched"
+SCHED_DIR = "sched"
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -304,6 +315,7 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_kv(root)
     problems += check_router(root)
     problems += check_profile(root)
+    problems += check_sched(root)
     return problems
 
 
@@ -448,6 +460,44 @@ def check_resilience(root: Path = SOURCE_ROOT):
                 f"{_where(path, lineno)}: {name!r} registered inside "
                 f"nnstreamer_tpu/{RESILIENCE_DIR}/ must use a layer in "
                 f"{sorted(RESILIENCE_LAYERS)}, not {layer!r}")
+    return problems
+
+
+def check_sched(root: Path = SOURCE_ROOT):
+    """Placement lint for the device-scheduler telemetry: every metric
+    and event in the ``sched`` layer is emitted from
+    nnstreamer_tpu/sched/ (sched/telemetry.py centralizes the
+    registrations; the xla bucket counters and engine events go through
+    its helper functions, never by minting sched.* names elsewhere),
+    and the sched package registers under no other layer. Mirrors
+    check_resilience."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_pkg = SCHED_DIR in path.parts
+        if layer == SCHED_LAYER and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{SCHED_LAYER!r} layer outside nnstreamer_tpu/"
+                f"{SCHED_DIR}/ — record through sched.telemetry "
+                f"helpers instead")
+        elif in_pkg and layer != SCHED_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/{SCHED_DIR}/ must use the "
+                f"{SCHED_LAYER!r} layer, not {layer!r}")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == SCHED_LAYER and SCHED_DIR not in path.parts:
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{SCHED_LAYER!r} layer outside nnstreamer_tpu/"
+                f"{SCHED_DIR}/")
     return problems
 
 

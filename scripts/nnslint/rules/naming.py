@@ -96,10 +96,10 @@ class EventNameRule(_NamingRule):
 @register_rule
 class PlacementRule(_NamingRule):
     id = "naming/placement"
-    description = ("resilience/chaos, kv_*, and router telemetry are "
-                   "registered in their owning packages")
+    description = ("resilience/chaos, kv_*, router, and sched telemetry "
+                   "are registered in their owning packages")
     checks = (_compat.check_resilience, _compat.check_kv,
-              _compat.check_router)
+              _compat.check_router, _compat.check_sched)
 
 
 @register_rule

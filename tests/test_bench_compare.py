@@ -78,6 +78,28 @@ class TestCompare:
                                ["composite_lstm_query_fps_median"])
         assert reg == [] and len(ok) == 1
 
+    def test_multiplex_lane_baselines_on_serial_util(self):
+        # the scheduler lane reads the pre-sched serial utilization
+        # (0.000965, even under its oldest "_mfu" name) as its baseline;
+        # the ISSUE-11 acceptance bar is >= 20x over it at N=8
+        fresh = {"multiplex_pipeline_util": 0.0200,
+                 "adaptive_batch16_pipeline_util": 0.00097}
+        reg, ok, _sk = compare(fresh, BASE, 0.10,
+                               ["multiplex_pipeline_util"])
+        assert reg == []
+        (name, b, f, delta), = ok
+        assert (name, b, f) == ("multiplex_pipeline_util", 0.000965, 0.02)
+        assert delta > 19.0
+
+    def test_alias_never_fakes_a_missing_fresh_reading(self):
+        # fresh artifact carries the OLD lane but not the new one: the
+        # new lane must be SKIPPED, not silently fed the old value
+        fresh = {"adaptive_batch16_pipeline_util": 0.00097}
+        reg, ok, sk = compare(fresh, BASE, 0.10,
+                              ["multiplex_pipeline_util"])
+        assert reg == [] and ok == []
+        assert [s[0] for s in sk] == ["multiplex_pipeline_util"]
+
 
 @pytest.mark.slow
 class TestMainSmoke:

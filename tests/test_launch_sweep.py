@@ -247,6 +247,90 @@ def test_backends_flag_wires_router_with_fallback_last_resort():
     assert rc == 0
 
 
+SCHED_FILTER_PIPELINE = (
+    f"videotestsrc num-buffers=4 width=32 height=32 ! tensor_converter ! "
+    f'tensor_filter framework=xla-tpu model="{MODEL}" ! tensor_sink')
+
+
+def _no_scheduler_leaked():
+    from nnstreamer_tpu import sched
+
+    return sched.installed() is None
+
+
+def test_sched_bare_flag_keeps_pipeline_positional(capsys):
+    # bare --sched (nargs="?") directly before the positional: the
+    # normalizer must not let argparse eat the pipeline as WIDTH
+    rc = cli_main(["--sched", SCHED_FILTER_PIPELINE, "--timeout", "120"])
+    assert rc == 0
+    assert "multiplexing" in capsys.readouterr().err
+    assert _no_scheduler_leaked()
+
+
+def test_sched_chained_bare_flags_before_positional():
+    # regression: two bare optional-value flags back to back — deferring
+    # --profile must not slide the pipeline into --sched's value slot
+    from nnstreamer_tpu.obs import profile, tracing
+    try:
+        rc = cli_main(["--sched", "--profile", SCHED_FILTER_PIPELINE,
+                       "--timeout", "120"])
+    finally:
+        # cli_main enables these process-wide (a real launch exits);
+        # in-process they must not instrument later tests' pipelines
+        profile.disable()
+        tracing.disable()
+    assert rc == 0
+    assert _no_scheduler_leaked()
+
+
+def test_sched_composes_with_trace_and_explicit_width():
+    from nnstreamer_tpu.obs import tracing
+    try:
+        rc = cli_main(["--sched", "4", "--trace", SCHED_FILTER_PIPELINE,
+                       "--timeout", "120"])
+    finally:
+        tracing.disable()
+    assert rc == 0
+    assert _no_scheduler_leaked()
+
+
+def test_sched_composes_with_deadline_and_fallback():
+    # --deadline-ms needs a tensor_query_client; dead default backend +
+    # passthrough fallback completes, with every invoke sched-routed
+    rc = cli_main(["--sched", "--deadline-ms", "200",
+                   "--fallback", "passthrough", "--timeout", "60",
+                   "videotestsrc num-buffers=2 width=8 height=8 ! "
+                   "tensor_converter ! "
+                   "tensor_query_client max-request-retry=1 timeout-s=0.3 "
+                   "retry-base-s=0.001 retry-max-s=0.002 "
+                   "breaker-threshold=1 ! tensor_sink"])
+    assert rc == 0
+    assert _no_scheduler_leaked()
+
+
+def test_sched_tenant_presets_accepted():
+    rc = cli_main(["--sched", "8", "--sched-tenants", "pipe:4:1,lm:1",
+                   SCHED_FILTER_PIPELINE, "--timeout", "120"])
+    assert rc == 0
+    assert _no_scheduler_leaked()
+
+
+@pytest.mark.parametrize("argv", [
+    ["--sched", "0"],                          # width must be >= 1
+    ["--sched-tenants", "cam:4"],              # presets need --sched
+    ["--sched", "--sched-tenants", "cam"],     # missing weight
+    ["--sched", "--sched-tenants", "cam:0"],   # weight must be > 0
+    ["--sched", "--sched-tenants", "cam:x"],   # weight must be numeric
+], ids=["zero-width", "tenants-alone", "no-weight", "zero-weight",
+        "bad-weight"])
+def test_sched_flag_validation_rejected(argv):
+    with pytest.raises(SystemExit) as ei:
+        cli_main(argv + ["videotestsrc num-buffers=1 ! tensor_converter "
+                         "! tensor_sink"])
+    assert ei.value.code == 2
+    assert _no_scheduler_leaked()
+
+
 def test_list_models_includes_zoo_families():
     import io
     from contextlib import redirect_stdout

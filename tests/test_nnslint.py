@@ -446,6 +446,93 @@ class TestNamingRules:
 
 
 # --------------------------------------------------------------------------- #
+# sched placement (naming/placement via naming_compat.check_sched)
+# --------------------------------------------------------------------------- #
+
+class TestSchedPlacement:
+    """check_sched ownership: sched-layer telemetry lives in
+    nnstreamer_tpu/sched/ and the sched package mints no other layer."""
+
+    @staticmethod
+    def _tree(tmp_path, files):
+        for rel, code in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(code))
+        return tmp_path
+
+    def test_sched_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_sched_stray_total", "h", ())
+            """})
+        problems = naming_compat.check_sched(root)
+        assert len(problems) == 1
+        assert "sched.telemetry" in problems[0]
+
+    def test_foreign_layer_inside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"sched/telemetry.py": """
+            def setup(reg):
+                reg.counter("nnstpu_pipeline_oops_total", "h", ())
+            """})
+        problems = naming_compat.check_sched(root)
+        assert len(problems) == 1
+        assert "must use the 'sched' layer" in problems[0]
+
+    def test_sched_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"filters/stray.py": """
+            def warn(events):
+                events.record("sched.bucket_miss", "w", msg="x")
+            """})
+        problems = naming_compat.check_sched(root)
+        assert len(problems) == 1
+        assert "sched.bucket_miss" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "sched/telemetry.py": """
+                def setup(reg, events):
+                    reg.counter("nnstpu_sched_batches_total", "h", ())
+                    reg.gauge("nnstpu_sched_queue_depth", "h", ("tenant",))
+                    events.record("sched.tenant_register", "info", msg="t")
+                """,
+            "serving/own.py": """
+                def setup(reg):
+                    reg.counter("nnstpu_serving_steps_total", "h", ())
+                """,
+        })
+        assert naming_compat.check_sched(root) == []
+
+    def test_sched_hook_globals_are_gate_checked(self, tmp_path):
+        # the integration hooks the scheduler rides (SCHED_PIPELINE_HOOK
+        # in graph/pipeline.py, SCHED_HOOK in obs/profile.py) match the
+        # *_HOOK convention, so contracts/hook-gate covers their callers
+        res = lint_snippet(tmp_path, """
+            SCHED_PIPELINE_HOOK = None
+            SCHED_HOOK = None
+
+            def bad(p):
+                SCHED_PIPELINE_HOOK(p)
+
+            def good(p):
+                hook = SCHED_HOOK
+                if SCHED_PIPELINE_HOOK is not None:
+                    SCHED_PIPELINE_HOOK(p)
+            """, ["contracts/hook-gate"])
+        assert len(res.findings) == 1
+        assert "SCHED_PIPELINE_HOOK" in res.findings[0].message or \
+            "SCHED_PIPELINE_HOOK" in res.findings[0].anchor
+
+
+# --------------------------------------------------------------------------- #
 # suppressions
 # --------------------------------------------------------------------------- #
 

@@ -257,10 +257,10 @@ class TestPerfettoTrace:
         text = json.dumps(doc)
         assert json.loads(text)["displayTimeUnit"] == "ms"
         assert doc["otherData"]["profile_enabled"] is False
-        # process metadata for all three lanes is always present
+        # process metadata for all four lanes is always present
         names = {e["args"]["name"] for e in doc["traceEvents"]
                  if e["ph"] == "M" and e["name"] == "process_name"}
-        assert names == {"host", "device", "serving"}
+        assert names == {"host", "device", "serving", "sched"}
 
     def test_composite_pipeline_all_three_lane_groups(
             self, prof, global_metrics, global_tracing):

@@ -1,0 +1,514 @@
+"""nnstreamer_tpu.sched — multi-tenant device dispatch engine.
+
+Covers the ISSUE-11 acceptance pins: weighted-DRR fairness and the hard
+starvation bound (fake clock, no sleeps), coalesced outputs bit-identical
+to direct invokes, per-tenant deadline shedding riding resilience
+accounting, the zero-overhead-when-off contract on the graph hot path,
+the bounded bucket ladder in filters/xla.py, and the 8-concurrent-
+pipelines E2E whose outputs must match serial runs exactly.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import sched
+from nnstreamer_tpu.core.buffer import TensorMemory
+from nnstreamer_tpu.graph import Pipeline
+from nnstreamer_tpu.sched import SHED, DeviceEngine
+
+
+class FakeClock:
+    """Injectable monotonic-seconds source (no sleeping in fairness
+    tests)."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class TagFilter:
+    """Minimal filter double: distinct instances never coalesce with
+    each other (the coalesce key includes id(filt))."""
+
+    def __init__(self, name="f", log=None):
+        self.name = name
+        self.log = log if log is not None else []
+
+    def invoke(self, inputs):
+        self.log.append(self.name)
+        return [inputs[0].host() * 2]
+
+
+def _mem():
+    return TensorMemory(np.ones((2, 2), np.float32))
+
+
+@pytest.fixture
+def metrics_on():
+    """Counters are the registry's cheap no-op while collection is off;
+    these tests assert on values, so turn it on and restore after."""
+    from nnstreamer_tpu.obs import metrics
+
+    reg = metrics.registry()
+    was = reg.is_enabled
+    reg.enable()
+    yield reg
+    if not was:
+        reg.disable()
+
+
+# -- fairness ---------------------------------------------------------------- #
+
+def test_drr_service_tracks_weights():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock, max_coalesce=1)
+    a = eng.register("a", weight=3.0)
+    b = eng.register("b", weight=1.0)
+    fa, fb = TagFilter("a"), TagFilter("b")
+    for _ in range(40):
+        a.submit(fa, [_mem()])
+        b.submit(fb, [_mem()])
+    for _ in range(40):
+        assert eng.step()
+    total = a.stats["completed"] + b.stats["completed"]
+    assert total == 40
+    # weight 3:1 → a gets ~30 of the first 40 services
+    assert 26 <= a.stats["completed"] <= 34
+
+
+def test_equal_weights_alternate():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock, max_coalesce=1)
+    a = eng.register("a")
+    b = eng.register("b")
+    order = []
+    fa, fb = TagFilter("a", order), TagFilter("b", order)
+    for _ in range(6):
+        a.submit(fa, [_mem()])
+        b.submit(fb, [_mem()])
+    for _ in range(12):
+        eng.step()
+    # round-robin cursor: neither tenant serves 3+ in a row
+    for i in range(len(order) - 2):
+        assert len(set(order[i:i + 3])) > 1
+    assert order.count("a") == order.count("b") == 6
+
+
+def test_priority_class_served_first():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock, max_coalesce=1)
+    low = eng.register("low", priority=0)
+    high = eng.register("high", priority=1)
+    order = []
+    fl, fh = TagFilter("low", order), TagFilter("high", order)
+    for _ in range(3):
+        low.submit(fl, [_mem()])
+        high.submit(fh, [_mem()])
+    for _ in range(6):
+        eng.step()
+    # inside the starvation bound, the higher class drains completely
+    # before the lower one sees the device
+    assert order == ["high"] * 3 + ["low"] * 3
+
+
+def test_starvation_bound_forces_service():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock,
+                       max_coalesce=1, starve_ms=100.0)
+    low = eng.register("low", priority=0)
+    high = eng.register("high", priority=1)
+    order = []
+    fl, fh = TagFilter("low", order), TagFilter("high", order)
+    low.submit(fl, [_mem()])
+    for _ in range(8):
+        high.submit(fh, [_mem()])
+    for _ in range(3):
+        eng.step()
+    assert order == ["high"] * 3  # low bypassed while inside the bound
+    clock.advance(0.15)  # past starve_ms
+    eng.step()
+    assert order[-1] == "low"
+    assert eng.stats["starvation_reliefs"] >= 1
+    assert low.stats["completed"] == 1
+
+
+def test_starved_tenant_wait_never_exceeds_bound_plus_service():
+    """The acceptance pin: with continuous competing load, no tenant's
+    dispatch wait exceeds the fairness bound by more than one service
+    round."""
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock,
+                       max_coalesce=1, starve_ms=50.0)
+    heavy = eng.register("heavy", weight=100.0)
+    meek = eng.register("meek", weight=0.01)
+    fh, fm = TagFilter("heavy"), TagFilter("meek")
+    for _ in range(200):
+        heavy.submit(fh, [_mem()])
+    meek.submit(fm, [_mem()])
+    while meek.stats["completed"] == 0:
+        eng.step()
+        clock.advance(0.01)  # 10ms per service round
+    # bound: starve_ms plus one relief round-robin lap (|tenants| = 2)
+    assert meek.waits[-1] <= 0.05 + 2 * 0.01 + 1e-6
+
+
+# -- coalescing --------------------------------------------------------------- #
+
+class CoalesceFilter:
+    """Counts invocation modes; invoke_coalesced mirrors XLAFilter's
+    contract (per-group output lists, order-aligned)."""
+
+    def __init__(self):
+        self.serial = 0
+        self.coalesced = 0
+
+    def invoke(self, inputs):
+        self.serial += 1
+        return [inputs[0].host() + 1]
+
+    def invoke_coalesced(self, groups):
+        self.coalesced += 1
+        return [[g[0].host() + 1] for g in groups]
+
+
+def test_same_key_heads_coalesce_across_tenants():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock, max_coalesce=8)
+    filt = CoalesceFilter()
+    futs = [eng.register(f"t{i}").submit(filt, [_mem()]) for i in range(4)]
+    assert eng.step()
+    assert filt.coalesced == 1 and filt.serial == 0
+    for f in futs:
+        np.testing.assert_array_equal(
+            np.asarray(f.result(1.0)[0]), np.full((2, 2), 2, np.float32))
+    assert eng.coalesce_stats()["max"] == 4
+
+
+def test_coalesce_failure_falls_back_to_serial():
+    class Broken(CoalesceFilter):
+        def invoke_coalesced(self, groups):
+            raise RuntimeError("not coalescible after all")
+
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock)
+    filt = Broken()
+    futs = [eng.register(f"t{i}").submit(filt, [_mem()]) for i in range(3)]
+    eng.step()
+    assert eng.stats["coalesce_fallbacks"] == 1
+    assert filt.serial == 3
+    for f in futs:
+        assert f.result(1.0)[0].shape == (2, 2)
+
+
+def test_xla_coalesced_bit_identical_to_direct_invoke():
+    """invoke_coalesced concatenates groups into ONE dispatch; every
+    scattered row must equal the direct per-item invoke exactly."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def model(x):
+        return jnp.tanh(x @ w)
+
+    f = XLAFilter()
+    f.open(FilterProps(model=model))
+    items = [[TensorMemory(rng.normal(size=(4, 16)).astype(np.float32))]
+             for _ in range(5)]
+    direct = [np.asarray(f.invoke(g)[0].host()) for g in items]
+    together = f.invoke_coalesced(items)
+    assert len(together) == len(items)
+    for got, want in zip(together, direct):
+        np.testing.assert_array_equal(np.asarray(got[0].host()), want)
+
+
+def test_xla_coalesced_bucketed_bit_identical():
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    f = XLAFilter()
+    f.open(FilterProps(model=lambda x: jnp.asarray(x) * 3.0,
+                       custom="bucket=4"))
+    rng = np.random.default_rng(3)
+    groups = [[TensorMemory(rng.normal(size=(2, 2)).astype(np.float32))
+               for _ in range(k)] for k in (1, 3, 2)]
+    direct = [np.asarray(f.invoke(g)[0].host()) for g in groups]
+    together = f.invoke_coalesced(groups)
+    for got, want in zip(together, direct):
+        np.testing.assert_array_equal(np.asarray(got[0].host()), want)
+
+
+# -- bounded bucket ladder (filters/xla.py bugfix) --------------------------- #
+
+def test_bucket_ladder_capped_and_chunked(metrics_on):
+    """More tensors than bucket_max used to compile a fresh unbounded
+    shape; now the invoke chunks at the cap and stays correct."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.sched import telemetry as tel
+
+    f = XLAFilter()
+    f.open(FilterProps(model=lambda x: jnp.asarray(x) + 1.0,
+                       custom="bucket=2,bucket_max=4"))
+    assert f._bucket_max == 4
+    before = tel.BUCKET_TOTAL.labels("miss")._value
+    inputs = [TensorMemory(np.full((3,), i, np.float32))
+              for i in range(11)]  # 11 > cap of 4 → 3 chunks
+    outs = f.invoke(inputs)
+    got = np.asarray(outs[0].host())
+    assert got.shape == (11, 3)
+    np.testing.assert_array_equal(
+        got, np.stack([np.full((3,), i + 1.0, np.float32)
+                       for i in range(11)]))
+    assert tel.BUCKET_TOTAL.labels("miss")._value == before + 1
+
+
+def test_bucket_default_cap_is_8x():
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    f = XLAFilter()
+    f.open(FilterProps(model=lambda x: jnp.asarray(x),
+                       custom="bucket=4"))
+    assert f._bucket_max == 32
+
+
+# -- deadlines ---------------------------------------------------------------- #
+
+class StubDeadline:
+    def __init__(self, expired=False):
+        self._expired = expired
+
+    def expired(self):
+        return self._expired
+
+
+def test_expired_at_submit_sheds_immediately():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock)
+    t = eng.register("a")
+    fut = t.submit(TagFilter(), [_mem()], deadline=StubDeadline(True))
+    assert fut.result(0.1) is SHED
+    assert t.stats["shed"] == 1 and eng.stats["shed"] == 1
+    assert t.pending() == 0
+
+
+def test_expired_in_queue_sheds_before_dispatch():
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock)
+    t = eng.register("a")
+    filt = TagFilter()
+    dead = StubDeadline(False)
+    fut = t.submit(filt, [_mem()], deadline=dead)
+    dead._expired = True  # expires while queued
+    assert eng.step() is False  # shed, nothing dispatched
+    assert fut.result(0.1) is SHED
+    assert filt.log == []
+    assert t.stats["shed"] == 1
+
+
+def test_tenant_default_deadline_applies():
+    eng = DeviceEngine("t", autostart=False)
+    t = eng.register("a", deadline_ms=0.0)  # everything is already late
+    fut = t.submit(TagFilter(), [_mem()])
+    assert fut.result(0.1) is SHED
+
+
+def test_shed_rides_resilience_accounting(metrics_on):
+    eng = DeviceEngine("t", autostart=False)
+    t = eng.register("a")
+    fam = metrics_on.counter(
+        "nnstpu_resilience_shed_total",
+        "work shed by deadline/overload policies", ("site",))
+    before = fam.labels("sched")._value
+    t.submit(TagFilter(), [_mem()], deadline=StubDeadline(True))
+    assert fam.labels("sched")._value == before + 1
+
+
+# -- tenant lifecycle --------------------------------------------------------- #
+
+def test_duplicate_tenant_name_rejected():
+    eng = DeviceEngine("t", autostart=False)
+    eng.register("a")
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.register("a")
+
+
+def test_deregister_resolves_leftovers_to_shed():
+    eng = DeviceEngine("t", autostart=False)
+    t = eng.register("a")
+    fut = t.submit(TagFilter(), [_mem()])
+    eng.deregister(t)
+    assert fut.result(0.1) is SHED
+    assert eng.tenants() == []
+
+
+def test_preset_overrides_registration():
+    eng = DeviceEngine("t", autostart=False)
+    eng.preset("cam", weight=4.0, priority=2)
+    t = eng.register("cam", weight=1.0)
+    assert t.weight == 4.0 and t.priority == 2
+    # suffixed pipeline tenants inherit the base-name preset
+    t2 = eng.register("cam#1")
+    assert t2.weight == 4.0
+
+
+def test_opaque_call_runs_under_fair_share():
+    eng = DeviceEngine("t", autostart=True)
+    try:
+        t = eng.register("srv")
+        assert t.call(lambda: 41 + 1) == 42
+        assert t.stats["completed"] == 1
+    finally:
+        eng.stop()
+
+
+def test_dispatch_error_propagates_to_future():
+    class Boom:
+        def invoke(self, inputs):
+            raise RuntimeError("device on fire")
+
+    eng = DeviceEngine("t", autostart=False)
+    t = eng.register("a")
+    fut = t.submit(Boom(), [_mem()])
+    eng.step()
+    with pytest.raises(RuntimeError, match="device on fire"):
+        fut.result(0.1)
+    assert t.stats["errors"] == 1
+
+
+# -- zero-overhead-when-off contract ------------------------------------------ #
+
+def test_no_scheduler_means_no_hook_and_no_wrapper():
+    from nnstreamer_tpu.graph import pipeline as gp
+
+    assert gp.SCHED_PIPELINE_HOOK is None
+    assert sched.installed() is None
+    p = Pipeline()
+    src = p.add_new("videotestsrc", width=32, height=32, num_buffers=2)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", model=lambda x: x.mean(axis=(1, 2, 3)))
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, sink)
+    p.run(timeout=120)
+    # the chain never grew a scheduler wrapper: the gate attribute
+    # stayed None the whole run and no engine ever existed
+    assert all(el._sched_exec is None for el in p.elements.values())
+    assert p._sched_engine is None
+    assert sink.num_buffers == 2
+
+
+def test_install_uninstall_default_engine():
+    from nnstreamer_tpu.graph import pipeline as gp
+
+    eng = sched.install("dflt", max_coalesce=4)
+    try:
+        assert sched.installed() is eng
+        assert sched.install() is eng  # idempotent
+        assert gp.SCHED_PIPELINE_HOOK is not None
+        p = Pipeline("hookpipe")
+        src = p.add_new("videotestsrc", width=32, height=32, num_buffers=2)
+        conv = p.add_new("tensor_converter")
+        filt = p.add_new("tensor_filter",
+                         model=lambda x: x.mean(axis=(1, 2, 3)))
+        sink = p.add_new("tensor_sink", store=True)
+        Pipeline.link(src, conv, filt, sink)
+        p.run(timeout=120)
+        assert sink.num_buffers == 2
+        assert eng.stats["items"] >= 2  # invokes went through the engine
+        assert filt._sched_exec is None  # stop() detached
+    finally:
+        sched.uninstall()
+    assert sched.installed() is None
+    assert gp.SCHED_PIPELINE_HOOK is None
+
+
+# -- E2E: 8 concurrent pipelines, one engine ---------------------------------- #
+
+def _build(model, n, scheduler=None, buffers=4):
+    p = Pipeline(f"pipe{n}", scheduler=scheduler)
+    src = p.add_new("videotestsrc", width=32, height=32,
+                    num_buffers=buffers, pattern="random", seed=100 + n)
+    conv = p.add_new("tensor_converter")
+    filt = p.add_new("tensor_filter", framework="xla-tpu", model=model)
+    sink = p.add_new("tensor_sink", store=True)
+    Pipeline.link(src, conv, filt, sink)
+    return p, sink
+
+
+def _outputs(sink):
+    return [np.asarray(b.memories[0].host()) for b in sink.buffers]
+
+
+def test_eight_pipelines_multiplex_identical_to_serial():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(3, 7)).astype(np.float32))
+
+    def model(x):
+        return jnp.tanh(jnp.asarray(x, jnp.float32) @ w)
+
+    serial = []
+    for i in range(8):
+        p, sink = _build(model, i)
+        p.run(timeout=120)
+        serial.append(_outputs(sink))
+
+    eng = DeviceEngine("e2e", autostart=True, max_coalesce=8)
+    try:
+        built = [_build(model, i, scheduler=eng) for i in range(8)]
+        for p, _ in built:
+            p.start()
+        for p, _ in built:
+            assert p.wait_eos(120)
+        for p, _ in built:
+            p.stop()
+        assert len(eng.tenants()) == 0  # every stop() detached cleanly
+        assert eng.stats["items"] == 8 * 4
+        for i, (_, sink) in enumerate(built):
+            got = _outputs(sink)
+            assert len(got) == len(serial[i]) == 4
+            for a, b in zip(got, serial[i]):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        eng.stop()
+
+
+def test_coalesce_key_shared_across_xla_filter_instances():
+    # the zoo memoizes equal specs, so two filters over one spec publish
+    # the same coalesce_token — N pipelines share device batches; any
+    # result-affecting config difference splits the key again
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+    from nnstreamer_tpu.sched.engine import _coalesce_key
+
+    spec = ("zoo://mobilenet_v2?width=0.25&size=32&num_classes=16"
+            "&dtype=float32")
+    mem = TensorMemory(np.zeros((1, 32, 32, 3), np.float32))
+    a, b, c = XLAFilter(), XLAFilter(), XLAFilter()
+    a.open(FilterProps(model=spec))
+    b.open(FilterProps(model=spec))
+    c.open(FilterProps(model=spec, custom="precision=bf16"))
+    try:
+        assert _coalesce_key(a, [mem]) == _coalesce_key(b, [mem])
+        assert _coalesce_key(c, [mem]) != _coalesce_key(a, [mem])
+        other = TensorMemory(np.zeros((2, 32, 32, 3), np.float32))
+        assert _coalesce_key(a, [other]) != _coalesce_key(a, [mem])
+    finally:
+        for f in (a, b, c):
+            f.close()
