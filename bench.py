@@ -476,6 +476,83 @@ def _multiplex_lane(flops, device) -> dict:
         return {}
 
 
+def _multiplex_goodput_lane(device) -> dict:
+    """Per-tenant goodput under an 8-tenant mix with one deadline-tight
+    tenant: every tenant pushes the same device matmul through one
+    sched.DeviceEngine while obs.slo attributes each batch, then the
+    lane reports deadline-met work as a fraction of all work — overall
+    and for the tight tenant alone. This is the *useful*-throughput
+    counterpart to _multiplex_lane's occupancy story: a scheduler change
+    that lifts coalesce width by starving the deadline tenant shows up
+    here, not there."""
+    import traceback
+
+    try:
+        import jax
+        import jax.numpy as jnp
+        from nnstreamer_tpu.obs import slo as _slo
+        from nnstreamer_tpu.sched import DeviceEngine
+
+        n_tenants = int(os.environ.get("BENCH_SLO_TENANTS", "8"))
+        rounds = 24
+        dim = 256
+
+        @jax.jit
+        def _mm(x):
+            return x @ x
+
+        class _Filt:
+            name = "goodput"
+
+            def invoke(self, inputs):
+                return [np.asarray(_mm(inputs[0]))]
+
+        x = jnp.ones((dim, dim), jnp.float32)
+        np.asarray(_mm(x))  # compile outside the measurement
+        filt = _Filt()
+        was_on = _slo.enabled()
+        if not was_on:
+            _slo.enable()
+        eng = DeviceEngine("bench-slo", autostart=True,
+                           max_coalesce=max(n_tenants, 8))
+        try:
+            tight_name = "tight0"
+            tenants = [eng.register(tight_name, weight=1.0,
+                                    deadline_ms=25.0)]
+            tenants += [eng.register(f"bulk{i}", weight=1.0)
+                        for i in range(1, n_tenants)]
+            for _ in range(rounds):
+                futs = [t.submit(filt, [x]) for t in tenants]
+                for f in futs:
+                    f.result(timeout=60)
+            snap = _slo.snapshot()
+        finally:
+            eng.stop()
+            if not was_on:
+                _slo.disable()
+        met = missed = shed = t_met = t_all = 0
+        for name, row in snap["tenants"].items():
+            out = row["outcomes"]
+            met += out["met"]
+            missed += out["missed"]
+            shed += out["shed"]
+            if name == tight_name:
+                t_met = out["met"]
+                t_all = out["met"] + out["missed"] + out["shed"]
+        total = met + missed + shed
+        if not total or not t_all:
+            return {}
+        row = {
+            "multiplex_goodput_ratio": round(met / total, 4),
+            "multiplex_goodput_tight_ratio": round(t_met / t_all, 4),
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _batched_point(labels_path: str, batch: int, quant: str = "",
                    n_batches: int = 24, warm: int = 4) -> tuple:
     """(fps, fps_median) for frames-per-tensor serving at ``batch`` —
@@ -1680,6 +1757,9 @@ def main() -> None:
             if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":
                 _mark("multi-tenant multiplex lane starting")
                 result.update(_multiplex_lane(flops, device))
+            if os.environ.get("BENCH_SCHED_GOODPUT", "1") != "0":
+                _mark("multi-tenant goodput lane starting")
+                result.update(_multiplex_goodput_lane(device))
             if flops and result.get("adaptive_batch16_fps_median"):
                 # honest label: end-to-end pipeline rate × per-frame
                 # FLOPs over peak is *pipeline utilization* (the chip is

@@ -27,7 +27,10 @@ LMEngine the pipeline constructs, exported via the NNS_LM_KV_* env —
 see docs/performance.md "Paged KV cache"),
 --sched[=WIDTH]/--sched-tenants (multi-tenant device scheduler: one
 dispatch loop per chip coalescing same-shape work across pipelines and
-serving engines, weighted-DRR fair — docs/scheduler.md). Setting the
+serving engines, weighted-DRR fair — docs/scheduler.md),
+--slo TENANT:p99=MS:goodput=R (per-tenant SLO objectives: cost
+attribution, goodput accounting, and burn-rate alerting via obs.slo —
+docs/observability.md "SLO & tenant accounting"). Setting the
 ``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
 harness for the run (docs/resilience.md "Chaos harness").
 """
@@ -160,6 +163,18 @@ def main(argv=None) -> int:
                          "priority class per tenant name; names match "
                          "the pipeline name and serving-engine labels "
                          "(e.g. cam:2,lm:1:1)")
+    ap.add_argument("--slo", metavar="TENANT:p99=MS:goodput=R[,...]",
+                    default=None,
+                    help="enable per-tenant SLO accounting (obs.slo) "
+                         "and declare objectives: p99 latency in ms "
+                         "and/or goodput ratio in (0,1) per tenant "
+                         "(e.g. cam:p99=50:goodput=0.99,lm:goodput=0.9)"
+                         "; burn-rate breaches flip the tenant's "
+                         "slo:<name> component DEGRADED in /healthz, "
+                         "show at /debug/slo on --metrics-port, and "
+                         "the per-tenant report prints at exit — "
+                         "docs/observability.md 'SLO & tenant "
+                         "accounting'")
     ap.add_argument("--list-elements", action="store_true")
     ap.add_argument("--list-models", action="store_true",
                     help="zoo model names usable as model=zoo://<name>")
@@ -226,6 +241,14 @@ def main(argv=None) -> int:
                 ap.error(f"--sched-tenants: bad spec {spec!r} "
                          "(want name:weight[:priority], weight > 0)")
             sched_presets.append((parts[0], w, prio))
+    slo_objectives = None
+    if args.slo is not None:
+        from .obs import slo as _slo_mod
+
+        try:
+            slo_objectives = _slo_mod.parse_slo_spec(args.slo)
+        except ValueError as e:
+            ap.error(f"--slo: {e}")
     if args.kv_pages is not None and args.kv_page_size is None:
         ap.error("--kv-pages needs --kv-page-size (paging is off without "
                  "a page size)")
@@ -347,6 +370,18 @@ def main(argv=None) -> int:
             from .obs import health
 
             health.enable(stall_after_s=float(args.watchdog))
+    if slo_objectives is not None:
+        # after health.enable(): set_objective registers one
+        # slo:<tenant> component per objective, and hooks install
+        # process-wide before p.start() so attribution covers warmup
+        from .obs import slo as _slo_mod
+
+        _slo_mod.enable()
+        for tenant, obj in slo_objectives.items():
+            _slo_mod.set_objective(tenant, **obj)
+        print(f"slo: tracking {len(slo_objectives)} objective "
+              f"tenant(s): {', '.join(sorted(slo_objectives))}",
+              file=sys.stderr)
     t0 = time.monotonic()
     try:
         p.start()
@@ -414,6 +449,11 @@ def main(argv=None) -> int:
                 n = profile.dump_samples(args.profile_dump)
                 print(f"profile: {n} cost samples -> "
                       f"{args.profile_dump}", file=sys.stderr)
+        if slo_objectives is not None:
+            from .obs import slo as _slo_mod
+
+            print(_slo_mod.report(), file=sys.stderr)
+            _slo_mod.disable()
         if args.events_dump is not None:
             from .obs import events
 

@@ -19,7 +19,9 @@ query wire or plain HTTP, and one aggregator re-exposes the merged
 fleet on its exporter. The profiler (obs/profile.py) adds device-time
 attribution: per-dispatch host/device timing, jit-cache and compile
 telemetry, live MFU/roofline gauges, and a Perfetto timeline at
-``/debug/profile``.
+``/debug/profile``. The SLO layer (obs/slo.py) adds per-tenant cost
+attribution, goodput accounting, and multi-window burn-rate alerting
+surfaced at ``/debug/slo``.
 """
 
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry, disable,
@@ -30,6 +32,7 @@ from . import events
 from . import fleet
 from . import health
 from . import profile
+from . import slo
 from . import tracing
 from .events import EventRing
 from .fleet import FleetAggregator, FleetPusher
@@ -43,6 +46,6 @@ __all__ = [
     "MetricsRegistry", "MetricsExporter", "Profiler", "Span",
     "SpanContext", "SpanStore", "Status", "disable", "enable",
     "enabled", "events", "fleet", "health", "instrument_pipeline",
-    "perfetto_trace", "profile", "registry", "start_exporter",
+    "perfetto_trace", "profile", "registry", "slo", "start_exporter",
     "start_span", "tracing",
 ]

@@ -25,6 +25,12 @@ health and debug surfaces:
   * ``GET /debug/profile``           — Chrome trace_event / Perfetto
     JSON timeline (obs/profile.py): host lanes per pipeline thread,
     device lanes per dispatch label, serving lanes + occupancy counter
+  * ``GET /debug/profile/samples``   — the profiler's aggregated cost
+    samples (the ``dump_samples()`` JSON shape), so a fleet collector
+    gathers autotuner training data without exit files
+  * ``GET /debug/slo``               — per-tenant cost attribution,
+    goodput, objectives and burn rates (obs/slo.py); includes the
+    fleet rollup when this process aggregates
   * ``POST /fleet/push``             — snapshot-push ingestion for
     workers without a query wire; 503 unless aggregating
 
@@ -65,6 +71,7 @@ from . import fleet as _fleet
 from . import health as _health
 from . import metrics as _metrics
 from . import profile as _profile
+from . import slo as _slo
 from . import tracing as _tracing
 
 __all__ = ["MetricsExporter", "start_exporter"]
@@ -209,6 +216,24 @@ class MetricsExporter:
                 self._json(200, _profile.perfetto_trace(
                     span_store=_tracing.store()))
 
+            def _get_profile_samples(self, query):
+                # same shape as dump_samples() writes to disk, so a
+                # fleet aggregator collects autotuner training data
+                # over HTTP instead of via --profile-dump exit files
+                self._json(200, {
+                    "version": 1,
+                    "profile_enabled": _profile.enabled(),
+                    "samples": _profile.samples(),
+                })
+
+            def _get_slo(self, query):
+                snap = _slo.snapshot()
+                agg = _fleet.aggregator()
+                if agg is not None:
+                    snap = {**snap, "fleet": agg.slo_rollup(
+                        snap if snap.get("enabled") else None)}
+                self._json(200, snap)
+
             def _post_fleet_push(self, query):
                 body = self._read_body()
                 if body is None:
@@ -237,6 +262,8 @@ class MetricsExporter:
                 ("GET", "/debug/events"): _get_events,
                 ("GET", "/debug/fleet"): _get_fleet,
                 ("GET", "/debug/profile"): _get_profile,
+                ("GET", "/debug/profile/samples"): _get_profile_samples,
+                ("GET", "/debug/slo"): _get_slo,
                 ("POST", "/fleet/push"): _post_fleet_push,
             }
             _PREFIX_ROUTES = ((("GET", "/debug/traces/"), _get_trace),)

@@ -60,6 +60,7 @@ from urllib.parse import urlsplit
 from . import events as _events
 from . import health as _health
 from . import metrics as _metrics
+from . import slo as _slo
 from . import tracing as _tracing
 from .metrics import _escape_help, _escape_label, _fmt
 
@@ -132,6 +133,9 @@ def build_push(instance: str, role: str, seq: int,
         "health": hreg.snapshot(),
         "ready": {"ready": ready, "conditions": conds},
         "spans": store.drain_export(max_spans),
+        # None while the SLO layer is off — a worker without per-tenant
+        # accounting pushes the same doc it always did
+        "slo": _slo.push_data(),
     }
 
 
@@ -291,7 +295,7 @@ class _Instance:
     """Latest state pushed by one worker process."""
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
-                 "metrics", "health", "ready", "via", "pushes",
+                 "metrics", "health", "ready", "slo", "via", "pushes",
                  "spans_ingested", "first_mono", "last_mono")
 
     def __init__(self, instance: str):
@@ -303,6 +307,7 @@ class _Instance:
         self.metrics: Dict[str, Any] = {}
         self.health: Dict[str, Any] = {}
         self.ready: Dict[str, Any] = {"ready": False, "conditions": {}}
+        self.slo: Optional[Dict[str, Any]] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -415,6 +420,7 @@ class FleetAggregator:
         metrics = doc.get("metrics")
         health = doc.get("health")
         ready = doc.get("ready")
+        slo_doc = doc.get("slo")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -433,6 +439,8 @@ class FleetAggregator:
                 rec.health = health
             if isinstance(ready, dict):
                 rec.ready = ready
+            if isinstance(slo_doc, dict):
+                rec.slo = slo_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -606,6 +614,34 @@ class FleetAggregator:
             "components": components,
             "fleet": {"instances": len(recs)},
         }
+
+    def slo_rollup(self, local: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+        """Fleet-wide SLO view for ``/debug/slo``: each live instance's
+        pushed per-tenant snapshot (plus this process's own when given),
+        and the tenants breaching their burn budget anywhere in the
+        fleet — the page an operator reads before asking which worker
+        to drain."""
+        self._expire_now()
+        with self._lock:
+            recs = list(self._instances.values())
+        instances: Dict[str, Any] = {}
+        breached: set = set()
+
+        def scan(iid: str, snap: Optional[Dict[str, Any]]) -> None:
+            if not isinstance(snap, dict) or not snap.get("enabled"):
+                return
+            instances[iid] = snap
+            for tenant, row in (snap.get("tenants") or {}).items():
+                burn = row.get("burn") if isinstance(row, dict) else None
+                if isinstance(burn, dict) and burn.get("breached"):
+                    breached.add(tenant)
+
+        if local is not None:
+            scan(self.instance, local)
+        for rec in recs:
+            scan(rec.instance, rec.slo)
+        return {"instances": instances, "breached": sorted(breached)}
 
     def ready_rollup(self, local_ready: bool,
                      local_conds: Dict[str, bool]

@@ -18,7 +18,7 @@ A probe returning None retires its component (weakref-backed probes:
 the registry never pins a dead pipeline or engine).
 
 **The watchdog** is one daemon thread (started lazily on first
-registration while enabled — never when off) applying four rules each
+registration while enabled — never when off) applying these rules each
 tick and recording its verdicts as flight-recorder events
 (obs/events.py):
 
@@ -34,7 +34,12 @@ tick and recording its verdicts as flight-recorder events
     DEGRADED (``query.reconnect_storm``);
   * *admission stall*: a serving engine probe reporting a queued
     request waiting past ``admission_deadline_s`` → STALLED
-    (``serving.admission_stall``).
+    (``serving.admission_stall``);
+  * *starvation storm*: a sched engine whose starvation-relief count
+    rises by ``starvation_storm`` within ``starvation_window_s`` →
+    DEGRADED (``sched.starvation_storm``);
+  * *SLO burn*: an obs/slo.py tenant whose burn rate breaches its
+    error budget on both windows → DEGRADED (``slo.burn_alert``).
 
 Recovery flips the verdict back to OK and records the matching
 ``<layer>.recover`` event, so flapping is visible.
@@ -209,6 +214,8 @@ class HealthRegistry:
         self.reconnect_storm = 5
         self.reconnect_window_s = 10.0
         self.admission_deadline_s = 30.0
+        self.starvation_storm = 3
+        self.starvation_window_s = 10.0
         self.interval_s: Optional[float] = None  # None = stall_after/4
 
     # -- enable/disable ------------------------------------------------ #
@@ -381,6 +388,10 @@ class HealthRegistry:
                 self._check_serving(c, st, data or {})
             elif c.kind == "fleet":
                 self._check_fleet(c, st, data or {})
+            elif c.kind == "sched":
+                self._check_sched(c, st, data or {}, now_ns)
+            elif c.kind == "slo":
+                self._check_slo(c, st, data or {})
 
     # rule: per-element last-buffer heartbeat → STALLED
     def _check_element(self, c: Component, st: Dict[str, Any],
@@ -487,6 +498,66 @@ class HealthRegistry:
             _events.record("fleet.recover",
                            f"{c.name}: pushes resumed", **c.attrs)
 
+    # rule: scheduler starvation storm → DEGRADED
+    # (sched/engine.py registers one kind="sched" component per engine;
+    # the probe reports its monotonically increasing relief count —
+    # same windowed-delta shape as the reconnect-storm rule)
+    def _check_sched(self, c: Component, st: Dict[str, Any],
+                     data: Dict[str, Any], now_ns: int) -> None:
+        reliefs = int(data.get("starvation_reliefs") or 0)
+        if "win_start" not in st:
+            st["win_start"], st["win_reliefs"] = now_ns, reliefs
+            return
+        if (now_ns - st["win_start"]) / 1e9 \
+                < float(self.starvation_window_s):
+            return
+        delta = reliefs - st["win_reliefs"]
+        # sched.* event literals live in the sched layer; import lazily
+        # (no cycle: sched imports obs at module load, not vice versa)
+        from ..sched import telemetry as _sched_tel
+        if delta >= int(self.starvation_storm):
+            if not st.get("storm"):
+                st["storm"] = True
+                if c.status < Status.DEGRADED:
+                    c.set_status(
+                        Status.DEGRADED,
+                        f"{delta} starvation reliefs in "
+                        f"{self.starvation_window_s:.0f}s")
+                _sched_tel.event_starvation_storm(
+                    c.name, delta, float(self.starvation_window_s),
+                    **c.attrs)
+        elif st.pop("storm", None):
+            if c.status == Status.DEGRADED:
+                c.set_status(Status.OK, "starvation reliefs settled")
+            _sched_tel.event_starvation_recover(c.name, **c.attrs)
+        st["win_start"], st["win_reliefs"] = now_ns, reliefs
+
+    # rule: SLO burn-rate breach → DEGRADED
+    # (obs/slo.py registers one kind="slo" component per objective
+    # tenant; the probe is the registry's evaluate(), so the verdict
+    # here is pure threshold bookkeeping)
+    def _check_slo(self, c: Component, st: Dict[str, Any],
+                   data: Dict[str, Any]) -> None:
+        breached = bool(data.get("breached"))
+        # slo.* event literals live in obs/slo.py; import lazily (slo
+        # imports this module at load time, so top-level would cycle)
+        from . import slo as _slo
+        if breached:
+            if not st.get("burn"):
+                st["burn"] = True
+                if c.status < Status.DEGRADED:
+                    worst = data.get("worst_burn")
+                    c.set_status(
+                        Status.DEGRADED,
+                        "SLO burn %.2fx budget (%s)"
+                        % (worst if worst is not None else 0.0,
+                           data.get("worst_objective")))
+                _slo.event_burn_alert(c.name, data)
+        elif st.pop("burn", None):
+            if c.status == Status.DEGRADED:
+                c.set_status(Status.OK, "burn back under budget")
+            _slo.event_burn_recover(c.name, data)
+
     # rule: serving request stuck in admission → STALLED
     def _check_serving(self, c: Component, st: Dict[str, Any],
                        data: Dict[str, Any]) -> None:
@@ -528,7 +599,8 @@ def enabled() -> bool:
 def enable(**thresholds: Any) -> None:
     """Turn the health model on (``stall_after_s=``, ``queue_dwell_s=``,
     ``reconnect_storm=``, ``reconnect_window_s=``,
-    ``admission_deadline_s=``, ``interval_s=`` thresholds accepted).
+    ``admission_deadline_s=``, ``starvation_storm=``,
+    ``starvation_window_s=``, ``interval_s=`` thresholds accepted).
     Like metrics/tracing: call BEFORE building pipelines/engines — the
     integration points register components at construction/start
     time."""

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import events as _events
 from . import metrics as _metrics
+from . import slo as _slo
 from . import tracing as _tracing
 
 __all__ = [
@@ -571,7 +572,7 @@ class Profiler:
 # Perfetto / Chrome trace_event export
 # --------------------------------------------------------------------------- #
 
-_PID_HOST, _PID_DEVICE, _PID_SERVING, _PID_SCHED = 1, 2, 3, 4
+_PID_HOST, _PID_DEVICE, _PID_SERVING, _PID_SCHED, _PID_SLO = 1, 2, 3, 4, 5
 
 
 def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
@@ -592,6 +593,9 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
       * pid 4 **sched** — DeviceEngine coalesced-batch slices, one lane
         per work label, plus a coalesce-width / queue-depth counter
         track (multi-tenant multiplexing density at a glance)
+      * pid 5 **slo** — one cumulative goodput counter track per tenant
+        (met/missed/shed) from obs/slo.py, present when the SLO layer
+        is recording
 
     All timestamps share the process monotonic clock (µs)."""
     store = span_store if span_store is not None else _tracing.store()
@@ -712,12 +716,24 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
                 "pid": _PID_HOST, "tid": tid, "args": r["args"],
             })
 
+    slo_points = _slo.trace_points()
+    if slo_points:
+        meta(_PID_SLO, 0, "process_name", "slo")
+        for pt in slo_points:
+            ev.append({
+                "name": f"{pt['tenant']}.goodput", "ph": "C",
+                "ts": pt["t_ns"] / 1e3, "pid": _PID_SLO, "tid": 0,
+                "args": {"met": pt["met"], "missed": pt["missed"],
+                         "shed": pt["shed"]},
+            })
+
     return {
         "traceEvents": ev,
         "displayTimeUnit": "ms",
         "otherData": {
             "profile_enabled": p.is_enabled,
             "tracing_enabled": store.is_enabled,
+            "slo_enabled": _slo.enabled(),
             **p.stats(),
         },
     }

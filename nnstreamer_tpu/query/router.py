@@ -62,6 +62,7 @@ from ..core.log import logger
 from ..obs import events as _events
 from ..obs import fleet as _fleet
 from ..obs import metrics as _obs
+from ..obs import slo as _slo
 from ..obs import tracing as _tracing
 from ..resilience import policy as _rp
 from .protocol import (
@@ -667,6 +668,10 @@ class QueryRouter:
                     rtt = time.monotonic() - t0
                     self._observe_latency(rtt)
                     _RTT.labels(self.name).observe(rtt)
+                    rhook = _slo.ROUTER_SLO_HOOK
+                    if rhook is not None:
+                        rhook.record_dispatch(
+                            session, len(payload), len(rpayload))
                     span.set_attribute("backend", be.endpoint)
                     self.backends.reap_drained()
                     return rmeta, rpayload

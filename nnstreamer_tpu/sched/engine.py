@@ -51,7 +51,9 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..core.log import logger
 from ..graph.element import join_or_warn
+from ..obs import health as _health
 from ..obs import profile as _profile
+from ..obs import slo as _slo
 from ..resilience import policy as _rp
 from . import telemetry as _tel
 
@@ -116,6 +118,19 @@ class _Work:
         self.t_enq = t_enq
         self.deadline = deadline
         self.label = label
+
+
+def _work_rows(w: "_Work") -> int:
+    """Row weight for per-tenant busy-time attribution: the leading dim
+    of the first input tensor; opaque callables count as one row."""
+    if w.inputs:
+        try:
+            shape = w.inputs[0].shape
+            if shape:
+                return max(int(shape[0]), 1)
+        except Exception:
+            pass
+    return 1
 
 
 def _coalesce_key(filt: Any, inputs: Sequence[Any]) -> Tuple:
@@ -230,7 +245,20 @@ class DeviceEngine:
         #: bounded per-batch coalesce widths for median reporting
         self.widths: Deque[int] = collections.deque(maxlen=4096)
         self._busy_s = 0.0
+        self._wait_s = 0.0
         self._t_started = None  # wall anchor for occupancy()
+        eref = weakref.ref(self)
+
+        def _probe() -> Optional[Dict[str, Any]]:
+            eng = eref()
+            if eng is None:
+                return None  # engine collected — retire the component
+            return {"starvation_reliefs": eng.stats["starvation_reliefs"],
+                    "batches": eng.stats["batches"],
+                    "shed": eng.stats["shed"]}
+
+        _health.component(f"sched:{name}", kind="sched", probe=_probe,
+                          attrs={"engine": name})
         #: operator-set per-name admission overrides (nns-launch
         #: --sched-tenants): applied IN PLACE OF register() arguments,
         #: so deployment config beats programmatic defaults
@@ -360,6 +388,11 @@ class DeviceEngine:
         _rp.record_shed(
             "sched", f"{work.tenant.name}: {work.label} shed ({why})",
             tenant=work.tenant.name, label=work.label)
+        shook = _slo.SCHED_SLO_HOOK
+        if shook is not None:
+            shook.record_shed(
+                work.tenant.name, "sched",
+                wait_s=max(self.clock() - work.t_enq, 0.0))
         work.future.set_result(SHED)
 
     # -- fair draining ------------------------------------------------------ #
@@ -474,6 +507,7 @@ class DeviceEngine:
         for w in batch:
             wait = max(now - w.t_enq, 0.0)
             w.tenant.waits.append(wait)
+            self._wait_s += wait
             _tel.record_wait(w.tenant.name, wait)
         t0 = time.monotonic_ns()
         try:
@@ -522,6 +556,12 @@ class DeviceEngine:
                 tenants=sorted({w.tenant.name for w in batch}),
                 queued=sum(len(t.queue) for t in self.tenants()),
                 inflight=len(self._inflight_q))
+        shook = _slo.SCHED_SLO_HOOK
+        if shook is not None:
+            shook.record_sched_batch(
+                self.name, busy,
+                [(w.tenant.name, max(now - w.t_enq, 0.0), _work_rows(w),
+                  w.deadline) for w in batch])
 
     def _dispatch(self, batch: List[_Work]) -> List[Any]:
         """One device dispatch for the whole batch; returns per-item
@@ -605,6 +645,17 @@ class DeviceEngine:
             return {"median": 0.0, "mean": 0.0, "max": 0, "n": 0}
         return {"median": float(w[len(w) // 2]),
                 "mean": sum(w) / len(w), "max": w[-1], "n": len(w)}
+
+    @property
+    def busy_seconds(self) -> float:
+        """Total device dispatch+sync time — the attribution total the
+        SLO conservation test sums per-tenant device_seconds against."""
+        return self._busy_s
+
+    @property
+    def wait_seconds(self) -> float:
+        """Total submit→dispatch queue wait across all executed work."""
+        return self._wait_s
 
     def occupancy(self) -> float:
         """Fraction of wall time since start() spent in device

@@ -127,6 +127,29 @@ def event_starvation_relief(tenant_name: str, wait_s: float,
         wait_ms=wait_s * 1e3, bound_ms=bound_s * 1e3)
 
 
+def event_starvation_storm(component: str, reliefs: int, window_s: float,
+                           **attrs: Any) -> None:
+    """The health watchdog saw repeated starvation reliefs inside one
+    window: fairness is being rescued too often, which means the DRR
+    weights/priorities are mis-sized for the offered load. Called
+    lazily from obs/health's sched rule so the ``sched.*`` literal
+    stays in this layer."""
+    _events.record(
+        "sched.starvation_storm",
+        f"{component}: {reliefs} starvation reliefs within "
+        f"{window_s:.0f}s — fairness degraded",
+        severity="warning", component=component, reliefs=reliefs,
+        window_s=window_s, **attrs)
+
+
+def event_starvation_recover(component: str, **attrs: Any) -> None:
+    """The starvation storm subsided; the sched component returns OK."""
+    _events.record(
+        "sched.recover",
+        f"{component}: starvation storm subsided",
+        component=component, **attrs)
+
+
 def event_tenant_register(tenant_name: str, **attrs: Any) -> None:
     _events.record("sched.tenant_register",
                    f"tenant {tenant_name!r} registered",

@@ -67,6 +67,7 @@ from ..obs import events as _events
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import profile as _profile
+from ..obs import slo as _slo
 from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
 from ..resilience import policy as _rp
@@ -608,6 +609,12 @@ class LMEngine:
         self._queue.append(req)
         return rid
 
+    def _slo_tenant(self) -> str:
+        """Tenant name for per-tenant SLO attribution: the sched tenant
+        when enrolled on a DeviceEngine, else the engine label."""
+        t = self._sched_tenant
+        return t.name if t is not None else self._engine_label
+
     def _shed_request(self, req: "_Request", why: str) -> None:
         """Deadline load shedding: finish the request EMPTY right now —
         spending prefill + decode on a result whose deadline has passed
@@ -617,6 +624,11 @@ class LMEngine:
         _rp.record_shed(
             "serving", f"{self._engine_label}: rid {req.rid} shed ({why})",
             engine=self._engine_label, rid=req.rid)
+        shook = _slo.ENGINE_SLO_HOOK
+        if shook is not None:
+            shook.record_shed(
+                self._slo_tenant(), "serving",
+                wait_s=max(time.monotonic() - req.t_submit, 0.0))
         if req.wait_span is not None:
             req.wait_span.end()
         if req.span is not None:
@@ -760,7 +772,8 @@ class LMEngine:
                     "serving.prefill", parent=req.span.context,
                     attrs={"bucket": tb, "slot": slot})
             tp0 = time.monotonic_ns() \
-                if _profile.ENGINE_HOOK is not None else 0
+                if (_profile.ENGINE_HOOK is not None
+                    or _slo.ENGINE_SLO_HOOK is not None) else 0
             if self._kv is None:
                 first = self._prefill_into(
                     slot, padded, t, skey, temp, tk, tp)
@@ -796,6 +809,11 @@ class LMEngine:
                     self, "prefill", tp0, time.monotonic_ns(),
                     tokens=t, steps=1, compiled=first_use,
                     bucket=blabel, slot=slot)
+            shook = _slo.ENGINE_SLO_HOOK
+            if shook is not None:
+                shook.record_engine_phase(
+                    self._slo_tenant(), "prefill",
+                    (time.monotonic_ns() - tp0) / 1e9)
             if req.span is not None:
                 req.decode_span = _tracing.start_span(
                     "serving.decode", parent=req.span.context,
@@ -951,6 +969,10 @@ class LMEngine:
                 self, "decode", int(t0 * 1e9), time.monotonic_ns(),
                 tokens=n * len(active), steps=n, active=len(active),
                 queued=len(self._queue), slots=self.n_slots)
+        shook = _slo.ENGINE_SLO_HOOK
+        if shook is not None:
+            shook.record_engine_phase(
+                self._slo_tenant(), "decode", time.monotonic() - t0)
         for s in range(self.n_slots):
             self._pos_host[s] += n  # device pos advances for EVERY slot
         self.stats["decode_steps"] += n
@@ -1035,6 +1057,10 @@ class LMEngine:
                 tokens=int(np.sum(m[active])) if active else 0, steps=1,
                 active=len(active), queued=len(self._queue),
                 slots=self.n_slots, draft=g)
+        shook = _slo.ENGINE_SLO_HOOK
+        if shook is not None:
+            shook.record_engine_phase(
+                self._slo_tenant(), "verify", time.monotonic() - t0)
         for s in range(self.n_slots):
             # unlike chunks, per-slot advance is data-dependent — the
             # mirror updates from the fetched acceptance counts
@@ -1099,6 +1125,13 @@ class LMEngine:
             self.stats["tokens_out"] += len(req.out)
             self._m_streams.labels(self._engine_label, "completed").inc()
             self._m_tokens.inc(len(req.out))
+            shook = _slo.ENGINE_SLO_HOOK
+            if shook is not None:
+                missed = (req.deadline is not None
+                          and req.deadline.expired())
+                shook.record_outcome(
+                    self._slo_tenant(), "missed" if missed else "met",
+                    max(time.monotonic() - req.t_submit, 0.0))
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None
             if self._kv is not None and req.kv_lease is not None:

@@ -56,6 +56,12 @@ LANES: Dict[str, int] = {
     "lm_serving_continuous_waste_frac": -1,
     "multiplex_fps_median": +1,
     "multiplex_pipeline_util": +1,
+    # per-tenant goodput under the 8-tenant mix (obs.slo accounting):
+    # deadline-met work as a fraction of all work, overall and for the
+    # deadline-tight tenant — a scheduler "win" that starves the tight
+    # tenant regresses here even when occupancy improves
+    "multiplex_goodput_ratio": +1,
+    "multiplex_goodput_tight_ratio": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

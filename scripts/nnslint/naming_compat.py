@@ -54,6 +54,18 @@ layer (an efficiency ratio elsewhere should be a profile gauge, not a
 convention fork). check_profile enforces both directions, mirroring
 check_resilience.
 
+SLO placement (docs/observability.md "SLO & tenant accounting"): the
+``slo`` metric + event layer belongs to nnstreamer_tpu/obs/slo.py —
+per-tenant cost attribution, goodput counters, and burn-rate gauges
+are registered there only (dispatch sites feed the accountant through
+its hooks, never by minting slo.* names), and the ``tenant`` label is
+reserved to obs/slo.py and nnstreamer_tpu/sched/ (everywhere else a
+tenant-keyed series is an unbounded-cardinality bug — route it through
+the SLO registry, which folds overflow tenants). The ``ratio`` gauge
+unit reservation is shared with the profile layer
+(``nnstpu_slo_burn_ratio``). check_slo enforces all three directions,
+mirroring check_profile.
+
 Router placement (docs/resilience.md "Fleet routing & failover"): the
 ``router`` metric/span/event layer belongs to
 nnstreamer_tpu/query/router.py — the multi-backend dispatch telemetry
@@ -85,7 +97,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router", "profile", "sched")
+          "router", "profile", "sched", "slo")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -106,10 +118,11 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
 #: failover/drain/spill audit trail, query/router.py), and "profile"
 #: (capture start/stop audit trail, obs/profile.py), and "sched" (the
 #: multi-tenant device scheduler: tenant lifecycle, bucket misses,
-#: starvation reliefs — nnstreamer_tpu/sched/)
+#: starvation reliefs — nnstreamer_tpu/sched/), and "slo" (per-tenant
+#: SLO burn alerts/recoveries — obs/slo.py)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
                 "fleet", "resilience", "chaos", "router", "profile",
-                "sched")
+                "sched", "slo")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -133,6 +146,15 @@ ROUTER_FILE = ("query", "router.py")
 PROFILE_LAYER = "profile"
 PROFILE_FILE = ("obs", "profile.py")
 PROFILE_UNITS = frozenset({"ratio", "flops"})
+
+#: the ``slo`` metric/event layer is owned by the per-tenant SLO
+#: accountant alone (see module doc); matched like PROFILE_FILE. The
+#: ``tenant`` label is bounded there (overflow folding) and in the
+#: scheduler's registered-tenant series — anywhere else it is an
+#: unbounded-cardinality drift
+SLO_LAYER = "slo"
+SLO_FILE = ("obs", "slo.py")
+TENANT_LABEL = "tenant"
 
 #: the ``sched`` metric/event layer is owned by the multi-tenant device
 #: scheduler package (sched/telemetry.py centralizes every
@@ -316,6 +338,7 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_router(root)
     problems += check_profile(root)
     problems += check_sched(root)
+    problems += check_slo(root)
     return problems
 
 
@@ -349,11 +372,15 @@ def check_profile(root: Path = SOURCE_ROOT):
                 f"{_where(path, lineno)}: {name!r} registered inside "
                 f"nnstreamer_tpu/obs/profile.py must use the "
                 f"{PROFILE_LAYER!r} layer, not {layer!r}")
-        elif m.group("unit") in PROFILE_UNITS and layer != PROFILE_LAYER:
+        elif m.group("unit") in PROFILE_UNITS \
+                and layer not in (PROFILE_LAYER, SLO_LAYER):
+            # the slo layer shares the dimensionless ``ratio`` unit
+            # (burn rate is budget-normalized); check_slo pins those
+            # registrations to obs/slo.py
             problems.append(
                 f"{_where(path, lineno)}: {name!r} uses the "
                 f"{m.group('unit')!r} gauge unit reserved for the "
-                f"{PROFILE_LAYER!r} layer")
+                f"{PROFILE_LAYER!r}/{SLO_LAYER!r} layers")
     for path, lineno, name in iter_event_sites(root):
         m = _EVENT_NAME_RE.match(name)
         if m is None:
@@ -498,6 +525,58 @@ def check_sched(root: Path = SOURCE_ROOT):
                 f"{_where(path, lineno)}: event {name!r} uses the "
                 f"{SCHED_LAYER!r} layer outside nnstreamer_tpu/"
                 f"{SCHED_DIR}/")
+    return problems
+
+
+def _is_slo_file(path: Path) -> bool:
+    return tuple(path.parts[-2:]) == SLO_FILE
+
+
+def check_slo(root: Path = SOURCE_ROOT):
+    """Placement lint for the per-tenant SLO accountant: every
+    ``slo``-layer metric and event is emitted from
+    nnstreamer_tpu/obs/slo.py (the scheduler, serving engines, and
+    router feed it through the None-gated hooks, never by minting
+    slo.* names), the accountant registers under no other layer, and
+    the ``tenant`` label stays inside obs/slo.py + nnstreamer_tpu/
+    sched/ — the two places that bound it (overflow folding / the
+    registered-tenant set). Mirrors check_profile + the check_kv
+    reservation, but for a label key instead of a unit."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_file = _is_slo_file(path)
+        if layer == SLO_LAYER and not in_file:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{SLO_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"slo.py — feed the SLO accountant through its hooks "
+                f"instead")
+        elif in_file and layer != SLO_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/obs/slo.py must use the "
+                f"{SLO_LAYER!r} layer, not {layer!r}")
+    for path, lineno, name, labels in iter_label_decls(root):
+        if TENANT_LABEL in labels and not _is_slo_file(path) \
+                and SCHED_DIR not in path.parts:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} declares the "
+                f"{TENANT_LABEL!r} label outside nnstreamer_tpu/obs/"
+                f"slo.py and nnstreamer_tpu/{SCHED_DIR}/ — per-tenant "
+                f"series are bounded only there (cardinality guard)")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == SLO_LAYER and not _is_slo_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{SLO_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"slo.py")
     return problems
 
 

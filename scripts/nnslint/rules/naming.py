@@ -108,3 +108,11 @@ class ProfileRule(_NamingRule):
     description = ("profile telemetry is registered in obs/profile.py "
                    "and owns the ratio/flops gauge units")
     checks = (_compat.check_profile,)
+
+
+@register_rule
+class SloRule(_NamingRule):
+    id = "naming/slo"
+    description = ("slo telemetry is registered in obs/slo.py and the "
+                   "tenant label stays in obs/slo.py + sched/")
+    checks = (_compat.check_slo,)

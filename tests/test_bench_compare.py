@@ -91,6 +91,40 @@ class TestCompare:
         assert (name, b, f) == ("multiplex_pipeline_util", 0.000965, 0.02)
         assert delta > 19.0
 
+    def test_goodput_lanes_are_higher_is_better(self):
+        # the obs.slo lanes gate per-tenant goodput: a drop in the
+        # deadline-tight tenant's met ratio is a regression even when
+        # overall throughput/occupancy lanes improve
+        assert LANES["multiplex_goodput_ratio"] == +1
+        assert LANES["multiplex_goodput_tight_ratio"] == +1
+        base = {"multiplex_goodput_ratio": 0.95,
+                "multiplex_goodput_tight_ratio": 0.99}
+        fresh = {"multiplex_goodput_ratio": 0.96,     # +1% ok
+                 "multiplex_goodput_tight_ratio": 0.50}  # -49% BAD
+        reg, ok, _sk = compare(fresh, base, 0.10,
+                               ["multiplex_goodput_ratio",
+                                "multiplex_goodput_tight_ratio"])
+        assert [r[0] for r in reg] == ["multiplex_goodput_tight_ratio"]
+        assert [r[0] for r in ok] == ["multiplex_goodput_ratio"]
+
+    def test_goodput_lane_within_threshold_passes(self):
+        base = {"multiplex_goodput_tight_ratio": 0.99}
+        fresh = {"multiplex_goodput_tight_ratio": 0.95}  # -4% ok
+        reg, ok, _sk = compare(fresh, base, 0.10,
+                               ["multiplex_goodput_tight_ratio"])
+        assert reg == [] and len(ok) == 1
+
+    def test_goodput_lane_missing_in_old_baseline_skips(self):
+        # pre-slo baselines carry no goodput lanes: skipped, not faked
+        fresh = {"multiplex_goodput_ratio": 0.95,
+                 "multiplex_goodput_tight_ratio": 0.99}
+        reg, ok, sk = compare(fresh, BASE, 0.10,
+                              ["multiplex_goodput_ratio",
+                               "multiplex_goodput_tight_ratio"])
+        assert reg == [] and ok == []
+        assert {s[0] for s in sk} == {"multiplex_goodput_ratio",
+                                      "multiplex_goodput_tight_ratio"}
+
     def test_alias_never_fakes_a_missing_fresh_reading(self):
         # fresh artifact carries the OLD lane but not the new one: the
         # new lane must be SKIPPED, not silently fed the old value

@@ -533,6 +533,100 @@ class TestSchedPlacement:
 
 
 # --------------------------------------------------------------------------- #
+# slo placement (naming/slo via naming_compat.check_slo)
+# --------------------------------------------------------------------------- #
+
+class TestSloPlacement:
+    """check_slo ownership: slo-layer telemetry lives in obs/slo.py,
+    the accountant mints no other layer, and the tenant label stays
+    inside obs/slo.py + sched/ (cardinality guard)."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_slo_metric_outside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_slo_stray_total", "h", ())
+            """})
+        problems = naming_compat.check_slo(root)
+        assert len(problems) == 1
+        assert "hooks" in problems[0]
+
+    def test_foreign_layer_inside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/slo.py": """
+            def setup(reg):
+                reg.counter("nnstpu_pipeline_oops_total", "h", ())
+            """})
+        problems = naming_compat.check_slo(root)
+        assert len(problems) == 1
+        assert "must use the 'slo' layer" in problems[0]
+
+    def test_slo_event_outside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/health.py": """
+            def warn(events):
+                events.record("slo.burn_alert", "w", msg="x")
+            """})
+        problems = naming_compat.check_slo(root)
+        assert len(problems) == 1
+        assert "slo.burn_alert" in problems[0]
+
+    def test_tenant_label_outside_owners_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"query/router.py": """
+            def setup(reg):
+                reg.counter("nnstpu_router_work_total", "h", ("tenant",))
+            """})
+        problems = naming_compat.check_slo(root)
+        assert len(problems) == 1
+        assert "cardinality" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "obs/slo.py": """
+                def setup(reg, events):
+                    reg.counter("nnstpu_slo_goodput_total", "h",
+                                ("tenant", "outcome"))
+                    reg.gauge("nnstpu_slo_burn_ratio", "h",
+                              ("tenant", "objective", "window"))
+                    events.record("slo.burn_alert", "w", msg="x")
+                """,
+            "sched/telemetry.py": """
+                def setup(reg):
+                    reg.gauge("nnstpu_sched_queue_depth", "h", ("tenant",))
+                """,
+        })
+        assert naming_compat.check_slo(root) == []
+
+    def test_burn_ratio_shares_profile_unit_reservation(self, tmp_path):
+        # the ratio unit stays reserved: profile and slo layers pass,
+        # anything else still fires check_profile
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "obs/slo.py": """
+                def setup(reg):
+                    reg.gauge("nnstpu_slo_burn_ratio", "h", ("tenant",))
+                """,
+            "serving/stray.py": """
+                def setup(reg):
+                    reg.gauge("nnstpu_serving_hit_ratio", "h", ())
+                """,
+        })
+        problems = naming_compat.check_profile(root)
+        assert len(problems) == 1
+        assert "nnstpu_serving_hit_ratio" in problems[0]
+
+
+# --------------------------------------------------------------------------- #
 # suppressions
 # --------------------------------------------------------------------------- #
 
