@@ -1394,6 +1394,110 @@ def _serving_paged_lane(device) -> dict:
         return {}
 
 
+def _disagg_serving_lane(device) -> dict:
+    """Disaggregated prefill/decode serving (serving/disagg.py) vs the
+    same engine unified, request-at-a-time on a shared-prefix workload:
+    a role="prefill" worker runs chunked prefill and streams the
+    finished KV pages to a role="decode" worker over one KV_PAGE_XFER
+    frame; the decode worker splices + prefix-hits them. ``relative``
+    is the cost of the split on ONE host (two engines + loopback wire
+    round trips vs zero) — the split pays off when the fleets scale
+    independently, so the gate is "the wire hop stays cheap", not "the
+    split wins on localhost". Exactness is an invariant: the disagg
+    tokens must equal the unified engine's bit-for-bit, and every
+    shipped page must land (sent == received, zero re-prefills)."""
+    import traceback
+
+    try:
+        import jax
+
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.serving import LMEngine
+        from nnstreamer_tpu.serving import disagg as _dsg
+
+        V, D, H, L = _LM_DIMS
+        max_len, chunk, ps = 512, 16, 32
+        n_reqs, prefix_len, gen = 32, 128, 32
+        plens = (160, 192, 224, 256)
+        if device.platform == "cpu" and \
+                os.environ.get("BENCH_LM_DISAGG_FULL", "0") != "1":
+            V, D, H, L = 512, 64, 4, 2
+            max_len, chunk, ps = 128, 8, 8
+            n_reqs, prefix_len, gen = 12, 32, 12
+            plens = (40, 48, 56, 64)
+        kv_pages = 2 * max_len // ps  # 2-slot-equivalent pool per engine
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, max_len)
+
+        rng = np.random.default_rng(7)
+        prefix = rng.integers(0, V, prefix_len).astype(np.int32)
+        reqs = []
+        for i in range(n_reqs):
+            p = plens[i % len(plens)]
+            suffix = rng.integers(0, V, p - prefix_len).astype(np.int32)
+            reqs.append(np.concatenate([prefix, suffix]))
+
+        def mkeng(role=None):
+            return LMEngine(params, H, max_len, n_slots=2, chunk=chunk,
+                            kv_page_size=ps, kv_pages=kv_pages, role=role)
+
+        def run_unified():
+            eng = mkeng()
+            outs, t0 = [], time.monotonic()
+            for p in reqs:
+                rid = eng.submit(np.ascontiguousarray(p), max_new=gen)
+                eng.run()
+                outs.append(eng.results[rid])
+            wall = time.monotonic() - t0
+            return sum(len(v) for v in outs) / wall, outs
+
+        pre_eng, dec_eng = mkeng("prefill"), mkeng("decode")
+        pre_w = _dsg.DisaggWorker(pre_eng)
+        dec_w = _dsg.DisaggWorker(dec_eng)
+        client = _dsg.DisaggClient([(pre_w.host, pre_w.port)],
+                                   [(dec_w.host, dec_w.port)],
+                                   page_size=ps)
+        try:
+            _mark("disagg serving lane warmup (compiles) starting")
+            client.generate(reqs[0], gen)  # compiles both engines
+            run_unified()
+            _mark("disagg serving lane disagg run starting")
+            outs, t0 = [], time.monotonic()
+            for p in reqs:
+                outs.append(client.generate(p, gen))
+            disagg_wall = time.monotonic() - t0
+            disagg_tps = sum(len(v) for v in outs) / disagg_wall
+            _mark("disagg serving lane unified baseline starting")
+            base_tps, base_outs = run_unified()
+            row = {
+                "disagg_serving_config":
+                    f"d{D} L{L} V{V} page{ps} pool{kv_pages} "
+                    f"prefill+decode workers over loopback wire vs "
+                    f"unified, reqs{n_reqs} prefix{prefix_len} "
+                    f"prompts{min(plens)}-{max(plens)} gen{gen} greedy",
+                "disagg_serving_tokens_per_s": round(disagg_tps, 1),
+                "disagg_serving_unified_tokens_per_s": round(base_tps, 1),
+                "disagg_serving_relative": round(disagg_tps / base_tps, 3),
+                # invariant, not a tolerance: False is a correctness bug
+                "disagg_serving_exact": outs == base_outs,
+                "disagg_serving_pages_sent": client.stats["pages_sent"],
+                "disagg_serving_reprefills": client.stats["reprefills"],
+                "disagg_serving_prefix_hit_rate": round(
+                    dec_eng.prefix_hit_rate, 3),
+                "disagg_serving_prefill_hit_rate": round(
+                    pre_eng.prefix_hit_rate, 3),
+            }
+        finally:
+            client.close()
+            pre_w.stop()
+            dec_w.stop()
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -1752,6 +1856,9 @@ def main() -> None:
             if os.environ.get("BENCH_LM_PAGED", "1") != "0":
                 _mark("paged-KV serving lane starting")
                 result.update(_serving_paged_lane(device))
+            if os.environ.get("BENCH_LM_DISAGG", "1") != "0":
+                _mark("disaggregated serving lane starting")
+                result.update(_disagg_serving_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":

@@ -25,6 +25,10 @@ hedged dispatch — docs/resilience.md "Fleet routing & failover"),
 --kv-page-size/--kv-pages (serving: paged KV cache geometry for any
 LMEngine the pipeline constructs, exported via the NNS_LM_KV_* env —
 see docs/performance.md "Paged KV cache"),
+--role/--disagg (disaggregated serving: tag every LMEngine with a
+prefill/decode/unified role via NNS_LM_ROLE, and declare the
+PREFILL_EPS;DECODE_EPS fleet split via NNS_LM_DISAGG — serving/
+disagg.py, docs/architecture.md "L5: disaggregated serving"),
 --sched[=WIDTH]/--sched-tenants (multi-tenant device scheduler: one
 dispatch loop per chip coalescing same-shape work across pipelines and
 serving engines, weighted-DRR fair — docs/scheduler.md),
@@ -149,6 +153,21 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pages", type=int, default=None, metavar="N",
                     help="KV page-pool size shared by all slots (sets "
                          "NNS_LM_KV_PAGES; needs --kv-page-size)")
+    ap.add_argument("--role", choices=("prefill", "decode", "unified"),
+                    default=None,
+                    help="disaggregated-serving role for every LMEngine "
+                         "built during the run (sets NNS_LM_ROLE): "
+                         "'prefill' runs chunked prefill only and exports "
+                         "KV pages, 'decode' splices imported pages; both "
+                         "need --kv-page-size (the page pool is the "
+                         "transfer substrate) — serving/disagg.py")
+    ap.add_argument("--disagg", metavar="PREFILL_EPS;DECODE_EPS",
+                    default=None,
+                    help="declare the disaggregated fleet split: two "
+                         "comma-separated host:port lists divided by ';' "
+                         "(prefill backends, then decode backends); "
+                         "validated here and exported as NNS_LM_DISAGG "
+                         "for serving.disagg.DisaggClient construction")
     ap.add_argument("--sched", type=int, nargs="?", const=8,
                     default=None, metavar="WIDTH",
                     help="route tensor_filter invokes through the "
@@ -263,6 +282,19 @@ def main(argv=None) -> int:
         os.environ["NNS_LM_KV_PAGE_SIZE"] = str(args.kv_page_size)
         if args.kv_pages is not None:
             os.environ["NNS_LM_KV_PAGES"] = str(args.kv_pages)
+    if args.role is not None:
+        if args.role != "unified" and args.kv_page_size is None:
+            ap.error(f"--role {args.role} needs --kv-page-size (the "
+                     "paged KV pool is the page-transfer substrate)")
+        os.environ["NNS_LM_ROLE"] = args.role
+    if args.disagg is not None:
+        from .serving.disagg import parse_disagg_spec
+
+        try:
+            parse_disagg_spec(args.disagg)
+        except ValueError as e:
+            ap.error(f"--disagg: {e}")
+        os.environ["NNS_LM_DISAGG"] = args.disagg
 
     from .graph.parse import parse_pipeline
 
@@ -430,6 +462,25 @@ def main(argv=None) -> int:
                   f"{cs['median']:.1f}, occupancy "
                   f"{sched_engine.occupancy():.3f}", file=sys.stderr)
             sched.uninstall()
+        if args.kv_page_size is not None:
+            # per-engine KV exit summary (prefix_hit_rate is the
+            # economic number paging exists for); live_engines() is the
+            # weak registry — engines are built deep inside filters and
+            # never handed back to the CLI
+            from .serving.lm_engine import live_engines
+
+            for eng in live_engines():
+                hr = eng.prefix_hit_rate
+                kv = eng.kv_stats
+                if hr is None or kv is None:
+                    continue
+                print(f"kv[{eng._engine_label}/{eng.role}]: "
+                      f"prefix_hit_rate {hr:.3f} "
+                      f"({kv['hit_tokens']}/{kv['prompt_tokens']} tokens), "
+                      f"pages_peak {kv['pages_peak']}, "
+                      f"imported {kv['imported_pages']}, "
+                      f"exported {kv['exported_pages']}, "
+                      f"spilled {kv['spilled_pages']}", file=sys.stderr)
         if args.obs_push is not None or args.obs_aggregate:
             from .obs import fleet
 

@@ -54,7 +54,7 @@ import threading
 import time
 import weakref
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
 from . import events as _events
@@ -100,6 +100,17 @@ MAX_SPANS_PER_PUSH = 512
 #: HTTP ingestion body cap — a push is a snapshot, not a bulk upload
 MAX_PUSH_BYTES = 8 << 20
 
+#: digest entries per push — bounds both doc size and the router's
+#: probe cost; deep trees advertise their first 64 BFS nodes, which
+#: covers the hot shared prefixes placement actually cares about
+MAX_KV_PREFIX_ENTRIES = 64
+
+#: serving/disagg.py installs a zero-arg callable returning the local
+#: engine's bounded radix-prefix digest (kv_cache.prefix_digest());
+#: None (the default) keeps the push doc exactly as it was — the
+#: usual zero-overhead-when-off hook (slo.ENGINE_SLO_HOOK pattern)
+KV_DIGEST_HOOK = None
+
 
 def default_instance() -> str:
     """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
@@ -113,7 +124,8 @@ def build_push(instance: str, role: str, seq: int,
                registry: Optional[_metrics.MetricsRegistry] = None,
                health_registry: Optional[_health.HealthRegistry] = None,
                span_store: Optional[_tracing.SpanStore] = None,
-               max_spans: int = MAX_SPANS_PER_PUSH) -> Dict[str, Any]:
+               max_spans: int = MAX_SPANS_PER_PUSH,
+               kv_prefix: Optional[List[str]] = None) -> Dict[str, Any]:
     """Assemble one push document from the given (default: process-
     global) registries — the single source of truth for the push
     schema, shared by the pusher, the wire piggyback, and tests."""
@@ -122,6 +134,8 @@ def build_push(instance: str, role: str, seq: int,
         else _health.registry()
     store = span_store if span_store is not None else _tracing.store()
     ready, conds = hreg.readiness()
+    if kv_prefix is None and KV_DIGEST_HOOK is not None:
+        kv_prefix = KV_DIGEST_HOOK()
     return {
         "v": PUSH_VERSION,
         "instance": instance,
@@ -136,6 +150,12 @@ def build_push(instance: str, role: str, seq: int,
         # None while the SLO layer is off — a worker without per-tenant
         # accounting pushes the same doc it always did
         "slo": _slo.push_data(),
+        # None while no digest source is registered (same contract as
+        # slo): the bounded radix-prefix digest the router probes for
+        # prefix-cache-aware placement, capped at MAX_KV_PREFIX_ENTRIES
+        "kv_prefix": (None if kv_prefix is None
+                      else [str(h) for h in kv_prefix]
+                      [:MAX_KV_PREFIX_ENTRIES]),
     }
 
 
@@ -295,8 +315,9 @@ class _Instance:
     """Latest state pushed by one worker process."""
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
-                 "metrics", "health", "ready", "slo", "via", "pushes",
-                 "spans_ingested", "first_mono", "last_mono")
+                 "metrics", "health", "ready", "slo", "kv_prefix",
+                 "via", "pushes", "spans_ingested", "first_mono",
+                 "last_mono")
 
     def __init__(self, instance: str):
         self.instance = instance
@@ -308,6 +329,10 @@ class _Instance:
         self.health: Dict[str, Any] = {}
         self.ready: Dict[str, Any] = {"ready": False, "conditions": {}}
         self.slo: Optional[Dict[str, Any]] = None
+        #: frozenset of radix path hashes (None until the instance
+        #: first advertises one) — set membership IS the prefix probe:
+        #: chained hashes mean hashes[i] present implies path 0..i held
+        self.kv_prefix: Optional[frozenset] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -421,6 +446,7 @@ class FleetAggregator:
         health = doc.get("health")
         ready = doc.get("ready")
         slo_doc = doc.get("slo")
+        kv_prefix = doc.get("kv_prefix")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -441,6 +467,12 @@ class FleetAggregator:
                 rec.ready = ready
             if isinstance(slo_doc, dict):
                 rec.slo = slo_doc
+            if isinstance(kv_prefix, (list, tuple)):
+                # replace, never merge: the digest is a snapshot of
+                # what the instance holds NOW — evicted paths must
+                # stop attracting placements
+                rec.kv_prefix = frozenset(
+                    str(h) for h in kv_prefix[:MAX_KV_PREFIX_ENTRIES])
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -706,6 +738,7 @@ class FleetAggregator:
                 "queue_depth": self._queue_depth(rec),
                 "role": rec.role,
                 "push_age_s": age,
+                "kv_prefix_size": len(rec.kv_prefix or ()),
             }
         for iid, stone in stones.items():
             if iid in view:
@@ -718,8 +751,44 @@ class FleetAggregator:
                 "queue_depth": float("inf"),
                 "role": stone.get("role", "worker"),
                 "push_age_s": now - float(stone.get("expired_mono", now)),
+                "kv_prefix_size": 0,
             }
         return view
+
+    def longest_prefix(self, hashes: Sequence[str]
+                       ) -> Tuple[Optional[str], int]:
+        """The routable instance holding the longest shared KV prefix.
+
+        ``hashes`` is the request's chained page-path hash list
+        (kv_cache.prompt_path_hashes): because each hash chains over
+        its whole path, digest membership of ``hashes[i]`` proves the
+        instance holds pages 0..i — the probe is i set lookups, and it
+        stops at the first miss. Returns ``(instance, depth)`` where
+        depth counts matched leading pages, or ``(None, 0)`` when no
+        fresh+ready instance advertises any of the prefix. Only
+        instances that would be ``routable`` in :meth:`routing_view`
+        are considered — a stale digest must not attract placements."""
+        if not hashes:
+            return None, 0
+        self._expire_now()
+        now = time.monotonic()
+        with self._lock:
+            recs = list(self._instances.values())
+        best: Optional[str] = None
+        best_depth = 0
+        for rec in recs:
+            dig = rec.kv_prefix
+            if not dig or not rec.ready.get("ready") \
+                    or now - rec.last_mono > self._ttl(rec):
+                continue
+            depth = 0
+            for h in hashes:
+                if h not in dig:
+                    break
+                depth += 1
+            if depth > best_depth:
+                best, best_depth = rec.instance, depth
+        return best, best_depth
 
     # -- /debug/fleet ------------------------------------------------------ #
     def snapshot(self) -> Dict[str, Any]:

@@ -62,6 +62,12 @@ class Cmd(enum.IntEnum):
     # metric/health/span snapshot ahead of a DATA frame; fire-and-forget
     # (no reply frame — the data stream must not stall on telemetry)
     OBS_PUSH = 12
+    # disaggregated serving (serving/disagg.py): one finished KV radix
+    # path migrates prefill→decode backend — meta carries the chunk
+    # keys + dtype/layout header, the payload the concatenated page
+    # bits (auto-chunked like DATA), and the receiver answers RESULT
+    # (pages spliced) or ERROR (rejected — geometry/pool)
+    KV_PAGE_XFER = 13
 
 
 class QueryProtocolError(RuntimeError):

@@ -254,6 +254,15 @@ class Backend:
             except OSError:
                 pass
 
+    def ensure_connected(self, caps: str) -> None:
+        """Dial + INFO handshake without sending a request, so the
+        fleet ``instance`` id is learned up front — prefix-aware
+        placement joins digests to endpoints through it, and a backend
+        that never dispatched would otherwise stay anonymous."""
+        with self._wire_lock:
+            if self._sock is None:
+                self._sock = self._connect(caps)
+
     def local_load(self) -> float:
         """Load score from locally observed signals: requests in flight
         weighted by how slow this backend has been lately."""
@@ -433,15 +442,24 @@ class BackendSet:
         return [be for be in cands if be.breaker.state != _rp.OPEN]
 
     def pick(self, session: Optional[str] = None,
-             exclude: frozenset = frozenset()) -> Optional[Backend]:
+             exclude: frozenset = frozenset(),
+             prefix_hashes: Optional[Sequence[str]] = None
+             ) -> Optional[Backend]:
         """Choose a backend: session affinity first (consistent hash,
-        spilling with an event when the target is unroutable), else
+        spilling with an event when the target is unroutable), then the
+        backend advertising the longest shared KV prefix
+        (``prefix_hashes`` probed against the fleet digest —
+        serving.disagg placement), else
         least-loaded-of-two-random-choices. None when nothing routable
         remains — the caller's fallback decision point. Selection is a
         commitment: the winner's breaker admission (the half-open probe
         quota) is consumed here, never for losing candidates."""
         if session is not None:
             be = self._affinity(session, exclude)
+            if be is not None:
+                return be
+        if prefix_hashes:
+            be = self._prefix_match(prefix_hashes, exclude)
             if be is not None:
                 return be
         cands = self._routable(exclude)
@@ -456,6 +474,36 @@ class BackendSet:
             return first
         if second.breaker.allow():
             return second
+        return None
+
+    def _prefix_match(self, hashes: Sequence[str],
+                      exclude: frozenset) -> Optional[Backend]:
+        """The backend whose fleet digest holds the request's longest
+        leading prefix (FleetAggregator.longest_prefix) — a prefix hit
+        over the wire beats a least-loaded placement that would
+        re-prefill from token zero. None when no aggregator is
+        attached, no instance advertises the prefix, or the holder is
+        not in this set / not admissible; the caller falls through to
+        two-choice."""
+        agg = _fleet.aggregator()
+        if agg is None:
+            return None
+        inst, depth = agg.longest_prefix(hashes)
+        if inst is None or depth <= 0:
+            return None
+        with self._lock:
+            cands = [be for be in self._backends.values()
+                     if be.state == ACTIVE and be.instance == inst
+                     and be.endpoint not in exclude]
+        for be in cands:
+            if be.breaker.state != _rp.OPEN and be.breaker.allow():
+                _PREFIX_PLACED.labels(self.owner).inc()
+                _events.record(
+                    "router.prefix_place",
+                    f"{self.owner}: placed on {be.endpoint} holding "
+                    f"{depth} shared KV prefix page(s)",
+                    element=self.owner, backend=be.endpoint, depth=depth)
+                return be
         return None
 
     def _affinity(self, session: str,
@@ -519,6 +567,10 @@ _BACKEND_STATE = _reg.gauge(
 _INFLIGHT = _reg.gauge(
     "nnstpu_router_inflight_depth",
     "Requests in flight per backend", ("element", "backend"))
+_PREFIX_PLACED = _reg.counter(
+    "nnstpu_router_prefix_placed_total",
+    "Dispatches placed on the backend advertising the longest shared"
+    " KV prefix (serving.disagg prefix-aware routing)", ("element",))
 
 
 class QueryRouter:
@@ -568,6 +620,38 @@ class QueryRouter:
         not have happened when the router is constructed."""
         self._caps = fn
 
+    def prime(self) -> int:
+        """Dial every ACTIVE backend once (handshake only) so each
+        learns its fleet instance id before the first dispatch —
+        prefix-aware placement needs the endpoint-to-instance join.
+        Unreachable backends are skipped (their breakers record the
+        failure); returns how many backends are now identified."""
+        caps = self._caps()
+        n = 0
+        for be in self.backends.backends():
+            if be.state != ACTIVE:
+                continue
+            if be.instance is None:
+                try:
+                    be.ensure_connected(caps)
+                except (ConnectionError, OSError, QueryProtocolError):
+                    be.breaker.record_failure()
+                    continue
+            n += be.instance is not None
+        return n
+
+    def choose(self, session: Optional[str] = None,
+               prefix_hashes: Optional[Sequence[str]] = None
+               ) -> Optional[Backend]:
+        """Placement WITHOUT dispatch: the backend :meth:`dispatch`
+        would pick right now (affinity -> prefix digest -> two-choice).
+        serving.disagg uses it to choose the decode target before the
+        prefill even runs, so pages stream to where the request will
+        land. The choice is advisory — the later dispatch re-picks
+        unless pinned via ``prefer=``."""
+        return self.backends.pick(session=session,
+                                  prefix_hashes=prefix_hashes)
+
     # -- membership passthrough (gauges track new members) ----------------- #
     def add_backend(self, endpoint: str) -> Backend:
         import weakref
@@ -608,12 +692,20 @@ class QueryRouter:
     # -- dispatch ----------------------------------------------------------- #
     def dispatch(self, meta: Dict[str, Any], payload: bytes,
                  deadline: Optional[_rp.Deadline] = None,
-                 session: Optional[str] = None
+                 session: Optional[str] = None,
+                 prefix_hashes: Optional[Sequence[str]] = None,
+                 prefer: Optional[str] = None
                  ) -> Tuple[Dict[str, Any], bytes]:
         """Route one request. Raises :class:`RouterError` once every
         routable backend has failed it and the shared retry budget is
         spent; raises nothing for a single backend death — that is the
-        failover path, not an error."""
+        failover path, not an error.
+
+        ``prefix_hashes`` (kv_cache.prompt_path_hashes) turns on
+        prefix-cache-aware placement; ``prefer`` pins the first attempt
+        to a specific endpoint when it is still routable (serving.disagg
+        sends the decode request to the backend it just streamed pages
+        to) — on failure the normal failover loop takes over."""
         budget = _rp.RetryBudget(self.max_request_retry, site="router")
         tried: set = set()
         used_backend = False  # at least one real attempt hit a wire
@@ -635,10 +727,21 @@ class QueryRouter:
                 # tried, clear the exclusion and let backoff + breaker
                 # probes drive recovery
                 exclude = frozenset(tried)
-                be = self.backends.pick(session=session, exclude=exclude)
+                be = None
+                if prefer is not None and prefer not in exclude:
+                    cand = self.backends.get(prefer)
+                    if cand is not None and cand.state == ACTIVE \
+                            and cand.breaker.state != _rp.OPEN \
+                            and cand.breaker.allow():
+                        be = cand
+                if be is None:
+                    be = self.backends.pick(session=session,
+                                            exclude=exclude,
+                                            prefix_hashes=prefix_hashes)
                 if be is None and tried:
                     tried.clear()
-                    be = self.backends.pick(session=session)
+                    be = self.backends.pick(session=session,
+                                            prefix_hashes=prefix_hashes)
                 if be is None:
                     last = RouterError(
                         f"{self.name}: no routable backend "

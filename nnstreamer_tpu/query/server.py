@@ -53,6 +53,44 @@ log = logger("query")
 _pairs_lock = threading.Lock()
 _server_pairs: Dict[int, "TensorQueryServerSrc"] = {}
 
+#: disaggregated-serving import point (serving/disagg.py
+#: register_import_target installs/clears this): called as
+#: ``hook(meta, payload, deadline) -> pages_imported`` for every
+#: ``KV_PAGE_XFER`` frame a serversrc receives; ``deadline`` is already
+#: re-anchored on this host's clock (like DATA). None — the default —
+#: answers the sender with ERROR: a backend that never registered a
+#: page-import target must reject transfers loudly, not absorb them.
+#: Disabled cost: one module-global load per non-data frame.
+KV_IMPORT_HOOK = None
+
+
+def handle_kv_page_xfer(conn: socket.socket, meta: Dict[str, Any],
+                        payload: bytes, hook: Any = None) -> None:
+    """One KV_PAGE_XFER frame: re-anchor the wire deadline, hand the
+    page document to the import target, and answer RESULT (pages
+    spliced) or ERROR (no target / expired / rejected). Shared by the
+    serversrc dispatch (which uses the process-global KV_IMPORT_HOOK)
+    and serving/disagg.py's worker loop (which binds its own engine's
+    hook) so both endpoints speak identical transfer semantics."""
+    hook = hook if hook is not None else KV_IMPORT_HOOK
+    dl = _rp.Deadline.from_wire(meta.get(_rp.WIRE_KEY))
+    if hook is None:
+        send_message(conn, Cmd.ERROR,
+                     {"error": "no KV page-import target registered"})
+        return
+    if dl is not None and dl.expired():
+        # the transfer outlived its request budget in flight: splicing
+        # now would pin pages for a result nobody is waiting for
+        send_message(conn, Cmd.ERROR,
+                     {"error": "KV page transfer deadline expired"})
+        return
+    try:
+        n = int(hook(meta, payload, dl))
+    except (ValueError, RuntimeError) as e:
+        send_message(conn, Cmd.ERROR, {"error": f"kv import rejected: {e}"})
+        return
+    send_message(conn, Cmd.RESULT, {"kv_imported": n})
+
 
 def wait_bound_port(src: "TensorQueryServerSrc",
                     timeout_s: float = 10.0) -> int:
@@ -238,6 +276,12 @@ class TensorQueryServerSrc(SourceElement):
                     # fleet telemetry piggyback: ingest when this process
                     # aggregates, drop otherwise; never a reply frame
                     _fleet.ingest_wire(meta, payload)
+                elif cmd is Cmd.KV_PAGE_XFER:
+                    # disaggregated serving: splice migrated KV pages
+                    # into the registered engine's pool and answer
+                    # RESULT/ERROR (serving/disagg.py owns the framing)
+                    self._hc.beat()
+                    handle_kv_page_xfer(conn, meta, payload)
                 else:
                     send_message(conn, Cmd.ERROR,
                                  {"error": f"unexpected cmd {cmd}"})

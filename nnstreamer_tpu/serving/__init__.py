@@ -8,12 +8,16 @@ multiplexed into one compiled batched decode step. `PagedKVCache`
 (serving/kv_cache.py) lifts its concurrency past the slot count:
 fixed-size KV pages with radix prefix sharing, copy-on-write, and
 deterministic LRU eviction, enabled per engine via ``kv_page_size``.
+serving/disagg.py disaggregates the two LM phases across backends:
+role-tagged engines, KV-page migration over the query wire, and
+prefix-digest-aware placement (imported lazily — it pulls the query
+stack in, which plain engine users never need).
 """
 
 from . import sampling
-from .kv_cache import PagedKVCache
-from .lm_engine import LMEngine, next_pow2_bucket
+from .kv_cache import PagedKVCache, prompt_path_hashes
+from .lm_engine import LMEngine, live_engines, next_pow2_bucket
 from .tp_engine import TPLMEngine
 
-__all__ = ["LMEngine", "PagedKVCache", "TPLMEngine", "next_pow2_bucket",
-           "sampling"]
+__all__ = ["LMEngine", "PagedKVCache", "TPLMEngine", "live_engines",
+           "next_pow2_bucket", "prompt_path_hashes", "sampling"]

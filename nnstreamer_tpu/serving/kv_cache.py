@@ -50,6 +50,7 @@ lineage adapted to this repo's static-shape XLA discipline
 
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -59,10 +60,41 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import events as _events
 from ..obs import metrics as _obs
 
 __all__ = ["PagedKVCache", "PageNode", "AdmitPlan", "PageLease",
-           "empty_page_pool"]
+           "PAGE_DOC_VERSION", "empty_page_pool", "prompt_path_hashes"]
+
+#: page-transfer document schema version (serving/disagg.py frames these
+#: over the query wire as ``Cmd.KV_PAGE_XFER``; import_pages rejects
+#: unknown majors with a clear error instead of splicing garbage)
+PAGE_DOC_VERSION = 1
+
+
+def _chain_hash(prev: bytes, key: Any) -> "hashlib.blake2b":
+    """One link of the chained per-chunk path hash: digest over the
+    parent chunk's digest plus this chunk's token ids. Chaining makes
+    set membership of hashes[i] imply the whole path 0..i matches, so
+    a fleet prefix lookup is per-entry set probes, not tree walks."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(prev)
+    h.update(np.asarray(key, np.int32).tobytes())
+    return h
+
+
+def prompt_path_hashes(tokens: Any, page_size: int) -> List[str]:
+    """Chained hashes of a prompt's full-page chunks, root first — the
+    client-side key list a prefix-aware router matches against the
+    digests backends publish (:meth:`PagedKVCache.prefix_digest`)."""
+    toks = np.asarray(tokens, np.int32).reshape(-1)
+    out: List[str] = []
+    prev = b""
+    for k in range(int(toks.size) // page_size):
+        h = _chain_hash(prev, toks[k * page_size:(k + 1) * page_size])
+        prev = h.digest()
+        out.append(h.hexdigest())
+    return out
 
 
 def empty_page_pool(n_pages: int, n_layers: int, n_heads: int,
@@ -178,7 +210,9 @@ class PagedKVCache:
         self._shared = 0  # nodes pinned by >= 2 requests
         self.stats = {"lookups": 0, "hit_requests": 0, "hit_tokens": 0,
                       "prompt_tokens": 0, "cow_copies": 0, "evictions": 0,
-                      "offloads": 0, "reuploads": 0, "pages_peak": 0}
+                      "offloads": 0, "reuploads": 0, "pages_peak": 0,
+                      "exported_pages": 0, "imported_pages": 0,
+                      "spilled_pages": 0}
         self._init_metrics(label)
 
     def _init_metrics(self, label: str) -> None:
@@ -213,6 +247,15 @@ class PagedKVCache:
             "nnstpu_serving_kv_evict_total",
             "KV pages evicted from the pool (deterministic LRU)",
             ("engine",)).labels(label)
+        self._m_offload = reg.counter(
+            "nnstpu_serving_kv_offload_total",
+            "Cold KV pages copied D2H into host RAM at eviction",
+            ("engine",)).labels(label)
+        self._m_reupload = reg.counter(
+            "nnstpu_serving_kv_reupload_total",
+            "Offloaded KV pages uploaded back on a later prefix hit",
+            ("engine",)).labels(label)
+        self._label = label
 
     # -- accounting -------------------------------------------------------- #
 
@@ -356,6 +399,209 @@ class PagedKVCache:
         self.reserved -= lease.reserved
         lease.reserved = 0
 
+    # -- page migration (serving/disagg.py transfer substrate) ------------- #
+
+    def prefix_hit_rate(self) -> float:
+        """Fraction of prompt tokens served from shared prefix pages —
+        the economic summary the bench lane and exit report surface."""
+        return self.stats["hit_tokens"] / max(1, self.stats["prompt_tokens"])
+
+    def prefix_digest(self, max_entries: int = 64) -> List[str]:
+        """Bounded list of chained path hashes for every contentful
+        radix node, breadth-first (shallow prefixes — the most shareable
+        state — survive the bound). Published through the fleet push doc
+        so a prefix-aware router can place a request on the backend
+        already holding its prefix (:func:`prompt_path_hashes` builds
+        the matching client-side key list)."""
+        out: List[str] = []
+        queue: deque = deque((child, b"")
+                             for child in self.root.children.values())
+        while queue and len(out) < max_entries:
+            nd, prev = queue.popleft()
+            if nd.page is None and nd.host_kv is None:
+                continue
+            h = _chain_hash(prev, nd.key)
+            out.append(h.hexdigest())
+            queue.extend((c, h.digest()) for c in nd.children.values())
+        return out
+
+    def _header(self) -> Dict[str, Any]:
+        _, lh, ps, hd = self.kpool.shape
+        return {"v": PAGE_DOC_VERSION, "page_size": ps, "lh": lh,
+                "hd": hd, "dtype": str(self.kpool.dtype)}
+
+    def _node_payload(self, nd: PageNode
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """A node's K/V page bits as host arrays: D2H for a device page,
+        the stored copy for an offloaded one, None for content-less."""
+        if nd.page is not None:
+            return (np.asarray(jax.device_get(self.kpool[nd.page])),
+                    np.asarray(jax.device_get(self.vpool[nd.page])))
+        if nd.host_kv is not None:
+            return nd.host_kv
+        return None
+
+    def _export_doc(self, path: List[PageNode]) -> Optional[Dict[str, Any]]:
+        entries = []
+        for nd in path:
+            kv = self._node_payload(nd)
+            if kv is None:
+                return None  # a content-less link breaks the chain
+            entries.append({"key": [int(x) for x in nd.key],
+                            "k": kv[0], "v": kv[1]})
+        if not entries:
+            return None
+        self.stats["exported_pages"] += len(entries)
+        doc = self._header()
+        doc["entries"] = entries
+        return doc
+
+    def export_pages(self, seq: Any) -> Optional[Dict[str, Any]]:
+        """Export the registered radix path covering ``seq``'s full-page
+        chunks as a transfer document (header + root-first entries of
+        token keys and K/V page bits). Read-only: nothing is pinned,
+        dropped, or copied on device — safe regardless of what shares
+        the pages. Returns None when no full chunk of ``seq`` is in the
+        tree."""
+        seq = np.asarray(seq, np.int32).reshape(-1)
+        ps = self.page_size
+        node, path = self.root, []
+        for k in range(int(seq.size) // ps):
+            key = tuple(int(x) for x in seq[k * ps:(k + 1) * ps])
+            child = node.children.get(key)
+            if child is None or (child.page is None
+                                 and child.host_kv is None):
+                break
+            path.append(child)
+            node = child
+        return self._export_doc(path) if path else None
+
+    def export_path(self, nd: PageNode) -> Optional[Dict[str, Any]]:
+        """Export the root-to-``nd`` path (spill unit: the receiver can
+        splice a leaf only together with its ancestors — chunk keys are
+        position-dependent, so a dangling suffix would be meaningless)."""
+        path: List[PageNode] = []
+        cur: Optional[PageNode] = nd
+        while cur is not None and cur.key is not None:
+            path.append(cur)
+            cur = cur.parent
+        path.reverse()
+        return self._export_doc(path) if path else None
+
+    def import_pages(self, doc: Dict[str, Any]) -> int:
+        """Splice a transfer document into this pool's radix tree and
+        return the number of pages uploaded. All-or-nothing: geometry
+        mismatch raises ValueError and pool exhaustion raises
+        RuntimeError BEFORE any tree or pool mutation — a rejected
+        import leaves no half-spliced path behind.
+
+        Entries whose chunk path already has content here are skipped
+        (the chunk path content-addresses the page, so the local copy is
+        bit-identical by construction); the imported path is pinned
+        root-to-leaf for the duration of the splice so the allocations
+        it makes can never evict their own ancestors, then unpinned —
+        fresh nodes land ref-0 in the LRU exactly like locally-released
+        prefix state, COW-shareable and evictable from day one."""
+        if not isinstance(doc, dict):
+            raise ValueError("page transfer document must be a dict")
+        hdr = self._header()
+        if int(doc.get("v", 0)) > PAGE_DOC_VERSION:
+            raise ValueError(
+                f"page transfer doc v{doc.get('v')} newer than "
+                f"supported v{PAGE_DOC_VERSION}")
+        for fld in ("page_size", "lh", "hd", "dtype"):
+            if doc.get(fld) != hdr[fld]:
+                raise ValueError(
+                    f"page geometry mismatch on {fld!r}: transfer has "
+                    f"{doc.get(fld)!r}, this pool has {hdr[fld]!r}")
+        entries = doc.get("entries") or []
+        shape = (hdr["lh"], hdr["page_size"], hdr["hd"])
+        for ent in entries:
+            key = ent.get("key")
+            if not isinstance(key, (list, tuple)) \
+                    or len(key) != self.page_size:
+                raise ValueError("transfer entry key is not one full page")
+            for side in ("k", "v"):
+                arr = np.asarray(ent[side])
+                if arr.shape != shape:
+                    raise ValueError(
+                        f"transfer entry {side!r} payload shape "
+                        f"{arr.shape} != page shape {shape}")
+        # pass 1: pin the already-contentful prefix of the path so the
+        # pass-2 allocations (which may evict) can never drop it
+        node, idx, pinned = self.root, 0, []
+        for ent in entries:
+            child = node.children.get(tuple(int(x) for x in ent["key"]))
+            if child is None or (child.page is None
+                                 and child.host_kv is None):
+                break
+            self._pin(child)
+            pinned.append(child)
+            node, idx = child, idx + 1
+        needed = len(entries) - idx
+        if needed > self.available():
+            for nd in reversed(pinned):
+                self._unpin(nd)
+            raise RuntimeError(
+                f"page transfer needs {needed} pages but only "
+                f"{self.available()} are claimable — import rejected")
+        # pass 2: splice — every entry past the matched prefix uploads
+        # into a freshly allocated page under a node pinned on creation
+        spliced = 0
+        try:
+            for ent in entries[idx:]:
+                key = tuple(int(x) for x in ent["key"])
+                child = node.children.get(key)
+                if child is None:
+                    child = PageNode(key, node, None)
+                    node.children[key] = child
+                self._pin(child)
+                pinned.append(child)
+                if child.page is None and child.host_kv is None:
+                    pid = self._alloc()
+                    self.kpool = _pool_set(
+                        self.kpool, jnp.int32(pid),
+                        jnp.asarray(np.asarray(ent["k"], np.float32)))
+                    self.vpool = _pool_set(
+                        self.vpool, jnp.int32(pid),
+                        jnp.asarray(np.asarray(ent["v"], np.float32)))
+                    child.page = pid
+                    spliced += 1
+                node = child
+        finally:
+            for nd in reversed(pinned):
+                self._unpin(nd)
+        self.stats["imported_pages"] += spliced
+        return spliced
+
+    # -- cross-backend spill (serving/disagg.py PageSpiller) --------------- #
+
+    def coldest(self, n: int) -> List[PageNode]:
+        """Up to ``n`` coldest shed-able nodes: ref-0 LRU entries with no
+        children — leaves whose content transfers completely as one
+        root-to-node path document, so shedding one loses nothing an
+        export did not carry."""
+        out = []
+        for nd in self._lru:
+            if not nd.children:
+                out.append(nd)
+                if len(out) >= n:
+                    break
+        return out
+
+    def shed(self, nd: PageNode) -> int:
+        """Drop a cold subtree whose content was transferred elsewhere;
+        returns pages freed. Only valid for ref-0 nodes (the caller got
+        them from :meth:`coldest`); counted as spills, not evictions —
+        the content still exists, just on another backend."""
+        if nd.ref != 0:
+            raise RuntimeError("shed() on a pinned node — spill policy "
+                               "must only shed ref-0 LRU entries")
+        self._lru.pop(nd, None)
+        freed = self._drop_subtree(nd)
+        self.stats["spilled_pages"] += freed
+        return freed
+
     # -- internals --------------------------------------------------------- #
 
     def _register(self, lease: PageLease, seq: np.ndarray, upto: int,
@@ -449,6 +695,11 @@ class PagedKVCache:
                 nd.host_kv = (np.asarray(jax.device_get(self.kpool[nd.page])),
                               np.asarray(jax.device_get(self.vpool[nd.page])))
                 self.stats["offloads"] += 1
+                self._m_offload.inc()
+                _events.record(
+                    "serving.kv_offload",
+                    f"{self._label}: page {nd.page} offloaded to host RAM",
+                    severity="debug", engine=self._label, page=nd.page)
             self.free.append(nd.page)
             nd.page = None
             self.stats["evictions"] += 1
@@ -484,6 +735,11 @@ class PagedKVCache:
         self.vpool = _pool_set(self.vpool, jnp.int32(pid), jnp.asarray(v_np))
         nd.page = pid  # host_kv kept: future evictions skip the D2H
         self.stats["reuploads"] += 1
+        self._m_reupload.inc()
+        _events.record(
+            "serving.kv_reupload",
+            f"{self._label}: offloaded chunk re-uploaded into page {pid}",
+            severity="debug", engine=self._label, page=pid)
 
     def _copy_page(self, dst: int, src: int) -> None:
         self.kpool = _pool_copy(self.kpool, jnp.int32(dst), jnp.int32(src))

@@ -52,7 +52,9 @@ engine-backed worker, generated sequences flowing back per request.
 from __future__ import annotations
 
 import os
+import threading
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
@@ -85,6 +87,25 @@ def _env_int(name: str) -> Optional[int]:
         return int(v)
     except ValueError:
         raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+#: disaggregated-serving roles (serving/disagg.py): "prefill" engines
+#: run chunked prefill only and export finished KV pages; "decode"
+#: engines accept imported pages and decode (they can still re-prefill
+#: from scratch on transfer failure); "unified" is the classic both-
+#: phases engine and the default
+ROLES = ("unified", "prefill", "decode")
+
+#: weak registry of every constructed engine — `nns-launch` walks it at
+#: exit to print per-engine KV summaries without threading a handle
+#: through the pipeline graph
+_LIVE_ENGINES: "weakref.WeakSet[LMEngine]" = weakref.WeakSet()
+
+
+def live_engines() -> List["LMEngine"]:
+    """Engines constructed in this process and still alive (weak set —
+    collected engines drop out). Order is unspecified."""
+    return list(_LIVE_ENGINES)
 
 
 def next_pow2_bucket(n: int, lo: int = 16) -> int:
@@ -328,9 +349,19 @@ class LMEngine:
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
                  kv_slot_pages: Optional[int] = None,
-                 kv_host_offload: Optional[bool] = None) -> None:
+                 kv_host_offload: Optional[bool] = None,
+                 role: Optional[str] = None) -> None:
         if n_slots < 1 or chunk < 1:
             raise ValueError("n_slots and chunk must be >= 1")
+        # disaggregated-serving role: explicit kwarg wins, else the
+        # NNS_LM_ROLE environment (the `nns-launch --role` transport),
+        # else unified — same precedence as the NNS_LM_KV_* knobs
+        r = role if role is not None \
+            else (os.environ.get("NNS_LM_ROLE", "") or "unified")
+        if r not in ROLES:
+            raise ValueError(
+                f"role must be one of {ROLES}, got {r!r}")
+        self.role = r
         if spec_draft < 0 or spec_draft + 1 > max_len:
             raise ValueError("spec_draft must be in [0, max_len-1]")
         self.params = params
@@ -390,6 +421,19 @@ class LMEngine:
             #: the only writer); row entries past a request's allocated
             #: pages hold the null page 0
             self._table_host = np.zeros((n_slots, slot_pages), np.int32)
+        if self.role != "unified" and self._kv is None:
+            # the page pool IS the transfer substrate: a prefill engine
+            # has nothing to export and a decode engine nowhere to
+            # splice imports without it
+            raise ValueError(
+                f"role={self.role!r} requires the paged KV cache "
+                f"(set kv_page_size > 0)")
+        # cross-backend KV-page imports (serving/disagg.py): docs land
+        # here from the wire thread and are spliced by the scheduler
+        # thread at the top of each iteration — PagedKVCache itself is
+        # single-threaded by contract
+        self._kv_imports: deque = deque()
+        self._kv_imports_lock = threading.Lock()
         # device-resident slot state (leading axis = slot); cache
         # allocation is a hook so a mesh-sharded engine never
         # materializes the unsharded stores (serving/tp_engine.py);
@@ -430,6 +474,7 @@ class LMEngine:
         self._sched_engine = None
         self._init_metrics()
         self._init_health()
+        _LIVE_ENGINES.add(self)
 
     #: distinguishes engine kinds in the metric series; the TP engine
     #: overrides to "tp"
@@ -554,6 +599,15 @@ class LMEngine:
         if max_new < 1:
             self._reject("max_new must be >= 1")
             raise ValueError("max_new must be >= 1")
+        if self.role == "prefill" and max_new != 1:
+            # a prefill engine's product is the KV pages, not tokens:
+            # the single generated token only proves exactness (it must
+            # match what the decode backend regenerates from the
+            # imported prefix)
+            self._reject("prefill role accepts max_new=1 only")
+            raise ValueError(
+                f"role='prefill' engines run prefill only "
+                f"(max_new must be 1, got {max_new})")
         if p.size + max_new - 1 > self.max_len:
             # the LAST generated token needs no cache slot, hence -1
             self._reject("prompt + max_new exceeds cache capacity")
@@ -668,6 +722,8 @@ class LMEngine:
     def _step_direct(self) -> bool:
         self._hc.beat()  # watchdog liveness: the scheduler is turning
         t0 = time.monotonic()
+        if self._kv_imports:  # truthiness: free when nothing arrived
+            self.drain_kv_imports()
         self._admit()
         self._decode()
         self.stats["wall_s"] += time.monotonic() - t0
@@ -712,6 +768,78 @@ class LMEngine:
         """Paged-KV-cache counters (hit/prompt tokens, COW copies,
         evictions, pages_peak, ...) or None when running contiguous."""
         return None if self._kv is None else dict(self._kv.stats)
+
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Fraction of prompt tokens served from the radix prefix cache
+        (0.0 before any lookup); None when running contiguous."""
+        return None if self._kv is None else self._kv.prefix_hit_rate()
+
+    # -- disaggregated serving (serving/disagg.py) ------------------------- #
+
+    def kv_prefix_digest(self, max_entries: int = 64) -> List[str]:
+        """Bounded radix-prefix digest for the fleet push doc — chained
+        path hashes the router probes for prefix-aware placement.
+        Empty when running contiguous."""
+        return [] if self._kv is None else self._kv.prefix_digest(max_entries)
+
+    def prefill_and_export(self, prompt: Sequence[int], *,
+                           eos: Optional[int] = None,
+                           temperature: float = 0.0, top_k: int = 0,
+                           top_p: float = 1.0, seed: int = 0,
+                           deadline: Any = None,
+                           session: Optional[str] = None):
+        """Prefill-role entry point: run chunked prefill over ``prompt``
+        (max_new=1 — the one sampled token proves exactness), then
+        export the finished full-page KV path for wire transfer.
+
+        Returns ``(first_token_or_None, export_doc_or_None)``: the token
+        is None when the request was shed (expired deadline) and the doc
+        is None when no full page finished (short prompt) or the pages
+        were evicted before export — the decode backend then simply
+        re-prefills from scratch.
+        """
+        if self._kv is None:
+            raise RuntimeError(
+                "prefill_and_export requires the paged KV cache")
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        rid = self.submit(
+            p, 1, eos, temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed, deadline=deadline, session=session)
+        self.run()
+        out = self._finished.get(rid, [])
+        if not out:  # shed at the door or at admission
+            return None, None
+        return out[0], self._kv.export_pages(p)
+
+    def enqueue_kv_import(self, doc: Dict[str, Any]) -> None:
+        """Queue a wire-received page doc for splicing (any thread);
+        the scheduler thread drains at the top of its next iteration."""
+        with self._kv_imports_lock:
+            self._kv_imports.append(doc)
+
+    def drain_kv_imports(self) -> int:
+        """Splice every queued page doc into the pool (scheduler thread
+        or a quiesced engine only — PagedKVCache is single-threaded).
+        Returns pages spliced; a rejected doc (geometry mismatch, pool
+        exhaustion) is dropped with a flight-recorder event — the next
+        request over that prefix just prefills locally."""
+        if self._kv is None:
+            return 0
+        spliced = 0
+        while True:
+            with self._kv_imports_lock:
+                if not self._kv_imports:
+                    break
+                doc = self._kv_imports.popleft()
+            try:
+                spliced += self._kv.import_pages(doc)
+            except (ValueError, RuntimeError) as e:
+                _events.record(
+                    "serving.kv_import_reject",
+                    f"{self._engine_label}: page import dropped ({e})",
+                    severity="warning", engine=self._engine_label)
+        return spliced
 
     # -- scheduler internals ---------------------------------------------- #
 

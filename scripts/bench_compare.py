@@ -62,6 +62,13 @@ LANES: Dict[str, int] = {
     # tenant regresses here even when occupancy improves
     "multiplex_goodput_ratio": +1,
     "multiplex_goodput_tight_ratio": +1,
+    # disaggregated prefill/decode serving (serving/disagg.py): the
+    # absolute rate, the cost of the wire hop against the same engine
+    # unified, and the prefix reuse the radix digest router exists for
+    "disagg_serving_tokens_per_s": +1,
+    "disagg_serving_relative": +1,
+    "disagg_serving_prefix_hit_rate": +1,
+    "lm_serving_paged_prefix_hit_rate": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

@@ -97,7 +97,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router", "profile", "sched", "slo")
+          "router", "profile", "sched", "slo", "disagg")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -108,8 +108,10 @@ UNIT_BY_TYPE = {
               "flops"),
 }
 #: span layers add "device" — device.xprof has no metric series —
-#: and "router" (the dispatch span, query/router.py)
-SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
+#: and "router" (the dispatch span, query/router.py) and "disagg"
+#: (the KV-page transfer span, serving/disagg.py)
+SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
+               "disagg")
 #: event layers additionally allow "core" (the core/log.py bridge),
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
@@ -119,10 +121,12 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router")
 #: (capture start/stop audit trail, obs/profile.py), and "sched" (the
 #: multi-tenant device scheduler: tenant lifecycle, bucket misses,
 #: starvation reliefs — nnstreamer_tpu/sched/), and "slo" (per-tenant
-#: SLO burn alerts/recoveries — obs/slo.py)
+#: SLO burn alerts/recoveries — obs/slo.py), and "disagg" (the
+#: prefill/decode split: re-prefill fallbacks + page spills,
+#: serving/disagg.py)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
                 "fleet", "resilience", "chaos", "router", "profile",
-                "sched", "slo")
+                "sched", "slo", "disagg")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -163,6 +167,12 @@ TENANT_LABEL = "tenant"
 #: RESILIENCE_DIR
 SCHED_LAYER = "sched"
 SCHED_DIR = "sched"
+
+#: the ``disagg`` metric/span/event layer is owned by the
+#: disaggregated-serving module alone (see module doc); matched like
+#: ROUTER_FILE
+DISAGG_LAYER = "disagg"
+DISAGG_FILE = ("serving", "disagg.py")
 
 #: label names must be legal Prometheus label identifiers
 LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -433,6 +443,51 @@ def check_router(root: Path = SOURCE_ROOT):
                 f"{_where(path, lineno)}: event {name!r} uses the "
                 f"{ROUTER_LAYER!r} layer outside "
                 f"nnstreamer_tpu/query/router.py")
+    return problems
+
+
+def _is_disagg_file(path: Path) -> bool:
+    return tuple(path.parts[-2:]) == DISAGG_FILE
+
+
+def check_disagg(root: Path = SOURCE_ROOT):
+    """Placement lint for the disaggregated-serving telemetry: every
+    ``disagg``-layer metric, span, and event is emitted from
+    nnstreamer_tpu/serving/disagg.py (engines and the router reach the
+    split through DisaggClient/DisaggWorker, never by minting disagg.*
+    names). The reverse direction stays loose on purpose — disagg.py
+    legitimately rides the ``router`` and ``serving`` layers via the
+    QueryRouter and kv_cache helpers it builds on. Mirrors
+    check_router."""
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        if m.group("layer") == DISAGG_LAYER and not _is_disagg_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{DISAGG_LAYER!r} layer outside "
+                f"nnstreamer_tpu/serving/disagg.py — disaggregation "
+                f"telemetry lives with the split")
+    for path, lineno, name in iter_span_sites(root):
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == DISAGG_LAYER and not _is_disagg_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: span {name!r} uses the "
+                f"{DISAGG_LAYER!r} layer outside "
+                f"nnstreamer_tpu/serving/disagg.py")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == DISAGG_LAYER and not _is_disagg_file(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{DISAGG_LAYER!r} layer outside "
+                f"nnstreamer_tpu/serving/disagg.py")
     return problems
 
 

@@ -111,6 +111,14 @@ class ProfileRule(_NamingRule):
 
 
 @register_rule
+class DisaggRule(_NamingRule):
+    id = "naming/disagg"
+    description = ("disagg telemetry is registered in "
+                   "serving/disagg.py alone")
+    checks = (_compat.check_disagg,)
+
+
+@register_rule
 class SloRule(_NamingRule):
     id = "naming/slo"
     description = ("slo telemetry is registered in obs/slo.py and the "

@@ -199,6 +199,51 @@ def test_kv_flag_validation_rejected(argv, monkeypatch):
     assert "NNS_LM_KV_PAGES" not in os.environ
 
 
+def test_role_and_disagg_flags_set_env_transport(monkeypatch):
+    # --role/--disagg export NNS_LM_ROLE/NNS_LM_DISAGG before the run,
+    # so every LMEngine built inside picks its disagg role up
+    import os
+
+    monkeypatch.delenv("NNS_LM_ROLE", raising=False)
+    monkeypatch.delenv("NNS_LM_DISAGG", raising=False)
+    monkeypatch.delenv("NNS_LM_KV_PAGE_SIZE", raising=False)
+    rc = cli_main(["--kv-page-size", "8", "--role", "decode",
+                   "--disagg", "127.0.0.1:7001;127.0.0.1:7002",
+                   "--timeout", "30",
+                   "videotestsrc num-buffers=2 width=8 height=8 ! "
+                   "tensor_converter ! tensor_sink"])
+    try:
+        assert rc == 0
+        assert os.environ["NNS_LM_ROLE"] == "decode"
+        assert os.environ["NNS_LM_DISAGG"] \
+            == "127.0.0.1:7001;127.0.0.1:7002"
+    finally:
+        os.environ.pop("NNS_LM_ROLE", None)
+        os.environ.pop("NNS_LM_DISAGG", None)
+        os.environ.pop("NNS_LM_KV_PAGE_SIZE", None)
+
+
+@pytest.mark.parametrize("argv", [
+    ["--role", "prefill"],                    # role needs the paged cache
+    ["--role", "supervisor", "--kv-page-size", "8"],   # unknown role
+    ["--disagg", "127.0.0.1:7001"],           # no ';' split
+    ["--disagg", ";127.0.0.1:7002"],          # empty prefill side
+    ["--disagg", "127.0.0.1:7001;oops"],      # unparsable decode side
+], ids=["role-no-paging", "bad-role", "no-split", "empty-side",
+        "bad-endpoint"])
+def test_role_disagg_validation_rejected(argv, monkeypatch):
+    import os
+
+    monkeypatch.delenv("NNS_LM_ROLE", raising=False)
+    monkeypatch.delenv("NNS_LM_DISAGG", raising=False)
+    with pytest.raises(SystemExit) as ei:
+        cli_main(argv + ["videotestsrc num-buffers=1 ! tensor_converter "
+                         "! tensor_sink"])
+    assert ei.value.code == 2
+    assert "NNS_LM_ROLE" not in os.environ
+    assert "NNS_LM_DISAGG" not in os.environ
+
+
 @pytest.mark.parametrize("argv", [
     ["--hedge-ms", "5"],                                # hedging is routed-only
     ["--backends", "nonsense"],                         # not host:port

@@ -419,6 +419,46 @@ class TestWireRules:
             """, ["wire/struct-format"])
         assert res.findings == []
 
+    def test_kv_page_xfer_without_dispatch_fires(self, tmp_path):
+        # seeded regression for the Cmd value the disaggregated-serving
+        # split added: declaring KV_PAGE_XFER without a server dispatch
+        # arm must fire wire/cmd-dispatch
+        res = lint_snippet(tmp_path, """
+            import enum
+
+            class Cmd(enum.IntEnum):
+                DATA = 5
+                OBS_PUSH = 12
+                KV_PAGE_XFER = 13
+
+            def dispatch(c):
+                if c is Cmd.DATA:
+                    return "data"
+                if c is Cmd.OBS_PUSH:
+                    return "push"
+            """, ["wire/cmd-dispatch"])
+        assert len(res.findings) == 1
+        assert res.findings[0].anchor == "Cmd.KV_PAGE_XFER"
+
+    def test_kv_page_xfer_dispatched_silent(self, tmp_path):
+        res = lint_snippet(tmp_path, """
+            import enum
+
+            class Cmd(enum.IntEnum):
+                DATA = 5
+                OBS_PUSH = 12
+                KV_PAGE_XFER = 13
+
+            def dispatch(c):
+                if c is Cmd.DATA:
+                    return "data"
+                if c is Cmd.OBS_PUSH:
+                    return "push"
+                if c is Cmd.KV_PAGE_XFER:
+                    return "xfer"
+            """, ["wire/cmd-dispatch"])
+        assert res.findings == []
+
 
 # --------------------------------------------------------------------------- #
 # naming family (the migrated check_metric_names checks)
@@ -624,6 +664,72 @@ class TestSloPlacement:
         problems = naming_compat.check_profile(root)
         assert len(problems) == 1
         assert "nnstpu_serving_hit_ratio" in problems[0]
+
+
+# --------------------------------------------------------------------------- #
+# disagg placement (naming/disagg via naming_compat.check_disagg)
+# --------------------------------------------------------------------------- #
+
+class TestDisaggPlacement:
+    """check_disagg ownership: disagg-layer metrics, spans, and events
+    live in nnstreamer_tpu/serving/disagg.py alone — the prefill/decode
+    split's telemetry is not minted by the engines or router it rides
+    on."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_disagg_metric_outside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"query/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_disagg_pages_sent_total", "h", ())
+            """})
+        problems = naming_compat.check_disagg(root)
+        assert len(problems) == 1
+        assert "disaggregation" in problems[0]
+
+    def test_disagg_span_outside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/lm_engine.py": """
+            def ship(store):
+                with store.start_span("disagg.xfer"):
+                    pass
+            """})
+        problems = naming_compat.check_disagg(root)
+        assert len(problems) == 1
+        assert "disagg.xfer" in problems[0]
+
+    def test_disagg_event_outside_file_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"query/router.py": """
+            def warn(events):
+                events.record("disagg.reprefill", "warning", msg="x")
+            """})
+        problems = naming_compat.check_disagg(root)
+        assert len(problems) == 1
+        assert "disagg.reprefill" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "serving/disagg.py": """
+                def setup(reg, events, store):
+                    reg.counter("nnstpu_disagg_pages_sent_total", "h", ())
+                    reg.histogram("nnstpu_disagg_xfer_seconds", "h", ())
+                    events.record("disagg.reprefill", "warning", msg="r")
+                    with store.start_span("disagg.xfer"):
+                        pass
+                """,
+            "serving/kv_cache.py": """
+                def setup(reg):
+                    reg.counter("nnstpu_serving_kv_offloads_total", "h", ())
+                """,
+        })
+        assert naming_compat.check_disagg(root) == []
 
 
 # --------------------------------------------------------------------------- #
