@@ -400,6 +400,87 @@ def _adaptive_bench(labels_path: str) -> dict:
         return {}
 
 
+def _epilogue_fusion_lane(device) -> dict:
+    """Epilogue fusion (ops/epilogue.py) on the composite detection
+    pipeline: ssd_mobilenet → identity tensor_transform → bounding_box
+    decoder, fused (post-chain compiled into the filter's jit: one XLA
+    dispatch per frame, D2H ships the NMS'd (K,6) rows) vs unfused
+    (filter + transform + decoder device-reduce each dispatch
+    separately). Dispatches-per-frame comes from the profiler's
+    kind="dispatch" records — the same accounting obs/profile.py uses —
+    so the claimed collapse is measured, not inferred. Output is
+    bit-identical between the two runs (pinned by tests/test_epilogue.py);
+    this lane only measures rate and dispatch count."""
+    import tempfile
+    import traceback
+
+    try:
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.models.ssd_mobilenet import write_box_priors
+        from nnstreamer_tpu.obs import profile as _prof
+
+        size, n_frames, warm = 300, 160, 16
+        with tempfile.TemporaryDirectory() as td:
+            priors = os.path.join(td, "box_priors.txt")
+            write_box_priors(priors, size=size)
+
+            def run(auto_fuse):
+                _prof.enable()
+                _prof.profiler().reset()
+                p = Pipeline()
+                p.auto_fuse = auto_fuse
+                src = p.add_new("videotestsrc", width=size, height=size,
+                                num_buffers=warm + n_frames,
+                                pattern="random")
+                conv = p.add_new("tensor_converter")
+                filt = p.add_new(
+                    "tensor_filter", framework="xla-tpu",
+                    model=f"zoo://ssd_mobilenet_v2?size={size}"
+                          f"&num_classes=91")
+                # value-neutral post stage (same-dtype typecast): gives
+                # the fuser a transform to absorb and the unfused run an
+                # honest extra per-frame dispatch to count
+                tpost = p.add_new("tensor_transform", mode="typecast",
+                                  option="float32")
+                dec = p.add_new("tensor_decoder", mode="bounding_box",
+                                option1="mobilenet-ssd", option3=priors,
+                                option4=f"{size}:{size}",
+                                option5=f"{size}:{size}",
+                                async_depth=DECODE_DEPTH)
+                sink = p.add_new("tensor_sink")
+                arrivals = []
+                sink.new_data = lambda buf: arrivals.append(time.monotonic())
+                Pipeline.link(src, conv, filt, tpost, dec, sink)
+                p.run(timeout=600)
+                dispatches = len(_prof.profiler().records(kind="dispatch"))
+                _prof.disable()
+                _, med = _windowed_fps(arrivals, warm, DECODE_DEPTH)
+                dpf = dispatches / max(len(arrivals), 1)
+                return med, dpf, p._epilogue_count
+
+            _mark("epilogue fusion lane: fused run starting")
+            fused_med, fused_dpf, n_stages = run(True)
+            _mark("epilogue fusion lane: unfused run starting")
+            unfused_med, unfused_dpf, _ = run(False)
+        row = {
+            "epilogue_fusion_fps_median": round(fused_med, 2),
+            "epilogue_fusion_unfused_fps_median": round(unfused_med, 2),
+            "epilogue_fusion_speedup": round(fused_med / unfused_med, 3)
+            if unfused_med else None,
+            "epilogue_fusion_dispatches_per_frame": round(fused_dpf, 3),
+            "epilogue_fusion_unfused_dispatches_per_frame":
+                round(unfused_dpf, 3),
+            "epilogue_fusion_dispatch_ratio":
+                round(unfused_dpf / fused_dpf, 3) if fused_dpf else None,
+            "epilogue_fusion_stages_fused": n_stages,
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _multiplex_lane(flops, device) -> dict:
     """N concurrent pipelines over ONE zoo bundle through one
     sched.DeviceEngine: the single dispatch loop coalesces same-shape
@@ -1839,6 +1920,9 @@ def main() -> None:
             result.update(_batch_sweep(labels_path, flops, device))
             _mark("adaptive batch bench starting")
             result.update(_adaptive_bench(labels_path))
+            if os.environ.get("BENCH_EPILOGUE_FUSION", "1") != "0":
+                _mark("epilogue fusion lane starting")
+                result.update(_epilogue_fusion_lane(device))
             _mark("transformer prefill bench starting")
             result.update(_transformer_bench())
             if os.environ.get("BENCH_LM_LONGCTX", "1") != "0":

@@ -67,6 +67,27 @@ class Decoder:
         larger than per-frame host work, so depth alone can't hide it."""
         return _ready(token)
 
+    # -- epilogue fusion (ops/epilogue.py) ----------------------------------- #
+    #: set by the epilogue fuser: the upstream filter's jit already ran
+    #: ``epilogue_reduce`` — buffers arrive carrying the reduced tensor
+    _fused_epilogue = False
+
+    def epilogue_reduce(self) -> Optional[Any]:
+        """A jax-traceable ``fn(model_output_tuple) -> reduced array`` the
+        epilogue fuser compiles INTO the upstream filter's XLA program, or
+        None when this decoder has no device reduction. When fused,
+        ``decode``/``submit`` receive buffers whose single memory holds the
+        reduce result (``_fused_epilogue`` is set by the fuser) and must be
+        bit-identical to the unfused path."""
+        return None
+
+    def fusion_signature(self) -> str:
+        """Structural identity of the fused reduce for the sched
+        coalesce token: same mode+options ⇒ same reduce function."""
+        opts = ",".join(f"{k}={self.options.get(k)}"
+                        for k in sorted(self.options))
+        return f"{self.MODE}:{opts}"
+
 
 def _ready(obj: Any) -> bool:
     if isinstance(obj, TensorMemory):

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..core.buffer import Buffer, TensorMemory
 from ..core.types import Caps, TensorsConfig
+from ..obs import profile as _profile
 from .base import Decoder, register_decoder
 from .util import draw_rect, draw_text, load_labels, new_canvas, nms
 
@@ -158,57 +159,134 @@ class BoundingBox(Decoder):
     #: stream (submit/complete stays fully pipelined).
     PRE_NMS_TOPK = 256
 
+    def _make_reduce(self):
+        """``(jax reduce fn, arity)`` for this mode's device reduction
+        (arity = leading memories consumed; None = all), or None.
+
+        Every mode funnels into one shape: rank candidates (threshold
+        mask → ``top_k``, score -1 ⇒ unused slot), then the greedy NMS
+        sweep (ops.pallas.epilogue.nms_sweep — reference nms(),
+        tensordec-boundingbox.c:962-976: strict > suppresses), emitting
+        fixed (K, 6) rows [x0, y0, x1, y1, score, class]. The same jit
+        serves the async submit path and ``epilogue_reduce``."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.pallas import epilogue as _ep
+
+        threshold = float(self.threshold)
+        iou_thr = float(self.iou_threshold)
+        topk = self.PRE_NMS_TOPK
+
+        def nms_rows(bx0, by0, bx1, by1, top_score, cls_sel):
+            out_score = _ep.nms_sweep(
+                bx0, by0, bx1, by1, top_score,
+                iou_threshold=iou_thr, threshold=threshold)
+            return jnp.stack([bx0, by0, bx1, by1, out_score, cls_sel],
+                             axis=1)
+
+        if self.box_mode in ("mobilenet-ssd", "tflite-ssd"):
+            if self.priors is None:
+                return None
+            pr = jnp.asarray(self.priors, jnp.float32)
+
+            def reduce_ssd(locs, raw):
+                x0, y0, x1, y1, cls = ssd_box_math(jnp, locs, raw, pr)
+                best_score, best = _ep.class_reduce(cls)
+                k = min(topk, int(best_score.shape[0]))
+                # mask below-threshold anchors out before ranking so the
+                # K slots hold only real candidates (score -1 ⇒ unused)
+                masked = jnp.where(best_score >= threshold,
+                                   best_score, -1.0)
+                top_score, idx = jax.lax.top_k(masked, k)
+                return nms_rows(x0[idx], y0[idx], x1[idx], y1[idx],
+                                top_score,
+                                (best[idx] + 1).astype(jnp.float32))
+
+            return reduce_ssd, 2
+        if self.box_mode in ("mobilenet-ssd-postprocess", "tf-ssd",
+                             "tflite-ssd-postprocess"):
+            def reduce_post(boxes, classes, scores, *rest):
+                boxes = boxes.reshape(-1, 4).astype(jnp.float32)
+                classes = classes.reshape(-1).astype(jnp.float32)
+                scores = scores.reshape(-1).astype(jnp.float32)
+                m = int(scores.shape[0])
+                if rest:  # count tensor caps valid rows (input order)
+                    count = jnp.minimum(
+                        rest[0].reshape(-1)[0].astype(jnp.int32), m)
+                    valid = jnp.arange(m) < count
+                else:
+                    valid = jnp.ones((m,), bool)
+                masked = jnp.where(valid & (scores >= threshold),
+                                   scores, -1.0)
+                top_score, idx = jax.lax.top_k(masked, min(topk, m))
+                b = boxes[idx]  # rows are [ymin, xmin, ymax, xmax]
+                return nms_rows(b[:, 1], b[:, 0], b[:, 3], b[:, 2],
+                                top_score, classes[idx])
+
+            return reduce_post, None
+        if self.box_mode.startswith("ov-"):
+            def reduce_ov(rows):
+                r = rows.reshape(-1, 7).astype(jnp.float32)
+                masked = jnp.where(
+                    (r[:, 0] >= 0) & (r[:, 2] >= threshold), r[:, 2], -1.0)
+                top_score, idx = jax.lax.top_k(
+                    masked, min(topk, int(r.shape[0])))
+                rr = r[idx]
+                return nms_rows(rr[:, 3], rr[:, 4], rr[:, 5], rr[:, 6],
+                                top_score, rr[:, 1])
+
+            return reduce_ov, 1
+        return None
+
+    def epilogue_reduce(self):
+        made = self._make_reduce()
+        if made is None:
+            return None
+        reduce, arity = made
+
+        def fn(outs):
+            return reduce(*(outs if arity is None else outs[:arity]))
+
+        return fn
+
+    def _device_reduce_for(self, buf: Buffer):
+        """(jitted reduce, memories) when every consumed memory is already
+        device-resident — host tensors decode on host for free instead."""
+        if not hasattr(self, "_device_reduce"):
+            import jax
+
+            made = self._make_reduce()
+            self._device_reduce = None if made is None \
+                else (jax.jit(made[0]), made[1])
+        dr = self._device_reduce
+        if dr is None:
+            return None
+        fn, arity = dr
+        if arity is not None and buf.num_tensors < arity:
+            return None
+        mems = buf.memories if arity is None else buf.memories[:arity]
+        if not mems or not all(m.is_device for m in mems):
+            return None
+        return fn, mems
+
     def submit(self, buf: Buffer, config: TensorsConfig):
-        if (self.box_mode in ("mobilenet-ssd", "tflite-ssd")
-                and self.priors is not None and buf.num_tensors >= 2
-                and buf.memories[0].is_device and buf.memories[1].is_device):
+        if self._fused_epilogue:
+            # the upstream filter's jit already ran the fused reduce:
+            # memories[0] holds the (K, 6) rows — keep the D2H in flight
+            mem = buf.memories[0]
+            mem.prefetch()
+            return (buf, mem)
+        red = self._device_reduce_for(buf)
+        if red is not None:
             # box decode + class max + threshold + top-K + greedy NMS, all
             # on device in one jit — complete() only filters kept rows
-            import jax
-            import jax.numpy as jnp
-
-            if not hasattr(self, "_device_reduce"):
-                pr = jnp.asarray(self.priors, jnp.float32)
-                threshold = float(self.threshold)
-                iou_thr = float(self.iou_threshold)
-
-                def reduce(locs, raw):
-                    x0, y0, x1, y1, cls = ssd_box_math(jnp, locs, raw, pr)
-                    best = jnp.argmax(cls, axis=1)
-                    best_score = jnp.max(cls, axis=1)
-                    k = min(self.PRE_NMS_TOPK, int(best_score.shape[0]))
-                    # mask below-threshold anchors out before ranking so the
-                    # K slots hold only real candidates (score -1 ⇒ unused)
-                    masked = jnp.where(best_score >= threshold, best_score, -1.0)
-                    top_score, idx = jax.lax.top_k(masked, k)
-                    bx0, by0, bx1, by1 = x0[idx], y0[idx], x1[idx], y1[idx]
-                    # greedy same-order NMS (reference nms(),
-                    # tensordec-boundingbox.c:962-976: strict > suppresses),
-                    # vectorized as a K-step masked sweep over the IoU matrix
-                    area = (bx1 - bx0) * (by1 - by0)
-                    ix = (jnp.minimum(bx1[:, None], bx1[None, :])
-                          - jnp.maximum(bx0[:, None], bx0[None, :]))
-                    iy = (jnp.minimum(by1[:, None], by1[None, :])
-                          - jnp.maximum(by0[:, None], by0[None, :]))
-                    inter = jnp.clip(ix, 0) * jnp.clip(iy, 0)
-                    union = area[:, None] + area[None, :] - inter
-                    iou = jnp.where(union > 0, inter / union, 0.0)
-                    later = jnp.arange(k)[None, :] > jnp.arange(k)[:, None]
-                    suppresses = (iou > iou_thr) & later
-
-                    def body(i, alive):
-                        return alive & ~(alive[i] & suppresses[i])
-
-                    alive = jax.lax.fori_loop(
-                        0, k, body, top_score >= threshold)
-                    out_score = jnp.where(alive, top_score, -1.0)
-                    return jnp.stack(
-                        [bx0, by0, bx1, by1, out_score,
-                         (best[idx] + 1).astype(jnp.float32)], axis=1)
-
-                self._device_reduce = jax.jit(reduce)
-            rows = TensorMemory(self._device_reduce(
-                buf.memories[0].device(), buf.memories[1].device()))
+            fn, mems = red
+            arrays = [m.device() for m in mems]
+            prof = _profile.DISPATCH_HOOK
+            out = prof.dispatch_fn(f"decode:{self.box_mode}", fn, *arrays) \
+                if prof is not None else fn(*arrays)
+            rows = TensorMemory(out)
             rows.prefetch()
             return (buf, rows)
         return super().submit(buf, config)
@@ -224,6 +302,10 @@ class BoundingBox(Decoder):
         return self.decode(token, config)
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
+        if self._fused_epilogue:
+            rows = np.asarray(buf.memories[0].host())
+            objs = rows[rows[:, 4] >= self.threshold]
+            return self._finish(objs, buf, suppressed=True)
         if self.box_mode in ("mobilenet-ssd", "tflite-ssd"):
             objs = self._objects_mobilenet_ssd(buf)
         elif self.box_mode in ("mobilenet-ssd-postprocess", "tf-ssd",

@@ -14,6 +14,7 @@ import numpy as np
 
 from ..core.buffer import Buffer, TensorMemory
 from ..core.types import Caps, TensorsConfig
+from ..obs import profile as _profile
 from .base import Decoder, register_decoder
 
 # 21-class PASCAL VOC palette (RGBA), class 0 = background transparent
@@ -51,34 +52,75 @@ class ImageSegment(Decoder):
         return Caps("video/x-raw", {"format": "RGBA", "width": w, "height": h,
                                     "framerate": config.rate})
 
+    def _colorize_fn(self):
+        """jax fn: logits/class-ids → (H, W, 4) RGBA canvas on device
+        (ops.pallas.epilogue.segment_colorize), or None for host-only
+        schemes (snpe-depth's min/max normalize is data-dependent)."""
+        if self.scheme not in ("tflite-deeplab", "snpe-deeplab"):
+            return None
+        import jax.numpy as jnp
+
+        from ..ops.pallas import epilogue as _ep
+
+        pre_argmaxed = self.scheme == "snpe-deeplab"
+
+        def fn(x):
+            if pre_argmaxed:
+                x = jnp.squeeze(x)
+            elif x.ndim == 4:
+                x = x[0]
+            return _ep.segment_colorize(x, _PALETTE,
+                                        pre_argmaxed=pre_argmaxed)
+
+        return fn
+
+    def epilogue_reduce(self):
+        fn = self._colorize_fn()
+        return None if fn is None else (lambda outs: fn(outs[0]))
+
     def submit(self, buf: Buffer, config: TensorsConfig):
         m = buf.memories[0]
-        if m.is_device and self.scheme == "tflite-deeplab":
-            # argmax on device: D2H ships H*W uint8 class ids, not the
-            # H*W*classes float logits (21x smaller for deeplab-v3)
-            import jax
-            import jax.numpy as jnp
+        if self._fused_epilogue:
+            # upstream filter already ran argmax+colorize: memories[0]
+            # holds the RGBA canvas — keep the D2H in flight
+            m.prefetch()
+            return (buf, m)
+        if m.is_device:
+            # argmax + palette on device: D2H ships the H*W*4 uint8
+            # canvas, not the H*W*classes float logits, and the per-pixel
+            # host NumPy gather disappears from the frame loop
+            fn = self._colorize_fn()
+            if fn is not None:
+                import jax
 
-            if not hasattr(self, "_argmax"):
-                self._argmax = jax.jit(
-                    lambda x: jnp.argmax(x, axis=-1).astype(jnp.uint8))
-            cls_mem = TensorMemory(self._argmax(m.device()))
-            cls_mem.prefetch()
-            return (buf, cls_mem)
+                if not hasattr(self, "_colorize_jit"):
+                    self._colorize_jit = jax.jit(fn)
+                prof = _profile.DISPATCH_HOOK
+                out = prof.dispatch_fn(f"decode:{self.scheme}",
+                                       self._colorize_jit, m.device()) \
+                    if prof is not None else self._colorize_jit(m.device())
+                canvas_mem = TensorMemory(out)
+                canvas_mem.prefetch()
+                return (buf, canvas_mem)
         return super().submit(buf, config)
 
     def complete(self, token, config: TensorsConfig) -> Buffer:
         if isinstance(token, tuple):
-            buf, cls_mem = token
-            classes = cls_mem.host()
-            if classes.ndim == 3:
-                classes = classes[0]
-            canvas = _PALETTE[classes]
+            buf, mem = token
+            canvas = np.asarray(mem.host())
+            if canvas.ndim == 4:
+                canvas = canvas[0]
             return buf.with_memories([TensorMemory(np.ascontiguousarray(canvas))])
         return self.decode(token, config)
 
     def decode(self, buf: Buffer, config: TensorsConfig) -> Buffer:
         arr = buf.memories[0].host()
+        if self._fused_epilogue:
+            canvas = np.asarray(arr)
+            if canvas.ndim == 4:
+                canvas = canvas[0]
+            return buf.with_memories(
+                [TensorMemory(np.ascontiguousarray(canvas))])
         if self.scheme == "tflite-deeplab":
             if arr.ndim == 4:
                 arr = arr[0]

@@ -62,6 +62,8 @@ class TensorConverter(Element):
         self._out_config: Optional[TensorsConfig] = None
         self._pending: List[Buffer] = []
         self._custom = None
+        # set by ops.epilogue: static passthrough skips the host round trip
+        self._fused_passthrough = False
 
     # -- negotiation --------------------------------------------------------- #
     def on_caps(self, pad: Pad, caps: Caps) -> None:
@@ -209,6 +211,13 @@ class TensorConverter(Element):
                                            config=self._out_config))
 
     def _chain_tensors(self, buf: Buffer) -> Optional[FlowReturn]:
+        if self._fused_passthrough and self._out_config is not None:
+            # ops.epilogue enrolled this static tensors→tensors identity:
+            # forward without the per-memory host round trip (the upstream
+            # XLA filter emits static device tensors matching caps, so the
+            # flex-unwrap probe below can never apply)
+            return self.push(buf.with_memories(buf.memories,
+                                               config=self._out_config))
         # flexible → static: strip per-buffer flex headers if payload is raw,
         # else trust memory shapes; declare static caps from the first buffer
         mems = []

@@ -18,6 +18,7 @@ from typing import Any, List, Optional
 from ..core.buffer import Buffer, TensorMemory
 from ..core.types import Caps, TensorsConfig, TensorsInfo
 from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..obs import profile as _profile
 from ..ops import transform_ops
 
 
@@ -37,6 +38,8 @@ class TensorTransform(Element):
         self._jitted = None
         self._out_config: Optional[TensorsConfig] = None
         self._fused = False  # set by ops.fusion: math runs inside the filter's jit
+        # set by ops.epilogue: math runs inside the UPSTREAM filter's jit
+        self._fused_post = False
 
     def _build(self) -> transform_ops.Transform:
         if self.transform_chain:
@@ -65,10 +68,19 @@ class TensorTransform(Element):
         self.send_caps_all(Caps.tensors(self._out_config))
 
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
-        if self._fused:  # math happens inside the downstream filter's jit
+        if self._fused or self._fused_post:
+            # math happens inside the adjacent filter's jit (ops.fusion
+            # upstream / ops.epilogue downstream)
             return self.push(buf.with_memories(buf.memories,
                                                config=self._out_config))
-        outs = [TensorMemory(self._jitted(m.device())) for m in buf.memories]
+        prof = _profile.DISPATCH_HOOK
+        if prof is not None:
+            outs = [TensorMemory(prof.dispatch_fn(
+                f"transform:{self.name}", self._jitted, m.device()))
+                for m in buf.memories]
+        else:
+            outs = [TensorMemory(self._jitted(m.device()))
+                    for m in buf.memories]
         return self.push(buf.with_memories(outs, config=self._out_config))
 
     def as_jax_fn(self):

@@ -296,11 +296,35 @@ class XLAFilter(FilterFramework):
         self._device = sharding if sharding is not None \
             else self.props.accelerator.pick_device()
 
-    def set_fused_preprocess(self, pre) -> None:
+    def set_fused_preprocess(self, pre, token: Optional[str] = None) -> None:
         """Install a jax-traceable per-tensor preprocessing stage compiled
         into the same XLA program (ops.fusion pass)."""
         self._fused_pre = pre
+        self._extend_coalesce_token("pre", token)
         self._build_jit()
+
+    def set_fused_epilogue(self, post, token: Optional[str] = None) -> None:
+        """Install a jax-traceable post-processing stage compiled into the
+        same XLA program (ops.epilogue pass): applied to the output tuple
+        after the stream-layout restore, so a filter→transform/decoder
+        tail runs as ONE dispatch per frame. Caps inference still reports
+        the model's own (unreduced) outputs — downstream fused elements
+        negotiate the unreduced stream and forward/consume the fused
+        result (see ``_infer_out_info``)."""
+        self._fused_post = post
+        self._epilogue_label = (f"{self._bundle.name}+post[{token}]"
+                                if self._bundle is not None and token
+                                else None)
+        self._extend_coalesce_token("post", token)
+        self._build_jit()
+
+    def _extend_coalesce_token(self, kind: str, token: Optional[str]) -> None:
+        """Two filters sharing one bundle but fused with DIFFERENT chains
+        compute different functions — the sched engine must not coalesce
+        them. Structural signatures (not ``id()``) extend the token, so
+        identical chains still batch together."""
+        if getattr(self, "coalesce_token", None) is not None:
+            self.coalesce_token = self.coalesce_token + ((kind, token),)
 
     def _build_jit(self) -> None:
         """Compile (or reuse) the bundle's XLA program. The jit cache
@@ -314,6 +338,7 @@ class XLAFilter(FilterFramework):
         fn = self._bundle.fn()
         precision = self._precision
         pre = getattr(self, "_fused_pre", None)
+        post = getattr(self, "_fused_post", None)
         in_layout = getattr(self, "_in_layout", ())
         out_layout = getattr(self, "_out_layout", ())
 
@@ -369,11 +394,18 @@ class XLAFilter(FilterFramework):
                         fn(*(stage_jit(i, x) for i, x in enumerate(xs))))))
             else:
                 self._jitted = lambda *xs: _as_tuple(fn(*xs))
+            self._infer_fn = self._jitted
+            if post is not None:
+                # fused epilogue as its own (sharding-preserving) jitted
+                # stage, mirroring the preprocess staging above
+                base = self._jitted
+                epi = jax.jit(lambda *ys: tuple(post(ys)))
+                self._jitted = lambda *xs: epi(*base(*xs))
             return
-        # fused-preprocess programs are per-pipeline objects: caching them
-        # on a (memoized, process-lifetime) bundle would leak one compiled
-        # executable per pipeline construction and never actually share
-        cache = None if pre is not None \
+        # fused-preprocess/epilogue programs are per-pipeline objects:
+        # caching them on a (memoized, process-lifetime) bundle would leak
+        # one compiled executable per pipeline and never actually share
+        cache = None if pre is not None or post is not None \
             else self._bundle.metadata.setdefault("_jit_cache", {})
         cache_key = (precision, self._donate, in_layout, out_layout)
         if cache is not None:
@@ -382,9 +414,10 @@ class XLAFilter(FilterFramework):
                 if _profile.DISPATCH_HOOK is not None:
                     _profile.DISPATCH_HOOK.on_jit_cache("bundle", True)
                 self._jitted = hit
+                self._infer_fn = hit
                 return
 
-        def wrapped(*xs):
+        def wrapped_base(*xs):
             # fused preprocess BEFORE the layout permute (inputlayout
             # describes the fused transform's output stream — see stage())
             if pre is not None:
@@ -399,10 +432,20 @@ class XLAFilter(FilterFramework):
             return tuple(to_stream_layout(j, y)
                          for j, y in enumerate(_as_tuple(fn(*xs))))
 
+        def wrapped(*xs):
+            # fused epilogue AFTER the stream-layout restore: the chain it
+            # replaces consumed the filter's wire outputs
+            ys = wrapped_base(*xs)
+            return tuple(post(ys)) if post is not None else ys
+
         kw: Dict[str, Any] = {}
         if self._donate:
             kw["donate_argnums"] = tuple(range(8))
         self._jitted = jax.jit(wrapped, **kw)
+        # caps inference must see the model's own (unreduced) outputs —
+        # the fused epilogue's reduce is invisible to negotiation
+        self._infer_fn = jax.jit(wrapped_base) if post is not None \
+            else self._jitted
         if cache is not None:
             cache[cache_key] = self._jitted
             if _profile.DISPATCH_HOOK is not None:
@@ -427,7 +470,8 @@ class XLAFilter(FilterFramework):
         import jax
 
         specs = [jax.ShapeDtypeStruct(i.shape, i.dtype.np_dtype) for i in in_info]
-        out = jax.eval_shape(self._jitted, *specs)
+        infer = getattr(self, "_infer_fn", None) or self._jitted
+        out = jax.eval_shape(infer, *specs)
         infos = tuple(TensorInfo.from_shape(o.shape if o.shape else (1,), o.dtype)
                       for o in out)
         return TensorsInfo(infos)

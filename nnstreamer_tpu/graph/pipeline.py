@@ -284,8 +284,10 @@ class Pipeline:
         self._lock = threading.Lock()
         self.running = False
         #: fuse transform→filter chains into one XLA program at start
+        #: (ops.fusion upstream; ops.epilogue mirrors it downstream)
         self.auto_fuse = True
         self._fused_count = 0
+        self._epilogue_count = 0
         #: opt-in multi-tenant dispatch (sched.DeviceEngine): when set,
         #: start() enrolls this pipeline as a tenant — its filters'
         #: invokes coalesce with other tenants' on one dispatch loop.
@@ -362,6 +364,14 @@ class Pipeline:
                 if not el.is_source:
                     el.start()
                     el.started = True
+            # downstream mirror of fuse_chains: runs AFTER non-sources
+            # started (decoder instances exist, filter backends are open)
+            # and BEFORE sched enrollment (coalesce tokens must be final
+            # when the engine starts keying batches)
+            if self.auto_fuse:
+                from ..ops.epilogue import fuse_epilogues
+
+                self._epilogue_count = fuse_epilogues(self)
             # multi-tenant dispatch opt-in: enroll AFTER non-sources
             # started (filter backends are open) and BEFORE any source
             # thread pushes, so the first buffer already coalesces.

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core.types import TensorsInfo
 from ..ops.int8 import matmul_any as _mm
+from ..ops.int8 import mlp_matmul as _mlp
 from ..ops.int8 import quantize_weight, stack_shape
 from .zoo import ModelBundle, register_model
 
@@ -126,7 +127,7 @@ def _block_body(h, layer, mask, n_heads, attention_fn=None):
     o = o.transpose(0, 2, 1, 3).reshape(h.shape)
     h = h + _mm(o, wo)
     m = _ln(h, ln2)
-    return h + _mm(jax.nn.gelu(_mm(m, w1)), w2), kh, vh
+    return h + _mlp(m, w1, w2), kh, vh
 
 
 def _layer_stack(params):
@@ -363,7 +364,7 @@ def _lm_verify_window(params, tokens, kcache, vcache, pos, n_heads):
         o = o.transpose(0, 2, 1, 3).reshape(h.shape)
         h = h + _mm(o, wo)
         m = _ln(h, ln2)
-        return (h + _mm(jax.nn.gelu(_mm(m, w1)), w2), kc, vc), None
+        return (h + _mlp(m, w1, w2), kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(
         block, (x, kc, vc),

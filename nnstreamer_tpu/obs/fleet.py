@@ -180,9 +180,15 @@ class FleetPusher:
                  instance: Optional[str] = None, role: str = "worker",
                  registry: Optional[_metrics.MetricsRegistry] = None,
                  health_registry: Optional[_health.HealthRegistry] = None,
-                 span_store: Optional[_tracing.SpanStore] = None):
+                 span_store: Optional[_tracing.SpanStore] = None,
+                 kv_digest: Optional[Any] = None):
         self.instance = instance or default_instance()
         self.role = role
+        # per-pusher digest source; None defers to the module-level
+        # KV_DIGEST_HOOK inside build_push (serving/disagg.py installs
+        # that hook when a worker starts, so a plain FleetPusher next to
+        # a DisaggWorker advertises the digest with no extra wiring)
+        self._kv_digest = kv_digest
         self.interval_s = max(float(interval_s), 0.05)
         self._registry = registry
         self._health_registry = health_registry
@@ -224,7 +230,10 @@ class FleetPusher:
                           interval_s=self.interval_s,
                           registry=self._registry,
                           health_registry=self._health_registry,
-                          span_store=self._store)
+                          span_store=self._store,
+                          kv_prefix=(self._kv_digest()
+                                     if self._kv_digest is not None
+                                     else None))
 
     # -- HTTP channel --------------------------------------------------- #
     def _loop(self) -> None:

@@ -306,7 +306,8 @@ class Profiler:
         dispatch, HLO cost once per shape signature. Called with the
         bundle's dispatch lock held — same exclusion as the bare call."""
         jitted = bundle._jitted
-        label = getattr(getattr(bundle, "_bundle", None), "name", None) \
+        label = getattr(bundle, "_epilogue_label", None) \
+            or getattr(getattr(bundle, "_bundle", None), "name", None) \
             or type(bundle).__name__
         shapes = tuple(tuple(int(d) for d in a.shape) for a in arrays)
         dtypes = tuple(str(a.dtype) for a in arrays)
@@ -352,6 +353,67 @@ class Profiler:
             self._update_util("xla", cost["flops"], cost["bytes"],
                               device_ns / 1e9)
         return outs
+
+    def dispatch_fn(self, label: str, fn: Any, *arrays: Any) -> Any:
+        """Profiled dispatch for auxiliary jits that are not XLAFilter
+        bundles — unfused transform-element math and decoder device
+        reduces. Each call appends one kind="dispatch" record under the
+        caller's explicit label, so dispatches-per-frame on a pipeline is
+        simply the dispatch-record count over the frame count."""
+        shapes = tuple(tuple(int(d) for d in a.shape) for a in arrays)
+        dtypes = tuple(str(a.dtype) for a in arrays)
+        with self._lock:
+            self._n_dispatch += 1
+            sync = self._n_dispatch % self.sample_every == 0
+            last = self._last_done_ns.get(label)
+        t0 = time.monotonic_ns()
+        outs = fn(*arrays)
+        t1 = time.monotonic_ns()
+        device_ns = None
+        if sync:
+            try:
+                import jax
+                jax.block_until_ready(outs)
+                device_ns = time.monotonic_ns() - t0
+            except Exception:
+                device_ns = None
+        done = time.monotonic_ns()
+        gap_ns = max(t0 - last, 0) if last is not None else None
+        with self._lock:
+            self._last_done_ns[label] = done
+        self._append({
+            "kind": "dispatch", "label": str(label), "t0_ns": t0,
+            "dur_ns": t1 - t0, "device_ns": device_ns, "gap_ns": gap_ns,
+            "tid": threading.get_ident(),
+            "args": {"shapes": shapes, "dtypes": dtypes},
+        })
+        if self._m is not None:
+            self._m["dispatch"].labels("xla", "host").observe(
+                (t1 - t0) / 1e9)
+            if device_ns is not None:
+                self._m["dispatch"].labels("xla", "device").observe(
+                    device_ns / 1e9)
+        return outs
+
+    # -- epilogue fusion advice (ops/epilogue.py) ----------------------- #
+    def epilogue_select(self, filter_label: str,
+                        chain_labels: List[str]) -> bool:
+        """Cost-sample-driven fuse/don't-fuse advice for one candidate
+        chain. With no host-lane element records for the chain's stages
+        (cold profiler, fresh pipeline) fusion proceeds unconditionally —
+        the fused program is never slower than per-stage dispatch unless
+        the stages were already free. Only when observed element records
+        say the whole chain costs under ~1µs of host time combined do we
+        decline, keeping the jit-cache signature stable for nothing."""
+        del filter_label
+        per: Dict[str, List[int]] = {}
+        for r in self.records(kind="element"):
+            per.setdefault(r["label"], []).append(int(r["dur_ns"]))
+        seen = [per[c] for c in chain_labels if c in per]
+        if not seen:
+            return True
+        combined = sum(sum(d) / len(d) for d in seen)
+        return combined >= 1_000.0
 
     def _device_kind(self, arrays: Any) -> str:
         for a in arrays:
@@ -776,6 +838,11 @@ def enable(max_records: Optional[int] = None,
         _gel.PROFILE_CHAIN_HOOK = p.profiled_chain
     except ImportError:  # mid-import of graph: pipeline hooks come later
         pass
+    try:
+        from ..ops import epilogue as _epi
+        _epi.EPILOGUE_SELECT_HOOK = p.epilogue_select
+    except ImportError:
+        pass
     _events.record("profile.capture_start",
                    f"profiling on (ring={p._records.maxlen}, "
                    f"sync every {p.sample_every})")
@@ -797,6 +864,11 @@ def disable() -> None:
     try:
         from ..graph import element as _gel
         _gel.PROFILE_CHAIN_HOOK = None
+    except ImportError:
+        pass
+    try:
+        from ..ops import epilogue as _epi
+        _epi.EPILOGUE_SELECT_HOOK = None
     except ImportError:
         pass
 

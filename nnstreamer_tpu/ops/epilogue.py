@@ -1,0 +1,149 @@
+"""Epilogue fusion: compile post-filter chains INTO the filter's XLA program.
+
+The downstream mirror of ops.fusion: where that pass absorbs the
+``tensor_transform* → tensor_filter`` prologue, this one rewrites linear
+``tensor_filter(xla) → tensor_transform*/tensor_converter/tensor_decoder``
+tails so the composed post-processing runs as an epilogue stage *inside*
+the filter's jit — one dispatch per frame instead of one per element, and
+for reduce-capable decoders (SSD box decode + NMS, segmentation
+argmax+colorize) the D2H readback shrinks from the full model output to
+the reduced result.
+
+Enrolled elements stay in the graph for caps negotiation but forward
+buffers untouched (transforms/converters) or consume the pre-reduced
+tensor (decoders). Fused output is bit-identical to the unfused chain —
+the epilogue applies exactly the fns the elements would have applied.
+
+Applied automatically in ``Pipeline.start()`` after elements are started
+(disable with ``pipeline.auto_fuse = False``). Selection is
+profiler-driven when profiling is on: ``EPILOGUE_SELECT_HOOK`` is
+consulted with the filter and chain labels and can veto a fusion whose
+measured chain cost is negligible; when the hook is None (the default)
+eligible chains fuse unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..core.log import logger
+
+log = logger("epilogue")
+
+#: Selection hook: ``fn(filter_label, chain_labels) -> bool`` (True =
+#: fuse). None (default) = fuse every eligible chain. obs.profile's
+#: ``enable()`` installs ``Profiler.epilogue_select`` so fusion decisions
+#: follow measured per-element cost; ``disable()`` clears it. Gate every
+#: use with a single None check (zero-overhead-when-off contract).
+EPILOGUE_SELECT_HOOK: Optional[Callable[[str, List[str]], bool]] = None
+
+
+def _transform_signature(t: Any) -> str:
+    """Structural identity of a transform stage (coalesce-token part:
+    same mode/options ⇒ same composed function)."""
+    if t.transform_chain:
+        inner = ";".join(f"{m}:{o}" for m, o in t.transform_chain)
+        return f"transform[{inner}]"
+    return f"transform[{t.mode}:{t.option}]"
+
+
+def fuse_epilogues(pipeline: Any) -> int:
+    """Fuse eligible downstream chains; returns stages fused away.
+
+    Runs after ``Element.start()`` (decoder instances must exist) and
+    before scheduler attach (the filters' ``coalesce_token`` must be
+    final when the engine starts keying batches).
+    """
+    from ..elements.converter import TensorConverter
+    from ..elements.decoder import TensorDecoder
+    from ..elements.filter import TensorFilter
+    from ..elements.transform import TensorTransform
+    from ..filters.xla import XLAFilter
+
+    fused = 0
+    for el in pipeline.elements.values():
+        if not isinstance(el, TensorFilter) or len(el.src_pads) != 1:
+            continue
+        try:
+            el._open_fw()
+        except Exception:  # noqa: BLE001 — config errors surface at start()
+            continue
+        fw = el.fw
+        if not isinstance(fw, XLAFilter):
+            continue
+        if getattr(fw, "flexible_output", False):
+            continue  # bucket ladder emits variable rows; caps won't pin
+        if el._out_spec is not None:
+            continue  # output combination reorders memories downstream
+
+        stages: List[Tuple[str, Any]] = []
+        decoder_stage: Optional[Tuple[Any, Any, Callable]] = None
+        pad = el.src_pads[0]
+        while pad.peer is not None:
+            down = pad.peer.element
+            if isinstance(down, TensorTransform) and len(down.sink_pads) == 1 \
+                    and len(down.src_pads) == 1 and not down._fused \
+                    and not down._fused_post:
+                stages.append(("transform", down))
+                pad = down.src_pads[0]
+                continue
+            if isinstance(down, TensorConverter) and len(down.sink_pads) == 1 \
+                    and len(down.src_pads) == 1 \
+                    and down.mode in (None, "auto") \
+                    and int(down.frames_per_tensor) == 1 \
+                    and not down._fused_passthrough:
+                # static tensors→tensors passthrough: identity math, but
+                # enrolling skips the per-frame host round trip
+                stages.append(("converter", down))
+                pad = down.src_pads[0]
+                continue
+            if isinstance(down, TensorDecoder) and len(down.sink_pads) == 1:
+                dec = down._decoder
+                red = dec.epilogue_reduce() if dec is not None else None
+                if red is not None and not getattr(dec, "_fused_epilogue",
+                                                   False):
+                    decoder_stage = (down, dec, red)
+            break
+        if not stages and decoder_stage is None:
+            continue
+
+        labels = [s[1].name for s in stages]
+        if decoder_stage is not None:
+            labels.append(decoder_stage[0].name)
+        if EPILOGUE_SELECT_HOOK is not None \
+                and not EPILOGUE_SELECT_HOOK(el.name, labels):
+            log.info("epilogue fusion skipped for %s: profiler reports "
+                     "chain %s cost negligible", el.name, labels)
+            continue
+
+        fns: List[Callable] = []
+        sig_parts: List[str] = []
+        count = 0
+        for kind, t in stages:
+            if kind == "transform":
+                f = t.as_jax_fn()
+                fns.append(lambda outs, _f=f: tuple(_f(y) for y in outs))
+                t._fused_post = True
+                sig_parts.append(_transform_signature(t))
+            else:
+                t._fused_passthrough = True
+                sig_parts.append("converter[passthrough]")
+            count += 1
+        if decoder_stage is not None:
+            dec_el, dec, red = decoder_stage
+            fns.append(lambda outs, _r=red: (_r(outs),))
+            dec._fused_epilogue = True
+            sig_parts.append(f"decode[{dec.fusion_signature()}]")
+            count += 1
+
+        if fns:
+            def post(outs, _fns=tuple(fns)):
+                for f in _fns:
+                    outs = f(outs)
+                return outs
+
+            fw.set_fused_epilogue(post, token="|".join(sig_parts))
+        fused += count
+        log.info("fused %d epilogue stage(s) into %s's XLA program (%s)",
+                 count, el.name, "|".join(sig_parts))
+    return fused

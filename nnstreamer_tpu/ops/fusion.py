@@ -55,16 +55,23 @@ def fuse_chains(pipeline: Any) -> int:
             continue
         chain.reverse()  # upstream → downstream order
         fns = []
+        sig = []
         for t in chain:
             fns.append(t.as_jax_fn())
             t._fused = True
+            if t.transform_chain:
+                sig.append(";".join(f"{m}:{o}" for m, o in t.transform_chain))
+            else:
+                sig.append(f"{t.mode}:{t.option}")
 
         def pre(x, _fns=tuple(fns)):
             for f in _fns:
                 x = f(x)
             return x
 
-        el.fw.set_fused_preprocess(pre)
+        # structural token: filters sharing a bundle coalesce only when
+        # their fused chains compute the same function (sched engine)
+        el.fw.set_fused_preprocess(pre, token="|".join(sig))
         fused += len(chain)
         log.info("fused %d transform(s) into %s's XLA program",
                  len(chain), el.name)

@@ -138,6 +138,34 @@ def int8_row_sharded_matmul(x: jax.Array, wq: jax.Array,
     return (tot.astype(jnp.float32) * xs * w_scale).astype(x.dtype)
 
 
+def mlp_matmul(x: jax.Array, w1: Any, w2: Any) -> jax.Array:
+    """The transformer MLP block ``gelu(x @ w1) @ w2`` as one fused unit.
+
+    Float weights take the ordinary composition. When BOTH weights are
+    w8a8 dicts, the two GEMMs run int8 on the MXU and the inter-GEMM
+    elementwise chain — dequant by ``xs·w1.s``, gelu, dynamic per-row
+    requant — collapses into one Pallas epilogue kernel
+    (``ops.pallas.epilogue.dequant_gelu_requant``), so the hidden
+    activation never round-trips HBM in float between the matmuls.
+    Bit-identical to ``matmul_any(gelu(matmul_any(x, w1)), w2)``: the
+    kernel (and its CPU reference) composes exactly that math — int32
+    accumulation is exact, and gelu/requant run in the same dtypes and
+    order as the unfused form (pinned by tests/test_epilogue.py)."""
+    if not (is_quantized(w1) and is_quantized(w2)):
+        return matmul_any(jax.nn.gelu(matmul_any(x, w1)), w2)
+    from .pallas import epilogue as _ep
+
+    xq, xs = quant_act(x)
+    y = jax.lax.dot_general(
+        xq, w1[W8A8_TAG], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    hq, hs = _ep.dequant_gelu_requant(y, xs, w1["s"], out_dtype=x.dtype)
+    y2 = jax.lax.dot_general(
+        hq, w2[W8A8_TAG], (((hq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y2.astype(jnp.float32) * hs * w2["s"]).astype(x.dtype)
+
+
 def matmul_any(x: jax.Array, w: Any) -> jax.Array:
     """``x @ w`` that dispatches on the leaf: float weights take the
     ordinary (bf16/f32) MXU path, w8a8 dicts take the int8 path. The

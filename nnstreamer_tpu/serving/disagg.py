@@ -352,6 +352,20 @@ class DisaggWorker:
         self._threads: List[threading.Thread] = []
         self._xfer_clients: Dict[str, PageTransferClient] = {}
         self._push_seq = 0
+        # default fleet wiring: a worker that serves a KV cache IS the
+        # process's digest source, so installing fleet.KV_DIGEST_HOOK here
+        # means any FleetPusher in the process advertises this engine's
+        # radix-prefix digest without per-deployment glue. First worker
+        # wins (one digest per push doc); stop() clears only our own.
+        self._digest_hook_installed = False
+        if _fleet.KV_DIGEST_HOOK is None \
+                and hasattr(engine, "kv_prefix_digest"):
+            def _digest(worker=self):
+                with worker._elock:
+                    return worker.engine.kv_prefix_digest()
+            _fleet.KV_DIGEST_HOOK = _digest
+            self._digest_hook = _digest
+            self._digest_hook_installed = True
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name=f"disagg-accept:{self.endpoint}")
         self._threads.append(t)
@@ -492,6 +506,9 @@ class DisaggWorker:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._digest_hook_installed \
+                and _fleet.KV_DIGEST_HOOK is self._digest_hook:
+            _fleet.KV_DIGEST_HOOK = None
         try:
             self._listener.close()
         except OSError:

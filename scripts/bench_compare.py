@@ -69,6 +69,12 @@ LANES: Dict[str, int] = {
     "disagg_serving_relative": +1,
     "disagg_serving_prefix_hit_rate": +1,
     "lm_serving_paged_prefix_hit_rate": +1,
+    # epilogue fusion (ops/epilogue.py): post-filter chains compiled into
+    # the filter's jit — fewer dispatches per frame is the tentpole claim
+    "epilogue_fusion_fps_median": +1,
+    "epilogue_fusion_speedup": +1,
+    "epilogue_fusion_dispatches_per_frame": -1,
+    "epilogue_fusion_dispatch_ratio": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

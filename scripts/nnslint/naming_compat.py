@@ -687,6 +687,63 @@ def check_events(root: Path = SOURCE_ROOT):
     return problems
 
 
+#: literal-label KERNEL_HOOK call (ops/pallas entry points announce the
+#: kernels baked into a compiled program); labels are the device-lane
+#: vocabulary, so their shape and owner are pinned like metric names
+_KERNEL_LABEL_RE = re.compile(r"KERNEL_HOOK\(\s*[\"']([^\"']+)[\"']")
+_KERNEL_NAME_RE = re.compile(r"^pallas\.[a-z][a-z0-9_]*$")
+PALLAS_DIR = ("ops", "pallas")
+
+#: module-level assignment to the epilogue-fusion selection hook;
+#: matches ``EPILOGUE_SELECT_HOOK = ...`` and ``_epi.EPILOGUE_SELECT_HOOK
+#: = ...`` alike
+_EPILOGUE_HOOK_ASSIGN_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)*EPILOGUE_SELECT_HOOK\s*=[^=]", re.MULTILINE)
+#: the hook's definition site and its installer (profile.enable/disable)
+EPILOGUE_HOOK_OWNERS = (("ops", "epilogue.py"), ("obs", "profile.py"))
+
+
+def check_epilogue(root: Path = SOURCE_ROOT):
+    """Epilogue-fusion naming/placement lint.
+
+    * Pallas kernel labels (literal ``KERNEL_HOOK("...")`` calls) match
+      ``pallas.<snake_case>`` and are emitted only from
+      nnstreamer_tpu/ops/pallas/ — the device-lane label vocabulary has
+      one owner, like metric registrations (check_profile).
+    * ``EPILOGUE_SELECT_HOOK`` is assigned only in ops/epilogue.py (its
+      None default) and obs/profile.py (enable()/disable() install and
+      clear) — every other module may only *read* it behind a single
+      None check, which is what keeps the fusion pass zero-overhead
+      while profiling is off.
+    """
+    problems = []
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _KERNEL_LABEL_RE.finditer(text):
+            lineno = text.count("\n", 0, m.start()) + 1
+            label = m.group(1)
+            where = _where(path, lineno)
+            if not _KERNEL_NAME_RE.match(label):
+                problems.append(
+                    f"{where}: Pallas kernel label {label!r} does not "
+                    f"match {_KERNEL_NAME_RE.pattern}")
+            elif tuple(path.parts[-3:-1]) != PALLAS_DIR:
+                problems.append(
+                    f"{where}: Pallas kernel label {label!r} emitted "
+                    f"outside nnstreamer_tpu/ops/pallas/ — kernel entry "
+                    f"points own their labels")
+        for m in _EPILOGUE_HOOK_ASSIGN_RE.finditer(text):
+            if tuple(path.parts[-2:]) in EPILOGUE_HOOK_OWNERS:
+                continue
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: EPILOGUE_SELECT_HOOK assigned "
+                f"outside ops/epilogue.py + obs/profile.py — consumers "
+                f"read the hook behind one None check; only "
+                f"profile.enable()/disable() install and clear it")
+    return problems
+
+
 def main() -> int:
     problems = check()
     if problems:

@@ -119,6 +119,15 @@ class DisaggRule(_NamingRule):
 
 
 @register_rule
+class EpilogueRule(_NamingRule):
+    id = "naming/epilogue"
+    description = ("Pallas kernel labels are pallas.<snake_case> owned by "
+                   "ops/pallas/; EPILOGUE_SELECT_HOOK is assigned only by "
+                   "its definition and profile.enable()/disable()")
+    checks = (_compat.check_epilogue,)
+
+
+@register_rule
 class SloRule(_NamingRule):
     id = "naming/slo"
     description = ("slo telemetry is registered in obs/slo.py and the "

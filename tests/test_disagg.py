@@ -446,3 +446,51 @@ class TestDisaggE2E:
                 disagg.parse_disagg_spec(bad)
         with pytest.raises(ValueError, match="both fleets"):
             disagg.DisaggClient("127.0.0.1:1", page_size=PS)
+
+
+class TestWorkerKvDigestHook:
+    """DisaggWorker default fleet wiring: starting a worker installs
+    fleet.KV_DIGEST_HOOK (first worker wins) so any plain FleetPusher
+    in the process advertises the engine's radix-prefix digest;
+    stop() clears only the hook this worker installed."""
+
+    class _Eng:
+        role = "decode"
+
+        def kv_prefix_digest(self):
+            return ["aa11", "bb22"]
+
+    def test_install_and_clear(self):
+        assert obs_fleet.KV_DIGEST_HOOK is None
+        w = disagg.DisaggWorker(self._Eng())
+        try:
+            assert w._digest_hook_installed
+            doc = obs_fleet.build_push("i0", "decode", 1)
+            assert doc["kv_prefix"] == ["aa11", "bb22"]
+        finally:
+            w.stop()
+        assert obs_fleet.KV_DIGEST_HOOK is None
+
+    def test_first_worker_wins_second_does_not_steal(self):
+        w1 = disagg.DisaggWorker(self._Eng())
+        w2 = disagg.DisaggWorker(self._Eng())
+        try:
+            assert w1._digest_hook_installed
+            assert not w2._digest_hook_installed
+            w2.stop()
+            # w1's hook survives w2's stop
+            assert obs_fleet.KV_DIGEST_HOOK is not None
+        finally:
+            w1.stop()
+        assert obs_fleet.KV_DIGEST_HOOK is None
+
+    def test_engine_without_digest_skipped(self):
+        class Bare:
+            role = "decode"
+
+        w = disagg.DisaggWorker(Bare())
+        try:
+            assert not w._digest_hook_installed
+            assert obs_fleet.KV_DIGEST_HOOK is None
+        finally:
+            w.stop()

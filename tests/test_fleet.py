@@ -872,3 +872,36 @@ class TestIngestNeverRaises:
               "name": "query.request", "wall": 1e9, "dur_ns": 5,
               "attrs": {}}
         assert store.ingest_remote([IndexableNoGet(), ok], "w") == 1
+
+
+class TestPusherKvDigest:
+    """FleetPusher kv-digest wiring: a per-pusher digest source wins;
+    without one, build_push defers to the module KV_DIGEST_HOOK that
+    serving/disagg.py installs when a worker starts."""
+
+    def test_kv_digest_param_flows_into_doc(self):
+        psh = FleetPusher(instance="w:1",
+                          kv_digest=lambda: ["h1", "h2", "h3"])
+        try:
+            doc = psh._next_doc()
+            assert doc["kv_prefix"] == ["h1", "h2", "h3"]
+        finally:
+            psh.close()
+
+    def test_default_defers_to_module_hook(self):
+        prior = obs_fleet.KV_DIGEST_HOOK
+        obs_fleet.KV_DIGEST_HOOK = lambda: ["m1"]
+        psh = FleetPusher(instance="w:2")
+        try:
+            assert psh._next_doc()["kv_prefix"] == ["m1"]
+        finally:
+            psh.close()
+            obs_fleet.KV_DIGEST_HOOK = prior
+
+    def test_no_source_pushes_none(self):
+        assert obs_fleet.KV_DIGEST_HOOK is None
+        psh = FleetPusher(instance="w:3")
+        try:
+            assert psh._next_doc()["kv_prefix"] is None
+        finally:
+            psh.close()

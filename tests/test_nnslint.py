@@ -889,3 +889,101 @@ class TestCli:
     def test_error_exit_on_bad_path(self):
         proc = _run_cli("definitely/not/a/path.py")
         assert proc.returncode == 2
+
+
+# --------------------------------------------------------------------------- #
+# epilogue placement (naming/epilogue via naming_compat.check_epilogue)
+# --------------------------------------------------------------------------- #
+
+class TestEpiloguePlacement:
+    """check_epilogue ownership: Pallas kernel labels are
+    pallas.<snake_case> emitted only from ops/pallas/, and
+    EPILOGUE_SELECT_HOOK is assigned only by its definition
+    (ops/epilogue.py) and profile.enable()/disable()."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_bad_label_shape_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"ops/pallas/epilogue.py": """
+            def kern(hook):
+                hook("Pallas.NMS-Sweep", (4,), "f32")
+
+            def entry(_profile):
+                if _profile.KERNEL_HOOK is not None:
+                    _profile.KERNEL_HOOK("Pallas.NMS-Sweep", (4,), "f32")
+            """})
+        problems = naming_compat.check_epilogue(root)
+        assert len(problems) == 1
+        assert "does not match" in problems[0]
+
+    def test_label_outside_pallas_dir_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"decoders/stray.py": """
+            def entry(_profile):
+                if _profile.KERNEL_HOOK is not None:
+                    _profile.KERNEL_HOOK("pallas.stray_kernel", (4,), "f32")
+            """})
+        problems = naming_compat.check_epilogue(root)
+        assert len(problems) == 1
+        assert "outside nnstreamer_tpu/ops/pallas/" in problems[0]
+
+    def test_hook_assignment_outside_owners_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"graph/pipeline.py": """
+            from ..ops import epilogue as _epi
+
+            def start(self):
+                _epi.EPILOGUE_SELECT_HOOK = lambda f, c: True
+            """})
+        problems = naming_compat.check_epilogue(root)
+        assert len(problems) == 1
+        assert "EPILOGUE_SELECT_HOOK assigned outside" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "ops/pallas/epilogue.py": """
+                def entry(_profile):
+                    if _profile.KERNEL_HOOK is not None:
+                        _profile.KERNEL_HOOK("pallas.nms_sweep", (4,), "f32")
+                """,
+            "ops/epilogue.py": """
+                EPILOGUE_SELECT_HOOK = None
+                """,
+            "obs/profile.py": """
+                def enable(p):
+                    from ..ops import epilogue as _epi
+                    _epi.EPILOGUE_SELECT_HOOK = p.epilogue_select
+
+                def disable():
+                    from ..ops import epilogue as _epi
+                    _epi.EPILOGUE_SELECT_HOOK = None
+                """,
+            "ops/fusion.py": """
+                def consume(chain):
+                    from . import epilogue as _epi
+                    if _epi.EPILOGUE_SELECT_HOOK is not None:
+                        return _epi.EPILOGUE_SELECT_HOOK("f", chain)
+                    return True
+                """,
+        })
+        assert naming_compat.check_epilogue(root) == []
+
+    def test_equality_comparison_is_not_assignment(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"tests_helper/probe.py": """
+            def check(epi, fn):
+                return epi.EPILOGUE_SELECT_HOOK == fn
+            """})
+        assert naming_compat.check_epilogue(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_epilogue() == []
