@@ -481,6 +481,92 @@ def _epilogue_fusion_lane(device) -> dict:
         return {}
 
 
+def _autotune_lane(device) -> dict:
+    """Autotuner (tune/) cold→warm proof on the flash-attention block
+    knob. Cold run: empty store, one bounded measured sweep over the
+    FLASH_TUNE_r05 candidate grid. Warm run: the store reloads from
+    disk (a restarted instance) and the same call resolves with ZERO
+    sweeps — ``autotune_warm_sweeps`` must stay 0. The tuner's pick is
+    then timed against the hand-set 512/1024 default on the same shape:
+    ``autotune_flash_vs_hand`` >= 1 means the closed loop matched or
+    beat the hand sweep it replaces."""
+    import tempfile
+    import traceback
+
+    try:
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import tune
+        from nnstreamer_tpu.ops.pallas.flash_attention import (
+            _DEFAULT_BLOCKS, flash_attention)
+
+        on_cpu = device.platform == "cpu"
+        # interpret-mode flash is orders slower: shrink the sweep shape
+        # on CPU so the lane proves the mechanism, not the hardware
+        B, H, L, D = (1, 2, 256, 64) if on_cpu else (4, 8, 2048, 128)
+        q = jnp.ones((B, H, L, D), jnp.float32)
+        k = jnp.ones((B, H, L, D), jnp.float32)
+        v = jnp.ones((B, H, L, D), jnp.float32)
+
+        def timed(reps=5, **kw):
+            flash_attention(q, k, v, **kw).block_until_ready()  # warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                flash_attention(q, k, v, **kw).block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts)) * 1e3
+
+        tune.disable(save=False)
+        with tempfile.TemporaryDirectory() as td:
+            store = os.path.join(td, "tune.json")
+            # -- cold: empty store pays the one bounded sweep ---------
+            _mark("autotune lane: cold sweep starting")
+            tn = tune.enable(store, fit_from_profiler=False)
+            flash_attention(q, k, v).block_until_ready()
+            cold_sweeps = tn.stats["sweeps"]
+            cold_trials = tn.stats["trials"]
+            picked = tn.store.entries()
+            blocks = next(iter(picked.values()))["value"] if picked \
+                else list(_DEFAULT_BLOCKS)
+            tune.disable()  # persists the store
+
+            # -- warm: fresh tuner, same disk store, zero sweeps ------
+            _mark("autotune lane: warm run starting")
+            tn = tune.enable(store, fit_from_profiler=False)
+            flash_attention(q, k, v).block_until_ready()
+            warm_sweeps = tn.stats["sweeps"]
+            warm_hits = tn.stats["store_hits"]
+
+            # -- tuned pick vs the hand-set default -------------------
+            _mark("autotune lane: tuned-vs-hand timing starting")
+            tuned_ms = timed()  # store hit -> tuner-picked blocks
+            hand_ms = timed(block_q=_DEFAULT_BLOCKS[0],
+                            block_k=_DEFAULT_BLOCKS[1])
+            tune.disable(save=False)
+
+        row = {
+            "autotune_cold_sweeps": cold_sweeps,
+            "autotune_cold_trials": cold_trials,
+            "autotune_warm_sweeps": warm_sweeps,
+            "autotune_warm_store_hits": warm_hits,
+            "autotune_flash_blocks": list(blocks),
+            "autotune_flash_tuned_ms": round(tuned_ms, 3),
+            "autotune_flash_hand_ms": round(hand_ms, 3),
+            "autotune_flash_vs_hand": round(hand_ms / tuned_ms, 3)
+            if tuned_ms else None,
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+    finally:
+        from nnstreamer_tpu import tune as _tn
+
+        _tn.disable(save=False)
+
+
 def _multiplex_lane(flops, device) -> dict:
     """N concurrent pipelines over ONE zoo bundle through one
     sched.DeviceEngine: the single dispatch loop coalesces same-shape
@@ -1923,6 +2009,9 @@ def main() -> None:
             if os.environ.get("BENCH_EPILOGUE_FUSION", "1") != "0":
                 _mark("epilogue fusion lane starting")
                 result.update(_epilogue_fusion_lane(device))
+            if os.environ.get("BENCH_AUTOTUNE", "1") != "0":
+                _mark("autotune lane starting")
+                result.update(_autotune_lane(device))
             _mark("transformer prefill bench starting")
             result.update(_transformer_bench())
             if os.environ.get("BENCH_LM_LONGCTX", "1") != "0":

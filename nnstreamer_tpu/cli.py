@@ -71,6 +71,14 @@ def _normalize_argv(argv):
             except ValueError:
                 deferred.append(tok)
                 continue
+        if tok == "--tune" and out and not out[0].startswith("-") \
+                and "!" in out[0]:
+            # --tune takes a PATH, not a number: defer only when the
+            # next token is unmistakably the pipeline (bang syntax) so
+            # both `--tune store.json <pipe>` and `--tune '<pipe>'`
+            # parse; `--tune=store.json` needs no help
+            deferred.append(tok)
+            continue
         out.insert(0, tok)
     return out + deferred
 
@@ -113,6 +121,14 @@ def main(argv=None) -> int:
                          "device) -> cost samples to PATH as JSON at exit "
                          "(the autotuner training substrate; needs "
                          "--profile)")
+    ap.add_argument("--tune", metavar="STORE", nargs="?", const="",
+                    default=None,
+                    help="enable the autotuner (tune/): flash block "
+                         "shapes, LM chunk/page size, bucket rungs and "
+                         "the hedge delay resolve from tuned configs "
+                         "instead of hand-set defaults; STORE is the "
+                         "JSON store path (default $NNSTPU_TUNE_STORE "
+                         "or .nnstpu_tune.json)")
     ap.add_argument("--obs-push", metavar="URL", default=None,
                     help="push metric/health/span snapshots to a fleet "
                          "aggregator (obs.fleet): http://host:port for a "
@@ -354,6 +370,16 @@ def main(argv=None) -> int:
         print(f"fleet: aggregating as {agg.instance} "
               f"(POST {exporter.url.rsplit('/', 1)[0]}/fleet/push)",
               file=sys.stderr)
+    if args.tune is not None:
+        # BEFORE --obs-push: the tuner's fleet hooks must be installed
+        # when the pusher sends its first doc, so a fresh instance
+        # adopts fleet-tuned configs on its first push-ack — before
+        # its first dispatch ever consults a knob
+        from . import tune as _tune_mod
+
+        tn = _tune_mod.enable(store_path=args.tune or None)
+        print(f"tune: autotuner on ({len(tn.store)} stored config(s), "
+              f"store {tn.store.path})", file=sys.stderr)
     if args.obs_push is not None:
         from .obs import fleet
 
@@ -505,6 +531,11 @@ def main(argv=None) -> int:
 
             print(_slo_mod.report(), file=sys.stderr)
             _slo_mod.disable()
+        if args.tune is not None:
+            from . import tune as _tune_mod
+
+            print(_tune_mod.report(), file=sys.stderr)
+            _tune_mod.disable()  # persists the store for the next run
         if args.events_dump is not None:
             from .obs import events
 

@@ -72,6 +72,14 @@ class TensorBatch(Element):
         self.frames_grouped = 0
         self._ema_interval: Optional[float] = None
         self._last_arrival: Optional[float] = None
+        #: injectable time source so the budget/deadline arithmetic is
+        #: testable without real sleeps (tests swap in a fake clock)
+        self._clock = time.monotonic
+        #: DeviceEngine this element's pipeline is attached to, if any
+        #: (sched_enroll) — its queue depth shrinks the flush budget
+        #: under multi-tenant load so groups stop holding frames while
+        #: the device is already backed up
+        self._sched_engine: Optional[Any] = None
         if self.max_batch < 1:
             raise ValueError(f"tensor_batch: max_batch must be >= 1, "
                              f"got {self.max_batch}")
@@ -150,7 +158,7 @@ class TensorBatch(Element):
         bound = self.max_pending or 4 * self.max_batch
         with self._cv:
             if isinstance(item, Buffer):
-                now = time.monotonic()
+                now = self._clock()
                 if self._last_arrival is not None:
                     gap = now - self._last_arrival
                     # EMA of inter-arrival for the auto budget; ignore
@@ -172,12 +180,37 @@ class TensorBatch(Element):
         """Flush window for a new group. Fixed budget unless budget_ms=0
         (auto): ~1.3 × the time the stream needs to FILL max_batch at its
         observed rate, so groups normally reach full size and padding
-        stays exceptional (see module doc)."""
+        stays exceptional (see module doc). When the pipeline is enrolled
+        on a DeviceEngine (sched_enroll) and that engine already has
+        pending work queued, the window shrinks proportionally — holding
+        frames to fill a group buys nothing while the device is backed
+        up; it only stacks batching latency on top of queueing latency."""
         if self.budget_ms > 0:
-            return self.budget_ms / 1000.0
-        interval = self._ema_interval if self._ema_interval is not None \
-            else 0.005
-        return min(max(1.3 * self.max_batch * interval, 0.002), 0.5)
+            base = self.budget_ms / 1000.0
+        else:
+            interval = self._ema_interval if self._ema_interval is not None \
+                else 0.005
+            base = min(max(1.3 * self.max_batch * interval, 0.002), 0.5)
+        eng = self._sched_engine
+        if eng is not None:
+            try:
+                depth = eng.pending()
+            except Exception:  # noqa: BLE001 — engine mid-teardown
+                depth = 0
+            if depth > 0:
+                base = base / (1.0 + depth / float(self.max_batch))
+        return base
+
+    # -- scheduler opt-in ----------------------------------------------------- #
+    def sched_enroll(self, engine: Any, tenant: Any) -> None:
+        """Tenant-aware budget: remember the engine so _budget_s can read
+        its queue depth. Idempotent; no dispatch rerouting — batching
+        still happens on this element's own worker."""
+        self._sched_engine = engine
+
+    def sched_detach(self) -> None:
+        self._sched_engine = None
+        super().sched_detach()
 
     def _quit_worker(self) -> None:
         """Mark the element flushing before the worker exits early, so
@@ -202,7 +235,7 @@ class TensorBatch(Element):
                         self._cv.notify_all()
                         break
                     if group and deadline is not None:
-                        remaining = deadline - time.monotonic()
+                        remaining = deadline - self._clock()
                         if remaining <= 0:
                             item = _FLUSH
                             break
@@ -218,7 +251,7 @@ class TensorBatch(Element):
                 elif isinstance(item, Buffer):
                     group.append(item)
                     if len(group) == 1:
-                        deadline = time.monotonic() + self._budget_s()
+                        deadline = self._clock() + self._budget_s()
                     if len(group) >= self.max_batch:
                         if self._emit(group) is not FlowReturn.OK:
                             self._quit_worker()

@@ -44,6 +44,7 @@ import numpy as np
 from ..core.buffer import TensorMemory
 from ..core.log import logger
 from ..core.types import TensorInfo, TensorsInfo
+from .. import tune as _tune
 from ..models.zoo import ModelBundle, get_model
 from ..obs import profile as _profile
 from .base import FilterFramework, FilterProps, register_filter
@@ -408,6 +409,7 @@ class XLAFilter(FilterFramework):
         cache = None if pre is not None or post is not None \
             else self._bundle.metadata.setdefault("_jit_cache", {})
         cache_key = (precision, self._donate, in_layout, out_layout)
+        donate_key = (precision, True, in_layout, out_layout)
         if cache is not None:
             hit = cache.get(cache_key)
             if hit is not None:
@@ -415,6 +417,7 @@ class XLAFilter(FilterFramework):
                     _profile.DISPATCH_HOOK.on_jit_cache("bundle", True)
                 self._jitted = hit
                 self._infer_fn = hit
+                self._jitted_donate = cache.get(donate_key, hit)
                 return
 
         def wrapped_base(*xs):
@@ -442,12 +445,24 @@ class XLAFilter(FilterFramework):
         if self._donate:
             kw["donate_argnums"] = tuple(range(8))
         self._jitted = jax.jit(wrapped, **kw)
+        # donating twin for the coalesced path: sched's concatenated
+        # batch buffer is freshly allocated and exclusively owned, so
+        # it can be donated even when the filter's OWN inputs (the
+        # user's buffers) must stay intact. Same trace, donate=True key
+        # in the shared bundle cache — at most one extra executable.
+        if self._donate:
+            self._jitted_donate = self._jitted
+        else:
+            dkw = dict(kw)
+            dkw["donate_argnums"] = tuple(range(8))
+            self._jitted_donate = jax.jit(wrapped, **dkw)
         # caps inference must see the model's own (unreduced) outputs —
         # the fused epilogue's reduce is invisible to negotiation
         self._infer_fn = jax.jit(wrapped_base) if post is not None \
             else self._jitted
         if cache is not None:
             cache[cache_key] = self._jitted
+            cache[donate_key] = self._jitted_donate
             if _profile.DISPATCH_HOOK is not None:
                 _profile.DISPATCH_HOOK.on_jit_cache("bundle", False)
 
@@ -566,6 +581,22 @@ class XLAFilter(FilterFramework):
                 f"bucketed invoke needs same-shape tensors, got {shapes} "
                 "(add custom=\"resize=H:W\" for image regions)")
         bucket = -(-n // self._bucket) * self._bucket
+        tn = _tune.TUNE_HOOK
+        if tn is not None and bucket * 2 <= cap:
+            # rung choice: the minimal rung pads least but one rung up
+            # halves the distinct compiled shapes under jittery arrival
+            # counts — store/model resolution only (never a sweep: this
+            # is a per-frame path)
+            rowbytes = float(arrays[0].nbytes) if arrays else 0.0
+            rung = tn.pick(
+                "xla_bucket_rung", _tune.device_kind(),
+                self._bundle.name if self._bundle else "xla",
+                _tune.shape_sig(("rung", bucket)),
+                candidates=(bucket, bucket * 2), default=bucket,
+                features=lambda r: (0.0, r * rowbytes * 2.0))
+            if isinstance(rung, (int, float)) \
+                    and bucket <= int(rung) <= cap:
+                bucket = int(rung)
         _sched_tel.record_bucket_hit(bucket - n)
         if not hasattr(self, "_stack_fn"):
             # stack+pad inside one jit so the pad constant folds and the
@@ -587,8 +618,14 @@ class XLAFilter(FilterFramework):
                 o.block_until_ready()
         return [TensorMemory(o[:n]) for o in outs]
 
+    #: sched/engine.py gates its ``donate=True`` on this attribute so a
+    #: filter without the donating twin never sees an unexpected kwarg
+    #: (which would demote it to serial fallback forever)
+    supports_donate_coalesce = True
+
     def invoke_coalesced(
-            self, groups: Sequence[Sequence[TensorMemory]]
+            self, groups: Sequence[Sequence[TensorMemory]],
+            donate: bool = False
     ) -> List[Sequence[TensorMemory]]:
         """Sched-engine coalesced dispatch: several tenants' work items
         with identical input signatures execute as ONE device batch and
@@ -601,7 +638,14 @@ class XLAFilter(FilterFramework):
         concatenates along axis 0, giving at most ``max_coalesce``
         distinct batch shapes (a bounded compile set). Raises when the
         model's outputs are not batch-led — the engine then falls back
-        to serial invokes (``sched.coalesce_fallback``)."""
+        to serial invokes (``sched.coalesce_fallback``).
+
+        ``donate=True`` dispatches through the donating jit twin: the
+        concatenated batch buffer is freshly allocated here and read by
+        nobody afterwards, so XLA may reuse it for outputs — halving
+        peak HBM for the dispatch. The callers' own input buffers are
+        never donated (concatenate copies). Ignored on the bucketed and
+        single-group paths."""
         import jax.numpy as jnp
 
         if len(groups) == 1:
@@ -626,12 +670,19 @@ class XLAFilter(FilterFramework):
         arrays = [jnp.concatenate([g[j].device(self._device)
                                    for g in groups])
                   for j in range(npos)]
+        fn = self._jitted
+        if donate:
+            fn = getattr(self, "_jitted_donate", None) or fn
         with self._lock:
             prof = _profile.DISPATCH_HOOK
             if prof is not None:
-                outs = prof.dispatch(self, arrays)
+                outs = prof.dispatch(self, arrays, fn=fn)
             else:
-                outs = self._jitted(*arrays)
+                outs = fn(*arrays)
+        if donate:
+            # the donated concat buffers are dead: drop the references
+            # so nothing downstream can observe them
+            del arrays
         if self._sync:
             for o in outs:
                 o.block_until_ready()

@@ -226,6 +226,17 @@ class MetricsExporter:
                     "samples": _profile.samples(),
                 })
 
+            def _get_tune(self, query):
+                from .. import tune as _tune
+
+                agg = _fleet.aggregator()
+                self._json(200, {
+                    "enabled": _tune.enabled(),
+                    "local": _tune.snapshot(),
+                    "fleet": agg.tuned_view() if agg is not None
+                    else None,
+                })
+
             def _get_slo(self, query):
                 snap = _slo.snapshot()
                 agg = _fleet.aggregator()
@@ -248,7 +259,12 @@ class MetricsExporter:
                 except (TypeError, ValueError) as e:
                     self._json(400, {"error": str(e)})
                     return
-                self._json(200, {"ok": True})
+                # the ack carries the fleet's merged tuned configs so a
+                # worker's very first push makes it warm (tune/ adopts
+                # via obs/fleet.py TUNE_ADOPT_HOOK); None while no
+                # instance has pushed tune data — the ack is then
+                # byte-identical to pre-tune
+                self._json(200, {"ok": True, "tune": agg.tuned_view()})
 
             #: THE route table — GET and POST share it, and the 404
             #: hint below derives from it, so adding an endpoint here
@@ -264,6 +280,7 @@ class MetricsExporter:
                 ("GET", "/debug/profile"): _get_profile,
                 ("GET", "/debug/profile/samples"): _get_profile_samples,
                 ("GET", "/debug/slo"): _get_slo,
+                ("GET", "/debug/tune"): _get_tune,
                 ("POST", "/fleet/push"): _post_fleet_push,
             }
             _PREFIX_ROUTES = ((("GET", "/debug/traces/"), _get_trace),)

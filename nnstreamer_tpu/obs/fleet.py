@@ -111,6 +111,18 @@ MAX_KV_PREFIX_ENTRIES = 64
 #: usual zero-overhead-when-off hook (slo.ENGINE_SLO_HOOK pattern)
 KV_DIGEST_HOOK = None
 
+#: tune/ installs a zero-arg callable returning the local autotuner
+#: store's push slice (tune.TuneStore.to_doc()); None keeps the push
+#: doc exactly as before — same contract as KV_DIGEST_HOOK
+TUNE_PUSH_HOOK = None
+
+#: tune/ installs a one-arg callable that merges a fleet-shipped tune
+#: doc into the local store. The pusher fires it with the ``tune``
+#: field of every push-ack (see FleetPusher.push_now) — the adoption
+#: path that lets a fresh instance skip sweeps the fleet already paid
+#: for. None-gated like every other hook here.
+TUNE_ADOPT_HOOK = None
+
 
 def default_instance() -> str:
     """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
@@ -156,6 +168,10 @@ def build_push(instance: str, role: str, seq: int,
         "kv_prefix": (None if kv_prefix is None
                       else [str(h) for h in kv_prefix]
                       [:MAX_KV_PREFIX_ENTRIES]),
+        # None while the autotuner is off (same contract again): the
+        # local store's tuned-config slice, federated so any instance's
+        # sweep result reaches the whole fleet
+        "tune": TUNE_PUSH_HOOK() if TUNE_PUSH_HOOK is not None else None,
     }
 
 
@@ -256,11 +272,25 @@ class FleetPusher:
                 conn.request("POST", "/fleet/push", body=body,
                              headers={"Content-Type": "application/json"})
                 resp = conn.getresponse()
-                resp.read()
+                ack = resp.read()
                 if resp.status != 200:
                     raise OSError(f"aggregator replied {resp.status}")
             finally:
                 conn.close()
+            # the ack carries the fleet's merged tuned configs (obs/
+            # exporter.py _post_fleet_push): adopt them when the
+            # autotuner is on. First-push adoption is what lets a fresh
+            # instance skip sweeps the fleet already paid for — enable
+            # fleet push before the first dispatch and the configs are
+            # local before any knob is consulted.
+            hook = TUNE_ADOPT_HOOK
+            if hook is not None and ack:
+                try:
+                    tdoc = json.loads(ack).get("tune")
+                    if tdoc is not None:
+                        hook(tdoc)
+                except (ValueError, AttributeError):
+                    pass  # pre-tune aggregator or non-JSON ack
         except (OSError, http.client.HTTPException) as e:
             # the doc drained the span export queue — put the batch
             # back so a briefly unreachable aggregator loses nothing
@@ -325,8 +355,8 @@ class _Instance:
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
                  "metrics", "health", "ready", "slo", "kv_prefix",
-                 "via", "pushes", "spans_ingested", "first_mono",
-                 "last_mono")
+                 "tune", "via", "pushes", "spans_ingested",
+                 "first_mono", "last_mono")
 
     def __init__(self, instance: str):
         self.instance = instance
@@ -342,6 +372,8 @@ class _Instance:
         #: first advertises one) — set membership IS the prefix probe:
         #: chained hashes mean hashes[i] present implies path 0..i held
         self.kv_prefix: Optional[frozenset] = None
+        #: the instance's tune-store slice (None until it pushes one)
+        self.tune: Optional[Dict[str, Any]] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -456,6 +488,7 @@ class FleetAggregator:
         ready = doc.get("ready")
         slo_doc = doc.get("slo")
         kv_prefix = doc.get("kv_prefix")
+        tune_doc = doc.get("tune")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -482,6 +515,8 @@ class FleetAggregator:
                 # stop attracting placements
                 rec.kv_prefix = frozenset(
                     str(h) for h in kv_prefix[:MAX_KV_PREFIX_ENTRIES])
+            if isinstance(tune_doc, dict):
+                rec.tune = tune_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -798,6 +833,41 @@ class FleetAggregator:
             if depth > best_depth:
                 best, best_depth = rec.instance, depth
         return best, best_depth
+
+    def tuned_view(self) -> Optional[Dict[str, Any]]:
+        """The fleet's merged autotuned-config doc: the union of every
+        instance's pushed tune slice, lowest measured cost winning per
+        key (latest timestamp breaking unknown-cost ties). This is what
+        the push-ack carries back to workers — an instance's sweep
+        result reaches its peers one push interval later. None while no
+        instance has pushed any tune data, so pre-tune acks stay
+        byte-identical."""
+        with self._lock:
+            docs = [rec.tune for rec in self._instances.values()
+                    if isinstance(rec.tune, dict)]
+        merged: Dict[str, Dict[str, Any]] = {}
+        for doc in docs:
+            ents = doc.get("entries")
+            if not isinstance(ents, dict):
+                continue
+            for k, rec in ents.items():
+                if not isinstance(rec, dict) or "value" not in rec:
+                    continue
+                cur = merged.get(k)
+                if cur is not None:
+                    rc, cc = rec.get("cost_us"), cur.get("cost_us")
+                    if cc is not None:
+                        # a measured incumbent yields only to a
+                        # strictly better measurement
+                        if rc is None or rc >= cc:
+                            continue
+                    elif rc is None and (rec.get("ts") or 0) <= \
+                            (cur.get("ts") or 0):
+                        continue  # both unmeasured: newest wins
+                merged[k] = rec
+        if not merged:
+            return None
+        return {"version": 1, "entries": merged}
 
     # -- /debug/fleet ------------------------------------------------------ #
     def snapshot(self) -> Dict[str, Any]:

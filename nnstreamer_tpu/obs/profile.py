@@ -300,12 +300,15 @@ class Profiler:
         return cost
 
     # -- dispatch recording (filters/xla.py) ---------------------------- #
-    def dispatch(self, bundle: Any, arrays: List[Any]) -> Any:
+    def dispatch(self, bundle: Any, arrays: List[Any],
+                 fn: Any = None) -> Any:
         """Run ``bundle._jitted(*arrays)`` under the profiler: host
         timing always, device timing (block_until_ready) every Nth
         dispatch, HLO cost once per shape signature. Called with the
-        bundle's dispatch lock held — same exclusion as the bare call."""
-        jitted = bundle._jitted
+        bundle's dispatch lock held — same exclusion as the bare call.
+        ``fn`` overrides the callable while keeping the bundle's label
+        and sample key (filters/xla.py's donating coalesce twin)."""
+        jitted = fn if fn is not None else bundle._jitted
         label = getattr(bundle, "_epilogue_label", None) \
             or getattr(getattr(bundle, "_bundle", None), "name", None) \
             or type(bundle).__name__

@@ -24,12 +24,14 @@ parallel/ring.py's cross-chip ring.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ... import tune as _tune
 from ...obs import profile as _profile
 from .preprocess import _on_tpu
 
@@ -134,9 +136,81 @@ def _pick_block(lp: int, want: int) -> int:
     return best or 1
 
 
+#: the candidate grid the autotuner sweeps/ranks — exactly the
+#: FLASH_TUNE_r05 hand-sweep grid, so a tuner pick can never be worse
+#: than the best hand-swept point on the same hardware
+_TUNE_GRID = ((128, 128), (256, 256), (512, 512), (512, 1024),
+              (1024, 1024))
+#: hand-swept default (FLASH_TUNE_r05 winner) — what every call gets
+#: when the tuner is off or has nothing better
+_DEFAULT_BLOCKS = (512, 1024)
+
+
+def _block_features(b: int, h: int, L: int, d: int, itemsize: int):
+    """Per-candidate (flops, bytes) for the cost model: FLOPs are
+    block-independent; HBM traffic is not — each q block streams the
+    whole K/V once, so K/V re-reads scale with Lp/block_q, and q/o
+    re-reads with Lp/block_k staying resident. A coarse roofline, but
+    it orders the grid the same way the hand sweep did."""
+    Lp = -(-L // 128) * 128
+    flops = 4.0 * b * h * Lp * Lp * d  # qk^T + pv, causal ~x0.5 folds
+    # into the constant and cancels in ranking
+
+    def features(cand):
+        bq, bk = cand
+        nq = max(Lp // max(min(bq, Lp), 1), 1)
+        kv_traffic = 2.0 * b * h * nq * Lp * d * itemsize
+        qo_traffic = 2.0 * b * h * Lp * d * itemsize
+        return flops, kv_traffic + qo_traffic
+
+    return features
+
+
+def _tuned_blocks(q, k, v, causal: bool, interpret: bool):
+    """Resolve (block_q, block_k) through the autotuner. Store/model
+    hits are free; with neither, a bounded measured sweep times the
+    candidate grid on throwaway arrays of the caller's shape — safe
+    even while tracing, because the sweep inputs are concrete (jax
+    executes them eagerly) and the recursive calls pass explicit
+    blocks, which never re-enter the tuner."""
+    tn = _tune.TUNE_HOOK
+    if tn is None:
+        return _DEFAULT_BLOCKS
+    b, h, L, d = q.shape
+    sig = _tune.shape_sig(("b", b), ("h", h), ("l", L), ("d", d),
+                          ("c", int(causal)))
+    dev = "interpret" if interpret else _tune.device_kind()
+    dt = q.dtype
+
+    def measure(cand):
+        bq, bk = cand
+        qq = jnp.ones((b, h, L, d), dt)
+        kk = jnp.ones((b, h, L, d), dt)
+        vv = jnp.ones((b, h, L, d), dt)
+        flash_attention(qq, kk, vv, causal=causal, block_q=bq,
+                        block_k=bk,
+                        interpret=interpret).block_until_ready()  # warm
+        t0 = time.perf_counter()
+        flash_attention(qq, kk, vv, causal=causal, block_q=bq,
+                        block_k=bk,
+                        interpret=interpret).block_until_ready()
+        return time.perf_counter() - t0
+
+    cand = tn.pick("flash_blocks", dev, "pallas.flash_attention", sig,
+                   candidates=_TUNE_GRID, default=_DEFAULT_BLOCKS,
+                   measure=measure,
+                   features=_block_features(b, h, L, d, dt.itemsize))
+    try:
+        bq, bk = cand  # store round-trips tuples as lists
+        return int(bq), int(bk)
+    except (TypeError, ValueError):
+        return _DEFAULT_BLOCKS
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     return_residuals: bool = False,
                     _force_pad_d: bool = False):
@@ -147,6 +221,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     head dim that is not a multiple of the 128-wide lanes is zero-padded
     internally too (score-neutral; padded v columns sliced off, softmax
     scale from the true head dim) — callers never pad anything.
+
+    ``block_q``/``block_k`` default to the FLASH_TUNE_r05 hand-swept
+    512/1024 — unless the autotuner hook is installed, in which case
+    unset blocks resolve through its store/model/sweep (docs/tuning.md).
+    Explicit values always win and never consult the tuner.
 
     Precision model: scores and the output accumulate in f32; the
     softmax weights are rounded to v's dtype before the PV matmul (the
@@ -159,6 +238,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         _profile.KERNEL_HOOK("pallas.flash_attention", q.shape, q.dtype)
     if interpret is None:
         interpret = not _on_tpu()
+    if block_q is None or block_k is None:
+        tq, tk = _tuned_blocks(q, k, v, causal, interpret)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     b, h, L, d_orig = q.shape
     sm_scale = 1.0 / float(np.sqrt(d_orig))  # from the TRUE head dim
     d = d_orig

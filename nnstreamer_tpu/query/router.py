@@ -58,6 +58,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from .. import tune as _tune
 from ..core.log import logger
 from ..obs import events as _events
 from ..obs import fleet as _fleet
@@ -806,7 +807,17 @@ class QueryRouter:
         caps = self._caps()
         _DISPATCH_TOTAL.labels(self.name, be.endpoint).inc()
         if self.hedge_ms <= 0:
-            return be.request(meta, payload, caps)
+            # no manual floor: the autotuner arms hedging from the
+            # observed P95 alone once the latency window holds enough
+            # samples to make that quantile real (hedge_delay_s's own
+            # threshold) — `--hedge-ms` stops being required knowledge
+            tn = _tune.TUNE_HOOK
+            if tn is None or not tn.auto_hedge:
+                return be.request(meta, payload, caps)
+            with self._lat_lock:
+                n = len(self._latencies)
+            if n < 20:
+                return be.request(meta, payload, caps)
         return self._hedged(be, meta, payload, caps, session, tried)
 
     def _hedged(self, primary: Backend, meta: Dict[str, Any],

@@ -573,6 +573,14 @@ class DeviceEngine:
         if len(batch) == 1 or not hasattr(filt, "invoke_coalesced"):
             return [filt.invoke(w.inputs) for w in batch]
         try:
+            if getattr(filt, "supports_donate_coalesce", False):
+                # the filter builds a donating twin for the coalesced
+                # batch buffer (filters/xla.py): the concatenation is
+                # engine-owned scratch, so XLA may reuse it for outputs.
+                # Attribute-gated — passing the kwarg to a filter that
+                # lacks it would TypeError into permanent serial fallback
+                return filt.invoke_coalesced(
+                    [w.inputs for w in batch], donate=True)
             return filt.invoke_coalesced([w.inputs for w in batch])
         except Exception as e:  # noqa: BLE001 — fall back to serial
             self.stats["coalesce_fallbacks"] += 1

@@ -662,31 +662,75 @@ class DisaggClient:
 class PageSpiller:
     """Pressure relief over the transfer path: when the pool's
     claimable capacity drops below ``(1 - watermark) * n_pages``, ship
-    up to ``max_nodes`` of the coldest ref-0 leaf paths to the
-    neighbor and :meth:`~.kv_cache.PagedKVCache.shed` each one that
-    the peer acks — the content keeps existing on the fleet instead of
-    being destroyed by eviction. A dead or rejecting neighbor costs
-    nothing: the pages stay local and the next eviction handles them
-    the classic way.
+    up to ``max_nodes`` of the coldest ref-0 leaf paths to a peer and
+    :meth:`~.kv_cache.PagedKVCache.shed` each one that the peer acks —
+    the content keeps existing on the fleet instead of being destroyed
+    by eviction. A dead or rejecting peer costs nothing: the pages stay
+    local and the next eviction handles them the classic way.
+
+    The spill target is resolved per :meth:`maybe_spill` call: an
+    explicit ``neighbor`` always wins; without one the least-loaded
+    routable instance from the fleet aggregator's
+    :meth:`~nnstreamer_tpu.obs.fleet.FleetAggregator.routing_view` is
+    dialed (DisaggWorker instances advertise their ``host:port``
+    endpoint as their fleet id, so the view's keys are dialable).
+    ``self_instance`` excludes this process from its own candidates.
+    With neither a neighbor nor an aggregator, spilling is off.
 
     Call :meth:`maybe_spill` from the engine's owning thread (the
     cache is single-threaded); it is one comparison when the pool is
     below the watermark."""
 
-    def __init__(self, kv: PagedKVCache, neighbor: PageTransferClient,
-                 watermark: float = 0.85, max_nodes: int = 4):
+    def __init__(self, kv: PagedKVCache,
+                 neighbor: Optional[PageTransferClient] = None,
+                 watermark: float = 0.85, max_nodes: int = 4,
+                 self_instance: Optional[str] = None):
         if not 0.0 < watermark <= 1.0:
             raise ValueError("watermark must be in (0, 1]")
         self.kv = kv
         self.neighbor = neighbor
         self.watermark = float(watermark)
         self.max_nodes = int(max_nodes)
+        self.self_instance = self_instance
+        #: dialed fleet peers, kept across spills so a repeat target
+        #: reuses its handshaken connection
+        self._peers: Dict[str, PageTransferClient] = {}
+
+    def _pick_target(self) -> Optional[PageTransferClient]:
+        if self.neighbor is not None:
+            return self.neighbor
+        agg = _fleet.aggregator()
+        if agg is None:
+            return None
+        best_iid, best_depth = None, None
+        for iid, row in agg.routing_view().items():
+            if not row.get("routable") or iid == self.self_instance:
+                continue
+            # dialable ids only: the routing view also carries
+            # non-worker instances pushed by name, not endpoint
+            host, _, port = iid.rpartition(":")
+            if not host or not port.isdigit():
+                continue
+            depth = row.get("queue_depth") or 0.0
+            if best_depth is None or depth < best_depth:
+                best_iid, best_depth = iid, depth
+        if best_iid is None:
+            return None
+        peer = self._peers.get(best_iid)
+        if peer is None:
+            host, _, port = best_iid.rpartition(":")
+            peer = PageTransferClient(host, int(port))
+            self._peers[best_iid] = peer
+        return peer
 
     def maybe_spill(self) -> int:
-        """Returns pages freed locally (0 when below pressure or the
-        neighbor refused everything)."""
+        """Returns pages freed locally (0 when below pressure, no
+        target is resolvable, or the peer refused everything)."""
         kv = self.kv
         if kv.used_pages() < self.watermark * kv.n_pages:
+            return 0
+        target = self._pick_target()
+        if target is None:
             return 0
         freed = 0
         for nd in kv.coldest(self.max_nodes):
@@ -694,20 +738,20 @@ class PageSpiller:
             if doc is None:
                 continue
             try:
-                self.neighbor.send_pages(doc)
+                target.send_pages(doc)
             except (ConnectionError, OSError, QueryProtocolError) as e:
                 _events.record(
                     "disagg.spill",
-                    f"spill to {self.neighbor.endpoint} failed ({e}) — "
+                    f"spill to {target.endpoint} failed ({e}) — "
                     f"keeping pages local", severity="warning",
-                    peer=self.neighbor.endpoint)
+                    peer=target.endpoint)
                 break
             n = kv.shed(nd)
             freed += n
             _SPILL_PAGES.inc(n)
             _events.record(
                 "disagg.spill",
-                f"shed {n} cold page(s) to {self.neighbor.endpoint} "
+                f"shed {n} cold page(s) to {target.endpoint} "
                 f"instead of evicting", severity="debug",
-                peer=self.neighbor.endpoint, pages=n)
+                peer=target.endpoint, pages=n)
         return freed

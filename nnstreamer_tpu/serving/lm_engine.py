@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import tune as _tune
 from ..models import causal_lm
 from ..obs import events as _events
 from ..obs import health as _health
@@ -343,7 +344,7 @@ class LMEngine:
     """
 
     def __init__(self, params: Dict[str, Any], n_heads: int, max_len: int,
-                 n_slots: int = 4, chunk: int = 8,
+                 n_slots: int = 4, chunk: Optional[int] = None,
                  bucket=None, gang: bool = False,
                  spec_draft: int = 0,
                  kv_page_size: Optional[int] = None,
@@ -351,6 +352,19 @@ class LMEngine:
                  kv_slot_pages: Optional[int] = None,
                  kv_host_offload: Optional[bool] = None,
                  role: Optional[str] = None) -> None:
+        # prefill/decode chunk: explicit wins; unset consults the
+        # autotuner (store/model only — no sweep closure: constructing
+        # an engine must never dispatch), else the hand-set 8
+        if chunk is None:
+            chunk = 8
+            tn = _tune.TUNE_HOOK
+            if tn is not None:
+                chunk = int(tn.pick(
+                    "lm_chunk", _tune.device_kind(), "serving.lm",
+                    _tune.shape_sig(("slots", n_slots),
+                                    ("len", max_len),
+                                    ("heads", n_heads)),
+                    candidates=(4, 8, 16, 32), default=8))
         if n_slots < 1 or chunk < 1:
             raise ValueError("n_slots and chunk must be >= 1")
         # disaggregated-serving role: explicit kwarg wins, else the
@@ -389,6 +403,21 @@ class LMEngine:
         # the NNS_LM_KV_* environment (the nns-launch flag transport)
         ps = kv_page_size if kv_page_size is not None \
             else (_env_int("NNS_LM_KV_PAGE_SIZE") or 0)
+        if ps == 0 and kv_page_size is None and _tune.TUNE_HOOK is not None \
+                and (kv_pages is not None or _env_int("NNS_LM_KV_PAGES")):
+            # a page budget was given without a page granularity: the
+            # tuner owns it (store/fleet only — same no-dispatch rule
+            # as the chunk knob). kv_page_size=0 explicit still pins
+            # the contiguous path.
+            cands = tuple(c for c in (16, 32, 64, 128, 256)
+                          if c <= max_len and max_len % c == 0)
+            if cands:
+                dflt = 64 if 64 in cands else cands[0]
+                ps = int(_tune.TUNE_HOOK.pick(
+                    "lm_kv_page_size", _tune.device_kind(), "serving.lm",
+                    _tune.shape_sig(("len", max_len),
+                                    ("heads", n_heads)),
+                    candidates=cands, default=dflt))
         if ps < 0:
             raise ValueError("kv_page_size must be >= 0 (0 = contiguous)")
         self._kv: Optional[PagedKVCache] = None
@@ -1210,6 +1239,49 @@ class LMEngine:
             # would each have cost a dispatch under chunk=1 decode
             self.stats["spec_accepted"] += max(0, took - 1)
             self._retire_if_done(slot, req)
+        if _tune.TUNE_HOOK is not None:
+            self._retune_spec_draft()
+
+    #: re-derive the draft length every this many verify iterations —
+    #: often enough to track workload shifts, rare enough to cost nothing
+    _SPEC_RETUNE_EVERY = 32
+    #: per-dispatch overhead expressed in verify-row equivalents: the
+    #: fixed cost a verify window amortizes (scheduler step + dispatch
+    #: + D2H fetch). Small models in this codebase are overhead-bound,
+    #: so the constant is deliberately generous; it only shapes WHERE
+    #: the accept-rate curve peaks, not whether speculation runs.
+    _SPEC_OVERHEAD_ROWS = 4.0
+
+    def _retune_spec_draft(self) -> None:
+        """Close the loop the bench only analyzed: pick the draft
+        length whose EXPECTED tokens per verify cost is highest under
+        the observed per-token accept rate. Expected tokens for draft
+        k is the geometric partial sum 1 + a + ... + a^k; cost is the
+        (k+1)-row verify window plus fixed dispatch overhead. Closed
+        form — no sweep, and only reached when speculation is already
+        on (spec_draft > 0 gates _decode)."""
+        it = self.stats["spec_iterations"]
+        if self.spec_draft <= 0 or it == 0 \
+                or it % self._SPEC_RETUNE_EVERY:
+            return
+        drafted = self.stats["spec_drafted"]
+        if drafted < self._SPEC_RETUNE_EVERY:
+            return
+        a = min(max(self.stats["spec_accepted"] / drafted, 0.0), 0.99)
+        cap = min(16, max(self._m_slot - 1, 1))
+        best_k, best_rate = 1, 0.0
+        for k in range(1, cap + 1):
+            toks = (1.0 - a ** (k + 1)) / (1.0 - a)
+            rate = toks / (self._SPEC_OVERHEAD_ROWS + k + 1)
+            if rate > best_rate + 1e-9:
+                best_k, best_rate = k, rate
+        if best_k != self.spec_draft:
+            tn = _tune.TUNE_HOOK
+            if tn is not None:
+                tn.observe(
+                    "lm_spec_draft", _tune.device_kind(), "serving.lm",
+                    _tune.shape_sig(("len", self.max_len)), best_k)
+            self.spec_draft = best_k
 
     @staticmethod
     def _draft_tokens(req: _Request, g: int) -> np.ndarray:

@@ -97,7 +97,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router", "profile", "sched", "slo", "disagg")
+          "router", "profile", "sched", "slo", "disagg", "tune")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -123,10 +123,11 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
 #: starvation reliefs — nnstreamer_tpu/sched/), and "slo" (per-tenant
 #: SLO burn alerts/recoveries — obs/slo.py), and "disagg" (the
 #: prefill/decode split: re-prefill fallbacks + page spills,
-#: serving/disagg.py)
+#: serving/disagg.py), and "tune" (the autotuner's sweep/adoption
+#: audit trail, nnstreamer_tpu/tune/)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
                 "fleet", "resilience", "chaos", "router", "profile",
-                "sched", "slo", "disagg")
+                "sched", "slo", "disagg", "tune")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -741,6 +742,82 @@ def check_epilogue(root: Path = SOURCE_ROOT):
                 f"outside ops/epilogue.py + obs/profile.py — consumers "
                 f"read the hook behind one None check; only "
                 f"profile.enable()/disable() install and clear it")
+    return problems
+
+
+#: the ``tune`` metric/event layer is owned by the autotuner package:
+#: knob sites feed the tuner through the None-gated TUNE_HOOK; only the
+#: tuner itself counts picks/trials/adoptions (see module doc)
+TUNE_LAYER = "tune"
+TUNE_DIR = "tune"
+#: module-level assignment to the autotuner hook; matches
+#: ``TUNE_HOOK = ...`` and ``_tune.TUNE_HOOK = ...`` alike (but not the
+#: distinct fleet-side TUNE_PUSH_HOOK/TUNE_ADOPT_HOOK names)
+_TUNE_HOOK_ASSIGN_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)*TUNE_HOOK\s*=[^=]", re.MULTILINE)
+#: the hook's definition site (tune/__init__.py enable()/disable()) and
+#: the profiler, which may install/clear it the way it owns
+#: EPILOGUE_SELECT_HOOK
+TUNE_HOOK_OWNER_DIR = TUNE_DIR
+TUNE_HOOK_OWNER_FILES = (("obs", "profile.py"),)
+
+
+def _is_tune_pkg(path: Path) -> bool:
+    return path.parts[-2] == TUNE_DIR
+
+
+def check_tune(root: Path = SOURCE_ROOT):
+    """Autotuner naming/placement lint.
+
+    * ``tune``-layer metrics (``nnstpu_tune_*``) are registered only
+      under nnstreamer_tpu/tune/, and registrations inside that package
+      use no other layer — the tuner counts its own picks/sweeps/
+      adoptions; knob call sites ship no telemetry of their own.
+    * ``tune.*`` events are emitted only from nnstreamer_tpu/tune/.
+    * ``TUNE_HOOK`` is assigned only inside nnstreamer_tpu/tune/ (the
+      None default plus enable()/disable()) and obs/profile.py — every
+      other module may only *read* it behind a single None check, which
+      is what keeps every wired knob site zero-overhead while tuning
+      is off. Mirrors check_epilogue's EPILOGUE_SELECT_HOOK rule.
+    """
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_pkg = _is_tune_pkg(path)
+        if layer == TUNE_LAYER and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{TUNE_LAYER!r} layer outside nnstreamer_tpu/tune/ — "
+                f"knob sites feed the tuner through TUNE_HOOK; only "
+                f"the tuner counts its own resolutions")
+        elif in_pkg and layer != TUNE_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/tune/ must use the {TUNE_LAYER!r} "
+                f"layer, not {layer!r}")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == TUNE_LAYER and not _is_tune_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{TUNE_LAYER!r} layer outside nnstreamer_tpu/tune/")
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _TUNE_HOOK_ASSIGN_RE.finditer(text):
+            if _is_tune_pkg(path) \
+                    or tuple(path.parts[-2:]) in TUNE_HOOK_OWNER_FILES:
+                continue
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: TUNE_HOOK assigned outside "
+                f"nnstreamer_tpu/tune/ + obs/profile.py — consumers "
+                f"read the hook behind one None check; only "
+                f"tune.enable()/disable() install and clear it")
     return problems
 
 

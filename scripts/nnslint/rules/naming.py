@@ -133,3 +133,12 @@ class SloRule(_NamingRule):
     description = ("slo telemetry is registered in obs/slo.py and the "
                    "tenant label stays in obs/slo.py + sched/")
     checks = (_compat.check_slo,)
+
+
+@register_rule
+class TuneRule(_NamingRule):
+    id = "naming/tune"
+    description = ("tune telemetry and tune.* events live in tune/; "
+                   "TUNE_HOOK is assigned only by tune.enable()/"
+                   "disable() and obs/profile.py")
+    checks = (_compat.check_tune,)

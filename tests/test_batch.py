@@ -361,3 +361,98 @@ class TestAutoBudget:
         Pipeline.link(src, bat, unb, sink)
         p.run(timeout=60)
         assert sink.num_buffers == 1
+
+
+class TestTenantAwareBudget:
+    """sched_enroll-aware flush budget: a backed-up DeviceEngine shrinks
+    the batching window (holding frames to fill a group while the device
+    queue is deep only stacks latency). Fake clock + fake engine — no
+    real sleeps, no device."""
+
+    class _FakeEngine:
+        def __init__(self, depth=0):
+            self.depth = depth
+
+        def pending(self):
+            return self.depth
+
+    class _FakeClock:
+        def __init__(self):
+            self.t = 100.0
+
+        def __call__(self):
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+
+    def _element(self, **props):
+        from nnstreamer_tpu.elements.batch import TensorBatch
+        return TensorBatch(**props)
+
+    def test_fixed_budget_unchanged_without_engine(self):
+        el = self._element(max_batch=8, budget_ms=100.0)
+        assert el._budget_s() == 0.1
+
+    def test_engine_depth_shrinks_budget(self):
+        el = self._element(max_batch=8, budget_ms=100.0)
+        eng = self._FakeEngine(depth=8)
+        el.sched_enroll(eng, tenant=None)
+        # depth == max_batch -> budget halves
+        assert abs(el._budget_s() - 0.05) < 1e-9
+        eng.depth = 24  # 3x max_batch -> quarter
+        assert abs(el._budget_s() - 0.025) < 1e-9
+        eng.depth = 0  # idle engine -> full window again
+        assert el._budget_s() == 0.1
+
+    def test_detach_restores_full_budget(self):
+        el = self._element(max_batch=8, budget_ms=100.0)
+        el.sched_enroll(self._FakeEngine(depth=16), tenant=None)
+        assert el._budget_s() < 0.1
+        el.sched_detach()
+        assert el._budget_s() == 0.1
+        assert el._sched_engine is None
+
+    def test_engine_error_falls_back_to_full_budget(self):
+        class _Broken:
+            def pending(self):
+                raise RuntimeError("engine mid-teardown")
+
+        el = self._element(max_batch=8, budget_ms=100.0)
+        el.sched_enroll(_Broken(), tenant=None)
+        assert el._budget_s() == 0.1
+
+    def test_auto_budget_with_fake_clock_and_load(self):
+        """Drive the arrival EMA through the injectable clock: exactly
+        4 ms gaps -> deterministic auto window, then engine depth
+        shrinks it. No wall-clock sleeps anywhere."""
+        import numpy as np
+
+        from nnstreamer_tpu.core.buffer import Buffer
+
+        el = self._element(max_batch=8, budget_ms=0)
+        clock = self._FakeClock()
+        el._clock = clock
+        for i in range(6):
+            el._enqueue(Buffer.from_arrays([np.ones((1, 4), np.float32)]))
+            clock.advance(0.004)
+        # EMA of a constant gap converges to the gap exactly
+        assert abs(el._ema_interval - 0.004) < 1e-12
+        base = el._budget_s()
+        assert abs(base - min(max(1.3 * 8 * 0.004, 0.002), 0.5)) < 1e-9
+        el.sched_enroll(self._FakeEngine(depth=16), tenant=None)
+        assert abs(el._budget_s() - base / 3.0) < 1e-9
+
+    def test_deadline_math_uses_injected_clock(self):
+        """The worker's deadline arithmetic must run off self._clock so
+        tests (and simulations) can drive time: replicate the _drain
+        deadline expressions against the fake clock."""
+        el = self._element(max_batch=8, budget_ms=50.0)
+        clock = self._FakeClock()
+        el._clock = clock
+        deadline = el._clock() + el._budget_s()
+        assert deadline == 100.05
+        clock.advance(0.049)
+        assert deadline - el._clock() > 0
+        clock.advance(0.002)
+        assert deadline - el._clock() <= 0

@@ -987,3 +987,114 @@ class TestEpiloguePlacement:
         from scripts.nnslint import naming_compat
 
         assert naming_compat.check_epilogue() == []
+
+
+# --------------------------------------------------------------------------- #
+# tune placement (naming/tune via naming_compat.check_tune)
+# --------------------------------------------------------------------------- #
+
+class TestTunePlacement:
+    """check_tune ownership: tune-layer telemetry and tune.* events
+    live in nnstreamer_tpu/tune/, and TUNE_HOOK is assigned only by
+    tune/ itself + obs/profile.py — knob sites READ the hook behind
+    one None check (the zero-overhead contract)."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_tune_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"ops/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_tune_stray_total", "h", ())
+            """})
+        problems = naming_compat.check_tune(root)
+        assert len(problems) == 1
+        assert "TUNE_HOOK" in problems[0]
+
+    def test_foreign_layer_inside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"tune/tuner.py": """
+            def setup(reg):
+                reg.counter("nnstpu_pipeline_oops_total", "h", ())
+            """})
+        problems = naming_compat.check_tune(root)
+        assert len(problems) == 1
+        assert "must use the 'tune' layer" in problems[0]
+
+    def test_tune_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/lm_engine.py": """
+            def warn(events):
+                events.record("tune.sweep", "w", msg="x")
+            """})
+        problems = naming_compat.check_tune(root)
+        assert len(problems) == 1
+        assert "tune.sweep" in problems[0]
+
+    def test_hook_assignment_outside_owners_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"filters/xla.py": """
+            from .. import tune as _tune
+
+            def hijack(tn):
+                _tune.TUNE_HOOK = tn
+            """})
+        problems = naming_compat.check_tune(root)
+        assert len(problems) == 1
+        assert "TUNE_HOOK assigned outside" in problems[0]
+
+    def test_fleet_hooks_are_distinct_names(self, tmp_path):
+        # the regex must not swallow the fleet-side federation hooks,
+        # which ARE legitimately assigned by tune/__init__ and defined
+        # in obs/fleet.py
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            TUNE_PUSH_HOOK = None
+            TUNE_ADOPT_HOOK = None
+            """})
+        assert naming_compat.check_tune(root) == []
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "tune/__init__.py": """
+                TUNE_HOOK = None
+
+                def enable(tn):
+                    global TUNE_HOOK
+                    TUNE_HOOK = tn
+                """,
+            "tune/tuner.py": """
+                def setup(reg, events):
+                    reg.counter("nnstpu_tune_picks_total", "h", ("source",))
+                    events.record("tune.sweep", "info", msg="x")
+                """,
+            "ops/pallas/flash_attention.py": """
+                def blocks(_tune):
+                    tn = _tune.TUNE_HOOK
+                    if tn is None:
+                        return (512, 1024)
+                    return tn.pick()
+                """,
+        })
+        assert naming_compat.check_tune(root) == []
+
+    def test_equality_comparison_is_not_assignment(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"tests_helper/probe.py": """
+            def check(tune, tn):
+                return tune.TUNE_HOOK == tn
+            """})
+        assert naming_compat.check_tune(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_tune() == []

@@ -385,6 +385,43 @@ class TestDispatchUnits:
             r._observe_latency(0.2)  # now P95 genuinely above the floor
         assert r.hedge_delay_s() > 0.025
 
+    def test_auto_hedge_arms_from_observed_p95(self, tmp_path):
+        """With no manual --hedge-ms, the autotuner hook arms hedging
+        once the latency window holds >= 20 samples; tune off (or too
+        few samples) keeps the plain single-dispatch path."""
+        from nnstreamer_tpu import tune
+
+        bs = mkset(f"127.0.0.1:{free_port()},127.0.0.1:{free_port()}",
+                   "ah-unit")
+        r = qrouter.QueryRouter(bs, "ah-unit")  # hedge_ms defaults to 0
+        calls = {"direct": 0, "hedged": 0}
+        be = r.backends.backends()[0]
+        be.request = lambda meta, payload, caps: (
+            calls.__setitem__("direct", calls["direct"] + 1)
+            or ({"ok": 1}, b""))
+        r._hedged = lambda *a, **k: (
+            calls.__setitem__("hedged", calls["hedged"] + 1)
+            or ({"ok": 1}, b""))
+        try:
+            assert tune.TUNE_HOOK is None
+            r._attempt(be, {}, b"", None, None, set())
+            assert calls == {"direct": 1, "hedged": 0}  # tune off
+
+            tune.enable(str(tmp_path / "s.json"), fit_from_profiler=False)
+            r._attempt(be, {}, b"", None, None, set())
+            assert calls == {"direct": 2, "hedged": 0}  # < 20 samples
+
+            for _ in range(25):
+                r._observe_latency(0.004)
+            r._attempt(be, {}, b"", None, None, set())
+            assert calls == {"direct": 2, "hedged": 1}  # armed
+
+            tune.tuner().auto_hedge = False  # explicit opt-out respected
+            r._attempt(be, {}, b"", None, None, set())
+            assert calls == {"direct": 3, "hedged": 1}
+        finally:
+            tune.disable(save=False)
+
 
 # --------------------------------------------------------------------------- #
 # Drain-never-dials (client) + zero-overhead contract

@@ -248,6 +248,74 @@ def test_xla_coalesced_bucketed_bit_identical():
         np.testing.assert_array_equal(np.asarray(got[0].host()), want)
 
 
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_xla_coalesced_donating_bit_identical():
+    """donate=True routes the concatenated scratch buffer through the
+    donating jit twin — outputs must be bit-identical to the
+    non-donating coalesce AND to the direct per-group invoke (donation
+    changes buffer ownership, never arithmetic)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.base import FilterProps
+    from nnstreamer_tpu.filters.xla import XLAFilter
+
+    rng = np.random.default_rng(17)
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def model(x):
+        return jnp.tanh(x @ w)
+
+    f = XLAFilter()
+    f.open(FilterProps(model=model))
+    assert f.supports_donate_coalesce
+
+    def groups():
+        g = np.random.default_rng(23)
+        return [[TensorMemory(g.normal(size=(4, 16)).astype(np.float32))]
+                for _ in range(5)]
+
+    direct = [np.asarray(f.invoke(g)[0].host()) for g in groups()]
+    plain = f.invoke_coalesced(groups())
+    donated = f.invoke_coalesced(groups(), donate=True)
+    assert len(plain) == len(donated) == len(direct)
+    for got_d, got_p, want in zip(donated, plain, direct):
+        np.testing.assert_array_equal(np.asarray(got_p[0].host()), want)
+        np.testing.assert_array_equal(np.asarray(got_d[0].host()), want)
+
+
+def test_engine_donates_through_coalesce_gate():
+    """The engine's batched dispatch passes donate=True only to filters
+    that advertise supports_donate_coalesce — legacy coalescible
+    filters keep the old call shape (no TypeError → no silent
+    permanent serial fallback)."""
+    class Donatable(CoalesceFilter):
+        supports_donate_coalesce = True
+
+        def __init__(self):
+            super().__init__()
+            self.donate_flags = []
+
+        def invoke_coalesced(self, groups, donate=False):
+            self.donate_flags.append(donate)
+            return super().invoke_coalesced(groups)
+
+    clock = FakeClock()
+    eng = DeviceEngine("t", autostart=False, clock=clock)
+    filt = Donatable()
+    futs = [eng.register(f"t{i}").submit(filt, [_mem()]) for i in range(3)]
+    assert eng.step()
+    assert filt.donate_flags == [True]
+    for f in futs:
+        assert f.result(1.0)[0].shape == (2, 2)
+
+    legacy = CoalesceFilter()  # no donate kwarg at all
+    futs = [eng.register(f"u{i}").submit(legacy, [_mem()]) for i in range(2)]
+    assert eng.step()
+    assert legacy.coalesced == 1 and legacy.serial == 0
+    for f in futs:
+        assert f.result(1.0)[0].shape == (2, 2)
+
+
 # -- bounded bucket ladder (filters/xla.py bugfix) --------------------------- #
 
 def test_bucket_ladder_capped_and_chunked(metrics_on):

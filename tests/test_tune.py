@@ -1,0 +1,360 @@
+"""tune/ tests — store roundtrip and merge semantics, cost-model
+determinism, the tuner's resolution order (store → model → bounded
+sweep → default), the zero-overhead-when-off contract, and fleet
+federation of tuned configs (push doc, tuned_view merge, push-ack
+adoption including the real HTTP exporter loop)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nnstreamer_tpu import tune
+from nnstreamer_tpu.obs import fleet as obs_fleet
+from nnstreamer_tpu.obs import health as obs_health
+from nnstreamer_tpu.obs.exporter import start_exporter
+from nnstreamer_tpu.obs.fleet import FleetAggregator, FleetPusher, build_push
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.tracing import SpanStore
+from nnstreamer_tpu.tune.model import CostModel
+from nnstreamer_tpu.tune.store import MAX_PUSH_ENTRIES, TuneStore
+from nnstreamer_tpu.tune.tuner import Tuner, shape_sig
+
+
+@pytest.fixture
+def tune_off_after():
+    """Whatever a test installs on the module hooks, put it back."""
+    yield tune
+    tune.disable(save=False)
+    obs_fleet.TUNE_PUSH_HOOK = None
+    obs_fleet.TUNE_ADOPT_HOOK = None
+
+
+def worker_push(instance, seq=1, tune_doc=None):
+    """A synthetic worker push built through the real build_push path
+    (private registries), with an optional tune slice attached."""
+    doc = build_push(instance, "worker", seq, interval_s=2.0,
+                     registry=MetricsRegistry(enabled=True),
+                     health_registry=obs_health.HealthRegistry(),
+                     span_store=SpanStore())
+    if tune_doc is not None:
+        doc["tune"] = tune_doc
+    return doc
+
+
+def _samples(device="cpu", label="f", rows=((1e6, 1e4, 50.0),
+                                            (2e6, 2e4, 95.0),
+                                            (4e6, 4e4, 190.0))):
+    """Profiler-shaped sample rows: cost grows with flops+bytes so the
+    fit is well-posed (positive coefficients)."""
+    return [{"label": label, "device": device, "flops": f, "bytes": b,
+             "mean_device_us": c} for f, b, c in rows]
+
+
+# --------------------------------------------------------------------------- #
+# Store
+# --------------------------------------------------------------------------- #
+
+class TestStore:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.json")
+        s = TuneStore(p)
+        s.put("cpu", "flash", "b8.l2048", "flash_blocks",
+              [512, 1024], "sweep", cost_us=42.5)
+        s.put("cpu", "lm", "s4.l256", "lm_chunk", 16, "model")
+        assert s.dirty
+        assert s.save() == p
+        assert not s.dirty
+
+        s2 = TuneStore(p)
+        rec = s2.get("cpu", "flash", "b8.l2048", "flash_blocks")
+        assert rec["value"] == [512, 1024]
+        assert rec["source"] == "sweep"
+        assert rec["cost_us"] == 42.5
+        assert s2.get("cpu", "lm", "s4.l256", "lm_chunk")["value"] == 16
+        assert not s2.dirty
+
+    def test_unsupported_version_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"version": 99, "entries": {}}))
+        with pytest.raises(ValueError, match="version"):
+            TuneStore(str(p))
+
+    def test_merge_adopts_absent_and_lower_cost_only(self):
+        s = TuneStore()
+        s.put("cpu", "flash", "sig", "k", 512, "sweep", cost_us=10.0)
+        doc = {"version": 1, "entries": {
+            # absent locally -> adopted
+            "cpu|lm|sig|chunk": {"value": 16, "source": "sweep",
+                                 "cost_us": 5.0, "ts": 1.0},
+            # worse measured cost -> kept out
+            "cpu|flash|sig|k": {"value": 128, "source": "sweep",
+                                "cost_us": 50.0, "ts": 2.0}}}
+        assert s.merge_doc(doc) == 1
+        assert s.get("cpu", "lm", "sig", "chunk")["source"] == "fleet"
+        assert s.get("cpu", "flash", "sig", "k")["value"] == 512
+
+        # strictly lower measured cost -> replaces the local sweep
+        better = {"version": 1, "entries": {
+            "cpu|flash|sig|k": {"value": 256, "cost_us": 4.0, "ts": 3.0}}}
+        assert s.merge_doc(better) == 1
+        rec = s.get("cpu", "flash", "sig", "k")
+        assert rec["value"] == 256 and rec["source"] == "fleet"
+
+        # unmeasured remote never displaces a measured local
+        unmeasured = {"version": 1, "entries": {
+            "cpu|flash|sig|k": {"value": 64, "ts": 9.0}}}
+        assert s.merge_doc(unmeasured) == 0
+        assert s.merge_doc("junk") == 0
+        assert s.merge_doc({"entries": "junk"}) == 0
+
+    def test_push_doc_caps_entries_newest_first(self):
+        s = TuneStore()
+        for i in range(MAX_PUSH_ENTRIES + 10):
+            rec = s.put("cpu", "l", f"s{i}", "k", i, "sweep")
+            rec["ts"] = float(i)  # deterministic ordering
+        doc = s.to_doc()
+        assert len(doc["entries"]) == MAX_PUSH_ENTRIES
+        # the oldest 10 fell off, the newest survived
+        assert "cpu|l|s0|k" not in doc["entries"]
+        assert f"cpu|l|s{MAX_PUSH_ENTRIES + 9}|k" in doc["entries"]
+
+
+def test_shape_sig():
+    assert shape_sig(("b", 8), ("l", 2048)) == "b8.l2048"
+    assert shape_sig(("rung", 64)) == "rung64"
+
+
+# --------------------------------------------------------------------------- #
+# Cost model
+# --------------------------------------------------------------------------- #
+
+class TestCostModel:
+    def test_fit_is_deterministic(self):
+        rows = _samples()
+        m1, m2 = CostModel(), CostModel()
+        assert m1.fit(rows) == 1
+        assert m2.fit(list(rows)) == 1
+        assert m1.covers("cpu", "f")
+        for fl, by in ((1e6, 1e4), (3e6, 3e4), (8e6, 8e4)):
+            assert m1.predict("cpu", "f", fl, by) \
+                == m2.predict("cpu", "f", fl, by)
+
+    def test_negative_coefficient_means_no_coverage(self):
+        # more work measured as FASTER: samples do not span the
+        # feature — ranking on this fit would invert candidate order
+        rows = _samples(rows=((1e6, 0.0, 100.0), (2e6, 0.0, 50.0),
+                              (4e6, 0.0, 25.0)))
+        m = CostModel()
+        assert m.fit(rows) == 0
+        assert not m.covers("cpu", "f")
+        assert m.predict("cpu", "f", 1e6, 0.0) is None
+
+    def test_too_few_samples_means_no_coverage(self):
+        m = CostModel()
+        assert m.fit(_samples(rows=((1e6, 1e4, 50.0),))) == 0
+        assert not m.covers("cpu", "f")
+
+
+# --------------------------------------------------------------------------- #
+# Tuner resolution order
+# --------------------------------------------------------------------------- #
+
+class TestTunerResolution:
+    def test_model_pick_deterministic_across_instances(self):
+        """Same samples + same candidates → same config across two
+        independent tuners — and the second ask on either is a store
+        hit."""
+        rows = _samples()
+
+        def features(cand):
+            # candidate = multiplier on traffic; flops fixed
+            return (1e6, 1e4 * cand)
+
+        picks = []
+        for _ in range(2):
+            tn = Tuner(store=TuneStore())
+            tn.fit(rows)
+            v = tn.pick("k", "cpu", "f", "sig", candidates=(4, 2, 1, 8),
+                        default=4, features=features)
+            picks.append(v)
+            assert tn.stats["model_picks"] == 1
+            # second ask: resolved from the store, model not consulted
+            assert tn.pick("k", "cpu", "f", "sig", candidates=(4, 2, 1, 8),
+                           default=4, features=features) == v
+            assert tn.stats["store_hits"] == 1
+        assert picks[0] == picks[1] == 1  # least traffic wins
+
+    def test_sweep_is_bounded_and_cached(self):
+        calls = []
+
+        def measure(cand):
+            calls.append(cand)
+            return float(cand)  # smaller candidate = faster
+
+        tn = Tuner(store=TuneStore(), max_trials=4, measure_repeats=1)
+        v = tn.pick("k", "cpu", "f", "sig",
+                    candidates=(9, 3, 7, 5, 2, 1, 8, 6, 4, 10),
+                    default=9, measure=measure)
+        assert v == 3  # best of the FIRST max_trials candidates only
+        assert len(calls) == 4
+        assert tn.stats["trials"] == 4
+        rec = tn.store.get("cpu", "f", "sig", "k")
+        assert rec["source"] == "sweep" and rec["cost_us"] == 3e6
+
+        # warm ask: store hit, zero further measurement
+        assert tn.pick("k", "cpu", "f", "sig", candidates=(9, 3),
+                       default=9, measure=measure) == 3
+        assert len(calls) == 4
+        assert tn.stats["sweeps"] == 1
+
+    def test_sweep_total_failure_falls_back_to_default(self):
+        def broken(cand):
+            raise RuntimeError("no device")
+
+        tn = Tuner(store=TuneStore(), measure_repeats=1)
+        assert tn.pick("k", "cpu", "f", "sig", candidates=(1, 2),
+                       default=7, measure=broken) == 7
+        assert tn.stats["defaults"] == 1
+        assert tn.store.get("cpu", "f", "sig", "k") is None  # may retry
+
+    def test_measured_tie_breaks_by_candidate_order(self):
+        tn = Tuner(store=TuneStore(), measure_repeats=1)
+        v = tn.pick("k", "cpu", "f", "sig", candidates=(5, 3, 8),
+                    default=8, measure=lambda c: 1.0)
+        assert v == 5
+
+    def test_observe_persists_like_a_sweep(self):
+        tn = Tuner(store=TuneStore())
+        tn.observe("lm_spec_draft", "cpu", "serving.lm", "s4", 6)
+        assert tn.pick("lm_spec_draft", "cpu", "serving.lm", "s4",
+                       candidates=(), default=4) == 6
+        assert tn.stats["store_hits"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# Zero overhead when off
+# --------------------------------------------------------------------------- #
+
+class TestTuneOff:
+    def test_flash_blocks_default_without_hook(self, tune_off_after):
+        """TUNE_HOOK is None → the flash call site returns its hand-set
+        blocks without measuring, building arrays, or touching a store."""
+        from nnstreamer_tpu.ops.pallas import flash_attention as fa
+
+        assert tune.TUNE_HOOK is None
+        # None operands prove the gate short-circuits before any shape
+        # inspection — the hook check is the FIRST thing in the helper
+        assert fa._tuned_blocks(None, None, None, False, True) \
+            == fa._DEFAULT_BLOCKS
+
+    def test_push_doc_unchanged_without_hook(self, tune_off_after):
+        assert obs_fleet.TUNE_PUSH_HOOK is None
+        assert worker_push("w1:1").get("tune") is None
+
+    def test_enable_disable_lifecycle(self, tmp_path, tune_off_after):
+        p = str(tmp_path / "store.json")
+        tn = tune.enable(p, fit_from_profiler=False)
+        assert tune.enabled() and tune.tuner() is tn
+        assert tune.enable(p) is tn  # idempotent
+        assert obs_fleet.TUNE_PUSH_HOOK == tn.push_doc
+        assert obs_fleet.TUNE_ADOPT_HOOK == tn.adopt
+        tn.store.put("cpu", "f", "sig", "k", 1, "sweep")
+        tune.disable()
+        assert not tune.enabled()
+        assert obs_fleet.TUNE_PUSH_HOOK is None
+        assert obs_fleet.TUNE_ADOPT_HOOK is None
+        # disable persisted the dirty store
+        assert TuneStore(p).get("cpu", "f", "sig", "k")["value"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# Fleet federation
+# --------------------------------------------------------------------------- #
+
+class TestFleetFederation:
+    def test_push_doc_carries_store(self, tune_off_after):
+        tn = Tuner(store=TuneStore())
+        tn.store.put("cpu", "flash", "sig", "k", [512, 1024], "sweep",
+                     cost_us=10.0)
+        obs_fleet.TUNE_PUSH_HOOK = tn.push_doc
+        doc = worker_push("w1:1")
+        assert doc["tune"]["entries"]["cpu|flash|sig|k"]["value"] \
+            == [512, 1024]
+
+    def test_tuned_view_merges_lowest_cost(self):
+        agg = FleetAggregator(span_store=SpanStore())
+        agg.ingest(worker_push("w1:1", tune_doc={"version": 1, "entries": {
+            "cpu|f|s|k": {"value": 512, "cost_us": 20.0, "ts": 1.0},
+            "cpu|f|s|k2": {"value": 1, "ts": 1.0}}}))
+        agg.ingest(worker_push("w2:1", tune_doc={"version": 1, "entries": {
+            "cpu|f|s|k": {"value": 256, "cost_us": 5.0, "ts": 0.5},
+            "cpu|f|s|k2": {"value": 2, "ts": 2.0}}}))
+        view = agg.tuned_view()
+        # measured: lowest cost wins regardless of age
+        assert view["entries"]["cpu|f|s|k"]["value"] == 256
+        # both unmeasured: newest ts wins
+        assert view["entries"]["cpu|f|s|k2"]["value"] == 2
+
+    def test_tuned_view_none_before_any_tune_push(self):
+        agg = FleetAggregator(span_store=SpanStore())
+        agg.ingest(worker_push("w1:1"))
+        assert agg.tuned_view() is None
+
+    def test_adoption_skips_the_sweep(self, tune_off_after):
+        """A fresh instance that adopted the fleet's config must answer
+        from the store — its measure closure never runs."""
+        agg = FleetAggregator(span_store=SpanStore())
+        agg.ingest(worker_push("w1:1", tune_doc={"version": 1, "entries": {
+            "cpu|f|sig|k": {"value": 3, "cost_us": 2.0, "ts": 1.0}}}))
+        fresh = Tuner(store=TuneStore())
+        assert fresh.adopt(agg.tuned_view()) == 1
+        assert fresh.stats["adopted"] == 1
+
+        def never(cand):
+            raise AssertionError("sweep ran despite fleet adoption")
+
+        assert fresh.pick("k", "cpu", "f", "sig", candidates=(1, 2, 3),
+                          default=1, measure=never) == 3
+
+    def test_push_ack_adoption_over_http(self, tune_off_after):
+        """The real loop: aggregator already knows a tuned config, a
+        fresh worker's FIRST push-ack delivers it into the worker's
+        store via TUNE_ADOPT_HOOK."""
+        agg = obs_fleet.enable_aggregator(ttl_s=30.0)
+        try:
+            agg.ingest(worker_push("w1:1", tune_doc={
+                "version": 1, "entries": {
+                    "cpu|flash|sig|k": {"value": [512, 1024],
+                                        "cost_us": 7.0, "ts": 1.0}}}))
+            fresh = Tuner(store=TuneStore())
+            obs_fleet.TUNE_PUSH_HOOK = fresh.push_doc
+            obs_fleet.TUNE_ADOPT_HOOK = fresh.adopt
+            with start_exporter(port=0,
+                                registry=MetricsRegistry(enabled=True)) as exp:
+                psh = FleetPusher(
+                    url=f"http://127.0.0.1:{exp.port}", interval_s=3600,
+                    instance="w2:1",
+                    registry=MetricsRegistry(enabled=True),
+                    health_registry=obs_health.HealthRegistry(),
+                    span_store=SpanStore())
+                try:
+                    assert psh.push_now() is True
+                finally:
+                    psh.close()
+            rec = fresh.store.get("cpu", "flash", "sig", "k")
+            assert rec is not None
+            assert rec["value"] == [512, 1024] and rec["source"] == "fleet"
+        finally:
+            obs_fleet.disable_aggregator()
+
+    def test_debug_tune_route(self, tune_off_after, tmp_path):
+        tn = tune.enable(str(tmp_path / "s.json"), fit_from_profiler=False)
+        tn.store.put("cpu", "f", "sig", "k", 1, "sweep", cost_us=3.0)
+        with start_exporter(port=0,
+                            registry=MetricsRegistry(enabled=True)) as exp:
+            url = f"http://127.0.0.1:{exp.port}/debug/tune"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                body = json.loads(r.read())
+        assert body["enabled"] is True
+        assert "cpu|f|sig|k" in body["local"]["entries"]
