@@ -1665,6 +1665,121 @@ def _disagg_serving_lane(device) -> dict:
         return {}
 
 
+def _fleet_lane(device) -> dict:
+    """Fleet autoscaling (fleet/): halve a 4-worker unified-serving
+    fleet mid-load via live session migration (fleet/migrate.py) and
+    compare session goodput against the same load on the unhalved
+    fleet. ``fleet_halved_goodput_ratio`` is the tentpole claim —
+    streams survive a scale-in, so completed turns / offered turns
+    holds at ~1.0 through two drains — and
+    ``fleet_migration_seconds`` is the per-session bill (control round
+    trip + KV-page ship + router re-pin, end to end)."""
+    import traceback
+
+    try:
+        import jax
+
+        from nnstreamer_tpu.fleet.migrate import LM_CAPS, SessionMigrator
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.query.router import BackendSet, QueryRouter
+        from nnstreamer_tpu.serving import LMEngine
+        from nnstreamer_tpu.serving import disagg as _dsg
+
+        V, D, H, L = 512, 64, 4, 2
+        max_len, chunk, ps = 128, 8, 8
+        n_workers, n_sessions, n_turns, gen = 4, 8, 4, 8
+        if device.platform != "cpu" \
+                and os.environ.get("BENCH_FLEET_FULL", "0") == "1":
+            V, D, H, L = _LM_DIMS
+            max_len, chunk, ps = 512, 16, 32
+            n_sessions, gen = 16, 16
+        kv_pages = 4 * max_len // ps
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, max_len)
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, V, 3 * ps).astype(np.int32)
+                   for _ in range(n_sessions)]
+
+        def run(halve):
+            engines = [LMEngine(params, H, max_len, n_slots=2,
+                                chunk=chunk, kv_page_size=ps,
+                                kv_pages=kv_pages)
+                       for _ in range(n_workers)]
+            workers = [_dsg.DisaggWorker(e) for e in engines]
+            router = QueryRouter(
+                BackendSet([(w.host, w.port) for w in workers],
+                           "fleet-bench"), "fleet-bench")
+            router.set_caps_provider(lambda: LM_CAPS)
+            mig = SessionMigrator(router)
+            ok, total, mig_secs = 0, 0, []
+            t0 = time.monotonic()
+            try:
+                for turn in range(n_turns):
+                    if halve and turn == n_turns // 2:
+                        # the controller's scale-in path by hand, twice:
+                        # deterministic victim, migrate census, drain
+                        for _ in range(2):
+                            active = [be for be in
+                                      router.backends.backends()
+                                      if be.state == "active"]
+                            owned = router.backends.sessions_owned
+                            victim = min(
+                                active,
+                                key=lambda be: (len(owned(be.endpoint)),
+                                                be.endpoint))
+                            for s in owned(victim.endpoint):
+                                tgt = router.backends.pick(
+                                    session=s,
+                                    exclude=frozenset({victim.endpoint}))
+                                if tgt is not None:
+                                    r = mig.migrate(s, victim, tgt)
+                                    mig_secs.append(r["seconds"])
+                            router.remove_backend(victim.endpoint,
+                                                  drain=True)
+                    for i, prompt in enumerate(prompts):
+                        total += 1
+                        sid = f"bench-s{i}"
+                        rmeta, _ = router.dispatch(
+                            {"lm": {"prompt": [int(x) for x in prompt],
+                                    "max_new": gen, "session": sid}},
+                            b"", session=sid)
+                        if rmeta.get("tokens"):
+                            ok += 1
+                wall = time.monotonic() - t0
+            finally:
+                router.close()
+                for w in workers:
+                    w.stop()
+            return ok / max(1, total), wall, mig_secs, dict(mig.stats)
+
+        _mark("fleet lane full run starting (compiles)")
+        full_goodput, full_wall, _, _ = run(False)
+        _mark("fleet lane halved run starting")
+        halved_goodput, halved_wall, mig_secs, mstats = run(True)
+        row = {
+            "fleet_config":
+                f"d{D} L{L} V{V} page{ps} {n_workers} unified workers "
+                f"halved mid-load, {n_sessions} sessions x {n_turns} "
+                f"turns gen{gen} greedy",
+            "fleet_halved_goodput_ratio": round(
+                halved_goodput / max(full_goodput, 1e-9), 3),
+            "fleet_full_goodput": round(full_goodput, 3),
+            "fleet_halved_goodput": round(halved_goodput, 3),
+            "fleet_migration_seconds": round(
+                sum(mig_secs) / max(1, len(mig_secs)), 4),
+            "fleet_migrated_sessions": mstats["migrated"],
+            "fleet_absorbed_sessions": mstats["absorbed"],
+            "fleet_pages_moved": mstats["pages_moved"],
+            "fleet_halved_wall_s": round(halved_wall, 2),
+            "fleet_full_wall_s": round(full_wall, 2),
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -2032,6 +2147,9 @@ def main() -> None:
             if os.environ.get("BENCH_LM_DISAGG", "1") != "0":
                 _mark("disaggregated serving lane starting")
                 result.update(_disagg_serving_lane(device))
+            if os.environ.get("BENCH_FLEET", "1") != "0":
+                _mark("fleet autoscale lane starting")
+                result.update(_fleet_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":

@@ -162,6 +162,13 @@ def main(argv=None) -> int:
                          "P95 round trip (floored at MS) elapses without "
                          "a response; first result wins (needs --backends "
                          "with >= 2 endpoints)")
+    ap.add_argument("--autoscale", metavar="MIN:MAX[:policy]", default=None,
+                    help="SLO-driven autoscaling over the routed backend "
+                         "set (fleet/): a reconcile-loop controller "
+                         "scales between MIN and MAX replicas, migrating "
+                         "live sessions off drained backends with zero "
+                         "stream loss; policy is 'default' or 'priced' "
+                         "(needs --backends — docs/autoscale.md)")
     ap.add_argument("--kv-page-size", type=int, default=None, metavar="TOK",
                     help="enable the paged KV cache on every LMEngine built "
                          "during the run: tokens per page (must divide the "
@@ -251,6 +258,17 @@ def main(argv=None) -> int:
         if len(backend_eps) < 2:
             ap.error("--hedge-ms needs --backends with >= 2 endpoints "
                      "(a hedge must land on a different backend)")
+    autoscale_spec = None
+    if args.autoscale is not None:
+        if backend_eps is None:
+            ap.error("--autoscale needs --backends (the routed backend "
+                     "set is the membership the controller scales)")
+        from .fleet import parse_autoscale_spec
+
+        try:
+            autoscale_spec = parse_autoscale_spec(args.autoscale)
+        except ValueError as e:
+            ap.error(f"--autoscale: {e}")
     if args.profile is not None and args.profile < 1:
         ap.error("--profile must be >= 1 (ring capacity in records)")
     if args.profile_dump is not None and args.profile is None:
@@ -319,12 +337,15 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — CLI reports, never tracebacks
         print(f"ERROR: {type(e).__name__}: {e}", file=sys.stderr)
         return 1
+    routed_clients = []
     if args.deadline_ms is not None or args.fallback is not None \
             or backend_eps is not None:
         from .query.client import TensorQueryClient
 
         clients = [el for el in p.elements.values()
                    if isinstance(el, TensorQueryClient)]
+        if backend_eps is not None:
+            routed_clients = clients
         if not clients:
             ap.error("--deadline-ms/--fallback/--backends need a "
                      "tensor_query_client in the pipeline")
@@ -457,6 +478,26 @@ def main(argv=None) -> int:
         if exporter is not None:
             exporter.close()
         return 1
+    autoscale_ctl = None
+    if autoscale_spec is not None:
+        # AFTER p.start(): the routed clients build their QueryRouter
+        # (the membership substrate the controller scales) at start
+        from . import fleet as _fleet_mod
+        from .obs import fleet as _obs_fleet
+
+        mn, mx, pol = autoscale_spec
+        router = next((el.router for el in routed_clients
+                       if el.router is not None), None)
+        if router is None:
+            print("ERROR: --autoscale: no routed query client came up",
+                  file=sys.stderr)
+            p.stop()
+            return 1
+        autoscale_ctl = _fleet_mod.enable(
+            router, mn, mx, policy=pol,
+            aggregator=_obs_fleet.aggregator(), start=True)
+        print(f"fleet: autoscaling {mn}..{mx} replicas (policy {pol})",
+              file=sys.stderr)
     try:
         ok = p.wait_eos(args.timeout)
         err = p.bus.error
@@ -475,6 +516,16 @@ def main(argv=None) -> int:
             print(f"(stopped after {args.timeout}s timeout)", file=sys.stderr)
             return 2
     finally:
+        if autoscale_ctl is not None:
+            # BEFORE p.stop(): the controller's reconcile thread acts
+            # through the router, which dies with the pipeline
+            from . import fleet as _fleet_mod
+
+            st = autoscale_ctl.stats
+            print(f"fleet: {st['ticks']} reconcile tick(s), "
+                  f"{st['scale_up']} up / {st['scale_in']} in, "
+                  f"{st['migrations']} migration(s)", file=sys.stderr)
+            _fleet_mod.disable()
         p.stop()
         if sched_engine is not None:
             # AFTER p.stop(): chain threads must be gone before the

@@ -237,6 +237,19 @@ class MetricsExporter:
                     else None,
                 })
 
+            def _get_fleet_actions(self, query):
+                # module-level _fleet is obs.fleet; the controller
+                # package resolves lazily like _get_tune's import
+                from .. import fleet as _fleetpkg
+
+                agg = _fleet.aggregator()
+                self._json(200, {
+                    "enabled": _fleetpkg.enabled(),
+                    "local": _fleetpkg.snapshot(),
+                    "fleet": agg.actions_rollup() if agg is not None
+                    else None,
+                })
+
             def _get_slo(self, query):
                 snap = _slo.snapshot()
                 agg = _fleet.aggregator()
@@ -277,6 +290,7 @@ class MetricsExporter:
                 ("GET", "/debug/pipeline"): _get_pipeline,
                 ("GET", "/debug/events"): _get_events,
                 ("GET", "/debug/fleet"): _get_fleet,
+                ("GET", "/debug/fleet/actions"): _get_fleet_actions,
                 ("GET", "/debug/profile"): _get_profile,
                 ("GET", "/debug/profile/samples"): _get_profile_samples,
                 ("GET", "/debug/slo"): _get_slo,

@@ -123,6 +123,13 @@ TUNE_PUSH_HOOK = None
 #: for. None-gated like every other hook here.
 TUNE_ADOPT_HOOK = None
 
+#: fleet/ installs a zero-arg callable returning the local
+#: FleetController's bounded action journal (controller.actions()) so
+#: scale/migration decisions federate through push docs like every
+#: other telemetry slice. None-gated like the hooks above; assigned
+#: only by fleet.enable()/disable() (nnslint ownership rule).
+FLEET_ACTIONS_HOOK = None
+
 
 def default_instance() -> str:
     """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
@@ -172,6 +179,11 @@ def build_push(instance: str, role: str, seq: int,
         # local store's tuned-config slice, federated so any instance's
         # sweep result reaches the whole fleet
         "tune": TUNE_PUSH_HOOK() if TUNE_PUSH_HOOK is not None else None,
+        # None while no controller runs here (same contract): the
+        # bounded autoscale action journal, so any aggregator can
+        # answer "who scaled what, when, and why"
+        "fleet_actions": (FLEET_ACTIONS_HOOK()
+                          if FLEET_ACTIONS_HOOK is not None else None),
     }
 
 
@@ -355,7 +367,7 @@ class _Instance:
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
                  "metrics", "health", "ready", "slo", "kv_prefix",
-                 "tune", "via", "pushes", "spans_ingested",
+                 "tune", "actions", "via", "pushes", "spans_ingested",
                  "first_mono", "last_mono")
 
     def __init__(self, instance: str):
@@ -374,6 +386,9 @@ class _Instance:
         self.kv_prefix: Optional[frozenset] = None
         #: the instance's tune-store slice (None until it pushes one)
         self.tune: Optional[Dict[str, Any]] = None
+        #: the instance's autoscale action journal (None until a
+        #: controller there pushes one)
+        self.actions: Optional[List[Dict[str, Any]]] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -440,14 +455,43 @@ class FleetAggregator:
                     self._tombstones[iid] = {
                         "role": rec.role, "expired_mono": now}
                     self._tombstones.move_to_end(iid)
-                    while len(self._tombstones) > TOMBSTONE_LIMIT:
-                        self._tombstones.popitem(last=False)
+            self._compact_tombstones()
         for rec in dead:
             _events.record(
                 "fleet.expire",
                 f"instance {rec.instance} expired after "
                 f"{now - rec.last_mono:.1f}s without a push",
                 severity="warning", instance=rec.instance, role=rec.role)
+
+    def _compact_tombstones(self) -> None:  # guarded-by: _lock
+        """Deterministic oldest-first compaction: when churn pushes the
+        tombstone census past the bound, evict the stones that expired
+        EARLIEST (by expiry time, tiebroken by instance id) — never
+        whichever insertion order a re-expiry happened to leave. The
+        newest deaths are the ones a router still needs to learn."""
+        while len(self._tombstones) > TOMBSTONE_LIMIT:
+            oldest = min(
+                self._tombstones.items(),
+                key=lambda kv: (float(kv[1].get("expired_mono", 0.0)),
+                                kv[0]))[0]
+            del self._tombstones[oldest]
+
+    def confirm_drain(self, iid: str) -> bool:
+        """Controller-confirmed drain (fleet/controller.py): the
+        instance was deliberately scaled in and its sessions migrated,
+        so drop both its live record and any tombstone — deliberate
+        autoscale churn must never crowd still-dead backends out of
+        the bounded tombstone list. Returns whether anything cleared."""
+        with self._lock:
+            had_rec = self._instances.pop(iid, None) is not None
+            had_stone = self._tombstones.pop(iid, None) is not None
+        cleared = had_rec or had_stone
+        if cleared:
+            _events.record(
+                "fleet.drain_confirmed",
+                f"instance {iid} drained by controller — record and "
+                f"tombstone cleared", instance=iid)
+        return cleared
 
     # -- ingestion ------------------------------------------------------- #
     def ingest(self, doc: Any, via: str = "http") -> None:
@@ -489,6 +533,7 @@ class FleetAggregator:
         slo_doc = doc.get("slo")
         kv_prefix = doc.get("kv_prefix")
         tune_doc = doc.get("tune")
+        actions_doc = doc.get("fleet_actions")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -517,6 +562,8 @@ class FleetAggregator:
                     str(h) for h in kv_prefix[:MAX_KV_PREFIX_ENTRIES])
             if isinstance(tune_doc, dict):
                 rec.tune = tune_doc
+            if isinstance(actions_doc, list):
+                rec.actions = actions_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -798,6 +845,34 @@ class FleetAggregator:
                 "kv_prefix_size": 0,
             }
         return view
+
+    def scale_signals(self) -> Dict[str, Any]:
+        """Controller-facing snapshot (fleet/controller.observe): the
+        routing view reduced to the scalars the autoscale policy
+        prices — total finite queue depth over routable instances, the
+        routable census, and the fleet's breached-tenant list."""
+        view = self.routing_view()
+        queue_depth, routable = 0.0, 0
+        for row in view.values():
+            if not row.get("routable"):
+                continue
+            routable += 1
+            depth = float(row.get("queue_depth", 0.0))
+            if depth != float("inf"):
+                queue_depth += depth
+        return {"queue_depth": queue_depth, "routable": routable,
+                "breached": self.slo_rollup()["breached"],
+                "instances": len(view)}
+
+    def actions_rollup(self) -> Dict[str, Any]:
+        """Fleet-wide autoscale action journals (``/debug/fleet/
+        actions``): every live instance's pushed journal, keyed by
+        instance — who scaled what, when, and why."""
+        self._expire_now()
+        with self._lock:
+            recs = list(self._instances.values())
+        return {rec.instance: rec.actions for rec in recs
+                if rec.actions is not None}
 
     def longest_prefix(self, hashes: Sequence[str]
                        ) -> Tuple[Optional[str], int]:

@@ -638,6 +638,7 @@ class Profiler:
 # --------------------------------------------------------------------------- #
 
 _PID_HOST, _PID_DEVICE, _PID_SERVING, _PID_SCHED, _PID_SLO = 1, 2, 3, 4, 5
+_PID_FLEET = 6
 
 
 def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
@@ -661,6 +662,9 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
       * pid 5 **slo** — one cumulative goodput counter track per tenant
         (met/missed/shed) from obs/slo.py, present when the SLO layer
         is recording
+      * pid 6 **fleet** — fleet.* spans (session migrations, one lane
+        per operation) from fleet/migrate.py, present when a
+        controller has acted
 
     All timestamps share the process monotonic clock (µs)."""
     store = span_store if span_store is not None else _tracing.store()
@@ -681,6 +685,16 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
     serving_rows: Dict[str, int] = {}
     device_rows: Dict[str, int] = {}
     sched_rows: Dict[str, int] = {}
+    fleet_rows: Dict[str, int] = {}
+
+    def fleet_row(op: str) -> int:
+        row = fleet_rows.get(op)
+        if row is None:
+            if not fleet_rows:  # lane appears only when fleet acted
+                meta(_PID_FLEET, 0, "process_name", "fleet")
+            row = fleet_rows[op] = len(fleet_rows) + 1
+            meta(_PID_FLEET, row, "thread_name", op)
+        return row
 
     def sched_row(label: str) -> int:
         row = sched_rows.get(label)
@@ -711,6 +725,15 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
                 "ts": s.start_ns / 1e3,
                 "dur": max(s.end_ns - s.start_ns, 0) / 1e3,
                 "pid": _PID_SERVING, "tid": serving_row(rest or s.name),
+                "args": s.attrs,
+            })
+            continue
+        if layer == "fleet":
+            ev.append({
+                "name": rest or s.name, "cat": "fleet", "ph": "X",
+                "ts": s.start_ns / 1e3,
+                "dur": max(s.end_ns - s.start_ns, 0) / 1e3,
+                "pid": _PID_FLEET, "tid": fleet_row(rest or s.name),
                 "args": s.attrs,
             })
             continue

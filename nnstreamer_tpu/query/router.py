@@ -56,6 +56,7 @@ import random
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import tune as _tune
@@ -84,6 +85,11 @@ ACTIVE = "active"
 DRAINING = "draining"
 CLOSED = "closed"
 _STATE_CODE = {ACTIVE: 0, DRAINING: 1, CLOSED: 2}
+
+#: bound on the session pin/owner tables (LRU-evicted) — placement
+#: state, not correctness state: an evicted session just re-places
+#: through the affinity ring on its next buffer
+SESSION_PIN_LIMIT = 4096
 
 #: virtual nodes per backend on the affinity hash ring — enough spread
 #: that removing one backend of N only remaps ~1/N of the sessions
@@ -308,6 +314,12 @@ class BackendSet:
         self._lock = threading.Lock()
         self._backends: Dict[str, Backend] = {}  # guarded-by: _lock
         self._ring: List[Tuple[int, str]] = []  # guarded-by: _lock
+        # session placement state (both guarded-by: _lock, LRU-bounded):
+        # _pins are explicit re-homes (migration / eager drain re-pin)
+        # consulted BEFORE the ring; _owners is the observed last
+        # successful placement, which is what drain enumerates
+        self._pins: "OrderedDict[str, str]" = OrderedDict()
+        self._owners: "OrderedDict[str, str]" = OrderedDict()
         self._rng = rng if rng is not None else random.Random()
         for host, port in endpoints:
             self.add(f"{host}:{port}")
@@ -338,7 +350,10 @@ class BackendSet:
         """Graceful drain: stop placing on the backend, leave its
         in-flight requests to finish. :meth:`reap_drained` (called on
         every dispatch) closes it once idle — scale-down without
-        dropping a single buffer."""
+        dropping a single buffer. Sessions the backend owns are
+        re-pinned EAGERLY here, so the first post-drain buffer dials
+        its new home directly instead of paying a lazy failover round
+        trip."""
         with self._lock:
             be = self._backends.get(endpoint)
             if be is None:
@@ -350,6 +365,7 @@ class BackendSet:
                        f"{self.owner}: backend {endpoint} draining "
                        f"({be.inflight} in flight)",
                        element=self.owner, backend=endpoint)
+        self._repin_sessions(endpoint)
         self.reap_drained()
         return be
 
@@ -365,6 +381,11 @@ class BackendSet:
         with self._lock:
             be = self._backends.pop(endpoint, None)
             self._rebuild_ring()
+            # drop placement state naming the gone backend (drain
+            # already re-pinned; this covers the drain=False sever)
+            for table in (self._pins, self._owners):
+                for s in [s for s, ep in table.items() if ep == endpoint]:
+                    del table[s]
         if be is not None:
             be.close()
             _events.record("router.backend_remove",
@@ -402,6 +423,68 @@ class BackendSet:
     def get(self, endpoint: str) -> Optional[Backend]:
         with self._lock:
             return self._backends.get(endpoint)
+
+    # -- session placement state ------------------------------------------- #
+    def pin_session(self, session: str, endpoint: str) -> None:
+        """Explicitly re-home a session (migration / drain hand-off):
+        :meth:`_affinity` honors the pin before the ring, so the next
+        buffer dials ``endpoint`` directly."""
+        with self._lock:
+            self._pins[session] = endpoint
+            self._pins.move_to_end(session)
+            self._owners[session] = endpoint
+            self._owners.move_to_end(session)
+            self._trim_session_tables()
+
+    def unpin_session(self, session: str) -> None:
+        with self._lock:
+            self._pins.pop(session, None)
+
+    def note_session(self, session: str, endpoint: str) -> None:
+        """Record where a session's buffer actually landed (dispatch
+        success path). Keeps the ownership census current and makes an
+        existing pin track reality after a failover moved the session."""
+        with self._lock:
+            self._owners[session] = endpoint
+            self._owners.move_to_end(session)
+            if session in self._pins and self._pins[session] != endpoint:
+                self._pins[session] = endpoint
+                self._pins.move_to_end(session)
+            self._trim_session_tables()
+
+    def sessions_owned(self, endpoint: str) -> List[str]:
+        """Sessions currently homed on ``endpoint`` (observed placement
+        union explicit pins) — the drain/migration census."""
+        with self._lock:
+            return sorted(
+                {s for s, ep in self._owners.items() if ep == endpoint}
+                | {s for s, ep in self._pins.items() if ep == endpoint})
+
+    def _trim_session_tables(self) -> None:  # guarded-by: _lock
+        while len(self._pins) > SESSION_PIN_LIMIT:
+            self._pins.popitem(last=False)
+        while len(self._owners) > SESSION_PIN_LIMIT:
+            self._owners.popitem(last=False)
+
+    def _repin_sessions(self, endpoint: str) -> int:
+        """Eagerly re-home every session owned by a draining backend
+        (the ring already excludes it). Each session re-places through
+        the normal :meth:`pick` path — deterministic ring hash first —
+        and lands as an explicit pin."""
+        moved = 0
+        for s in self.sessions_owned(endpoint):
+            be = self.pick(session=s, exclude=frozenset({endpoint}))
+            if be is None:
+                continue
+            self.pin_session(s, be.endpoint)
+            moved += 1
+        if moved:
+            _events.record(
+                "router.repin",
+                f"{self.owner}: {moved} session(s) eagerly re-pinned "
+                f"off draining {endpoint}",
+                element=self.owner, backend=endpoint, sessions=moved)
+        return moved
 
     def __len__(self) -> int:
         with self._lock:
@@ -509,6 +592,20 @@ class BackendSet:
 
     def _affinity(self, session: str,
                   exclude: frozenset) -> Optional[Backend]:
+        # explicit pins (migration / drain re-pin) outrank the ring:
+        # the pinned backend holds the session's migrated KV pages
+        with self._lock:
+            pinned = self._pins.get(session)
+        if pinned is not None:
+            be = self.get(pinned)
+            if be is not None and be.state == ACTIVE \
+                    and pinned not in exclude and be.breaker.allow():
+                return be
+            # pinned home unroutable (dead, draining, or excluded by a
+            # failed attempt): the pin is stale — drop it and let the
+            # ring/two-choice place the session fresh
+            with self._lock:
+                self._pins.pop(session, None)
         with self._lock:
             ring = self._ring
         if not ring:
@@ -777,6 +874,8 @@ class QueryRouter:
                         rhook.record_dispatch(
                             session, len(payload), len(rpayload))
                     span.set_attribute("backend", be.endpoint)
+                    if session is not None:
+                        self.backends.note_session(session, be.endpoint)
                     self.backends.reap_drained()
                     return rmeta, rpayload
                 except (ConnectionError, OSError,

@@ -352,3 +352,11 @@ def backend_breaker_name(owner: str, endpoint: str) -> str:
     separates backends instead of aggregating a fleet into one series.
     Cardinality is bounded by the configured backend set."""
     return f"query:{owner}:{endpoint}"
+
+
+def fleet_breaker_name(controller: str) -> str:
+    """Canonical breaker name for a fleet controller's scale actions —
+    ``fleet:<controller>`` — a run of failed worker launches opens the
+    breaker so the reconcile loop stops hammering a broken launch path
+    instead of flapping. Cardinality: one per controller (usually 1)."""
+    return f"fleet:{controller}"

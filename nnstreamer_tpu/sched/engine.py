@@ -51,6 +51,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..core.log import logger
 from ..graph.element import join_or_warn
+from .. import fleet as _fleet
 from ..obs import health as _health
 from ..obs import profile as _profile
 from ..obs import slo as _slo
@@ -562,6 +563,11 @@ class DeviceEngine:
                 self.name, busy,
                 [(w.tenant.name, max(now - w.t_enq, 0.0), _work_rows(w),
                   w.deadline) for w in batch])
+        fhook = _fleet.AUTOSCALE_HOOK
+        if fhook is not None:
+            # engine busy fraction as a scale signal, sampled at batch
+            # boundaries — same one-load None gate as the hooks above
+            fhook.observe_occupancy(self.name, self.occupancy())
 
     def _dispatch(self, batch: List[_Work]) -> List[Any]:
         """One device dispatch for the whole batch; returns per-item

@@ -452,6 +452,10 @@ class DisaggWorker:
         return n
 
     def _handle_lm(self, conn: socket.socket, meta: Dict[str, Any]) -> None:
+        ctl = meta.get("lm_ctl")
+        if isinstance(ctl, dict):
+            self._handle_ctl(conn, ctl, meta)
+            return
         req = meta.get("lm")
         if not isinstance(req, dict) or "prompt" not in req:
             send_message(conn, Cmd.ERROR,
@@ -486,6 +490,45 @@ class DisaggWorker:
             send_message(conn, Cmd.ERROR, {"error": str(e)})
             return
         send_message(conn, Cmd.RESULT, reply)
+
+    def _handle_ctl(self, conn: socket.socket, ctl: Dict[str, Any],
+                    meta: Dict[str, Any]) -> None:
+        """Fleet control plane (fleet/migrate.py) riding the LM DATA
+        wire: ``export_session`` freezes a session, exports its KV
+        pages, and ships them to the migration target over the same
+        KV_PAGE_XFER op the prefill→decode hand-off uses;
+        ``resume_session`` lifts the freeze (migration absorb path)."""
+        op = ctl.get("op")
+        session = ctl.get("session")
+        if not session:
+            send_message(conn, Cmd.ERROR,
+                         {"error": "lm_ctl needs a 'session'"})
+            return
+        dl = _rp.Deadline.from_wire(meta.get(_rp.WIRE_KEY))
+        if op == "export_session":
+            with self._elock:
+                doc = self.engine.export_session(str(session))
+            reply: Dict[str, Any] = {"session": str(session),
+                                     "pages_sent": 0,
+                                     "exported": doc is not None}
+            xfer_to = ctl.get("xfer_to")
+            if doc is not None and xfer_to:
+                reply["pages_sent"] = self._ship(doc, str(xfer_to),
+                                                 dl, reply)
+            if reply.get("xfer_error"):
+                # shipment failed with the source alive: keep serving
+                # here until the controller's drain moves the session
+                with self._elock:
+                    self.engine.resume_session(str(session))
+            send_message(conn, Cmd.RESULT, reply)
+        elif op == "resume_session":
+            with self._elock:
+                self.engine.resume_session(str(session))
+            send_message(conn, Cmd.RESULT, {"session": str(session),
+                                            "resumed": True})
+        else:
+            send_message(conn, Cmd.ERROR,
+                         {"error": f"unknown lm_ctl op {op!r}"})
 
     def _ship(self, doc: Dict[str, Any], xfer_to: str,
               dl: Optional[_rp.Deadline], reply: Dict[str, Any]) -> int:

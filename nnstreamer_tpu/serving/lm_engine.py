@@ -55,7 +55,7 @@ import os
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
@@ -96,6 +96,11 @@ def _env_int(name: str) -> Optional[int]:
 #: from scratch on transfer failure); "unified" is the classic both-
 #: phases engine and the default
 ROLES = ("unified", "prefill", "decode")
+
+#: bound on the per-engine session→token-path table behind live
+#: migration (LRU-evicted; an evicted session migrates via the
+#: re-prefill absorb path instead of a page shipment)
+SESSION_PATHS_LIMIT = 256
 
 #: weak registry of every constructed engine — `nns-launch` walks it at
 #: exit to print per-engine KV summaries without threading a handle
@@ -487,6 +492,14 @@ class LMEngine:
         self._queue: deque[_Request] = deque()
         self._finished: Dict[int, List[int]] = {}
         self._next_rid = 0
+        # live-migration session state (fleet/migrate.py): the token
+        # path each session last committed to the KV cache — what
+        # export_session ships — plus the set frozen mid-migration
+        # (their submits are refused so the router fails them over to
+        # the re-pinned target). LRU-bounded; eviction only costs the
+        # evicted session its migration warmth.
+        self._session_paths: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._frozen_sessions: set = set()
         # decode_steps/slot_steps/wasted_slot_steps account the CHUNK
         # path only (bench waste_frac reads them; its serving lane runs
         # chunk mode); speculative iterations are accounted separately
@@ -625,6 +638,14 @@ class LMEngine:
         if p.size < 1:
             self._reject("empty prompt")
             raise ValueError("empty prompt")
+        if session is not None and str(session) in self._frozen_sessions:
+            # mid-migration: the session's KV pages are in flight to
+            # another backend — refusing here makes the router fail the
+            # request over to the re-pinned target under its ORIGINAL
+            # deadline instead of decoding against a torn cache
+            self._reject("session frozen for migration")
+            raise ValueError(
+                f"session {session!r} is frozen for migration")
         if max_new < 1:
             self._reject("max_new must be >= 1")
             raise ValueError("max_new must be >= 1")
@@ -840,6 +861,35 @@ class LMEngine:
         if not out:  # shed at the door or at admission
             return None, None
         return out[0], self._kv.export_pages(p)
+
+    # -- live migration (fleet/migrate.py) --------------------------------- #
+
+    def freeze_session(self, session: str) -> bool:
+        """Refuse new submits for ``session`` while its KV pages are in
+        flight to another backend. Returns whether the session has a
+        recorded token path to export. In-flight requests already in a
+        slot run to completion — freezing gates ADMISSION, not decode,
+        so nothing in progress is torn."""
+        s = str(session)
+        self._frozen_sessions.add(s)
+        return s in self._session_paths
+
+    def resume_session(self, session: str) -> None:
+        """Lift a migration freeze (the absorb path when the page
+        shipment failed and this backend must keep serving)."""
+        self._frozen_sessions.discard(str(session))
+
+    def export_session(self, session: str) -> Optional[Dict[str, Any]]:
+        """Freeze ``session`` and export the KV pages covering its last
+        committed token path (``kv_cache.export_pages`` — the same doc
+        the disagg prefill→decode hand-off ships). None when the engine
+        runs contiguous, the session is unknown, or its pages were
+        already evicted — the migration target then re-prefills."""
+        path = self._session_paths.get(str(session))
+        self.freeze_session(session)
+        if self._kv is None or path is None:
+            return None
+        return self._kv.export_pages(path)
 
     def enqueue_kv_import(self, doc: Dict[str, Any]) -> None:
         """Queue a wire-received page doc for splicing (any thread);
@@ -1342,6 +1392,14 @@ class LMEngine:
                     [req.prompt, np.asarray(req.out[:-1], np.int32)])
                 self._kv.release(req.kv_lease, seq)
                 req.kv_lease = None
+                if req.session is not None:
+                    # the committed token path IS the session's
+                    # exportable KV state — fleet/migrate.py ships the
+                    # pages covering it on a scale-in drain
+                    self._session_paths[req.session] = seq
+                    self._session_paths.move_to_end(req.session)
+                    while len(self._session_paths) > SESSION_PATHS_LIMIT:
+                        self._session_paths.popitem(last=False)
                 self._table_host[slot] = 0
             if req.temperature > 0.0:
                 # restore greedy defaults so a finished sampled stream

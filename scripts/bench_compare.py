@@ -82,6 +82,13 @@ LANES: Dict[str, int] = {
     "autotune_warm_sweeps": -1,
     "autotune_flash_vs_hand": +1,
     "autotune_flash_tuned_ms": -1,
+    # fleet autoscaling (fleet/): live session migration must stay
+    # cheap (wall seconds per migrated session, end to end including
+    # the KV-page ship), and goodput after halving the fleet under
+    # load must hold against the unhalved run (ratio >= the SLO floor
+    # — streams surviving a scale-in is the tentpole claim)
+    "fleet_migration_seconds": -1,
+    "fleet_halved_goodput_ratio": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

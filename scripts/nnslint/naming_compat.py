@@ -66,6 +66,20 @@ unit reservation is shared with the profile layer
 (``nnstpu_slo_burn_ratio``). check_slo enforces all three directions,
 mirroring check_profile.
 
+Fleet placement (docs/autoscale.md): ``nnstpu_fleet_*`` metric series,
+``fleet.*`` spans, and the ``fleet.scale_*``/``fleet.migrate_*`` event
+subfamilies belong to nnstreamer_tpu/fleet/ — the autoscale controller
+and session migrator own the scaling/migration audit trail, while
+obs/fleet.py keeps the pre-existing federation events (``fleet.push``,
+``fleet.expire``, ...), which is why the fleet *event layer* as a whole
+is not package-confined, only those two verb subfamilies. The
+``replicas`` gauge unit is reserved to the fleet layer, and
+``AUTOSCALE_HOOK`` is assigned only inside nnstreamer_tpu/fleet/ (its
+None default plus enable()/disable()) — every other module reads it
+behind a single None check, which is what keeps the scheduler's
+occupancy tap zero-overhead while autoscaling is off. check_fleet
+enforces all of it, mirroring check_tune.
+
 Router placement (docs/resilience.md "Fleet routing & failover"): the
 ``router`` metric/span/event layer belongs to
 nnstreamer_tpu/query/router.py — the multi-backend dispatch telemetry
@@ -97,21 +111,24 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
-          "router", "profile", "sched", "slo", "disagg", "tune")
+          "router", "profile", "sched", "slo", "disagg", "tune",
+          "fleet")
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
     # _state: enumerated-condition gauges (e.g. breaker 0/1/2);
     # _pages: KV-page pool occupancy (serving kv_ family only);
-    # _ratio/_flops: utilization + roofline gauges (profile layer only)
+    # _ratio/_flops: utilization + roofline gauges (profile layer only);
+    # _replicas: live-backend census (fleet controller only)
     "gauge": ("depth", "slots", "bytes", "state", "pages", "ratio",
-              "flops"),
+              "flops", "replicas"),
 }
 #: span layers add "device" — device.xprof has no metric series —
 #: and "router" (the dispatch span, query/router.py) and "disagg"
-#: (the KV-page transfer span, serving/disagg.py)
+#: (the KV-page transfer span, serving/disagg.py) and "fleet" (the
+#: live-migration span, fleet/migrate.py)
 SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
-               "disagg")
+               "disagg", "fleet")
 #: event layers additionally allow "core" (the core/log.py bridge),
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
@@ -818,6 +835,105 @@ def check_tune(root: Path = SOURCE_ROOT):
                 f"nnstreamer_tpu/tune/ + obs/profile.py — consumers "
                 f"read the hook behind one None check; only "
                 f"tune.enable()/disable() install and clear it")
+    return problems
+
+
+#: the ``fleet`` *metric* layer and ``fleet.*`` spans are owned by the
+#: autoscale package; the fleet *event* layer is shared with obs/
+#: fleet.py (federation audit trail predates the controller), so only
+#: the controller's verb subfamilies are package-confined
+FLEET_LAYER = "fleet"
+FLEET_DIR = "fleet"
+#: event subfamilies the controller/migrator own: fleet.scale_up,
+#: fleet.scale_in, fleet.migrate_start/done/abandon — obs/fleet.py
+#: keeps fleet.push/expire/merge_conflict/drain_confirmed/...
+FLEET_EVENT_PREFIXES = ("scale_", "migrate_")
+#: the ``replicas`` gauge unit is the controller's census vocabulary
+FLEET_UNITS = frozenset({"replicas"})
+#: module-level assignment to the autoscale hook; matches
+#: ``AUTOSCALE_HOOK = ...`` and ``_fleet.AUTOSCALE_HOOK = ...`` alike
+_FLEET_HOOK_ASSIGN_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)*AUTOSCALE_HOOK\s*=[^=]", re.MULTILINE)
+
+
+def _is_fleet_pkg(path: Path) -> bool:
+    return path.parts[-2] == FLEET_DIR
+
+
+def check_fleet(root: Path = SOURCE_ROOT):
+    """Autoscaler naming/placement lint.
+
+    * ``fleet``-layer metrics (``nnstpu_fleet_*``) are registered only
+      under nnstreamer_tpu/fleet/, and registrations inside that
+      package use no other layer — the controller counts its own
+      scale actions and migrations; obs/fleet.py (the federation
+      aggregator) registers nothing.
+    * the ``replicas`` gauge unit stays reserved to the fleet layer
+      (a replica census elsewhere should route through the
+      controller, not fork the convention).
+    * ``fleet.*`` spans are emitted only from nnstreamer_tpu/fleet/.
+    * ``fleet.scale_*`` / ``fleet.migrate_*`` events are emitted only
+      from nnstreamer_tpu/fleet/ — the fleet event layer itself stays
+      open because obs/fleet.py owns the federation subfamily
+      (fleet.push, fleet.expire, fleet.drain_confirmed, ...).
+    * ``AUTOSCALE_HOOK`` is assigned only inside nnstreamer_tpu/fleet/
+      (the None default plus enable()/disable()) — every other module
+      may only *read* it behind a single None check, which keeps the
+      scheduler's occupancy tap zero-overhead while autoscaling is
+      off. Mirrors check_tune's TUNE_HOOK rule.
+    """
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_pkg = _is_fleet_pkg(path)
+        if layer == FLEET_LAYER and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{FLEET_LAYER!r} layer outside nnstreamer_tpu/fleet/ "
+                f"— scaling telemetry lives with the controller")
+        elif in_pkg and layer != FLEET_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} registered inside "
+                f"nnstreamer_tpu/fleet/ must use the {FLEET_LAYER!r} "
+                f"layer, not {layer!r}")
+        elif m.group("unit") in FLEET_UNITS and layer != FLEET_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{m.group('unit')!r} gauge unit reserved for the "
+                f"{FLEET_LAYER!r} layer")
+    for path, lineno, name in iter_span_sites(root):
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == FLEET_LAYER and not _is_fleet_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: span {name!r} uses the "
+                f"{FLEET_LAYER!r} layer outside nnstreamer_tpu/fleet/")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == FLEET_LAYER \
+                and m.group("event").startswith(FLEET_EVENT_PREFIXES) \
+                and not _is_fleet_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses a fleet "
+                f"scale_*/migrate_* subfamily outside nnstreamer_tpu/"
+                f"fleet/ — the controller owns the scaling audit trail")
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _FLEET_HOOK_ASSIGN_RE.finditer(text):
+            if _is_fleet_pkg(path):
+                continue
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: AUTOSCALE_HOOK assigned "
+                f"outside nnstreamer_tpu/fleet/ — consumers read the "
+                f"hook behind one None check; only fleet.enable()/"
+                f"disable() install and clear it")
     return problems
 
 

@@ -142,3 +142,14 @@ class TuneRule(_NamingRule):
                    "TUNE_HOOK is assigned only by tune.enable()/"
                    "disable() and obs/profile.py")
     checks = (_compat.check_tune,)
+
+
+@register_rule
+class FleetRule(_NamingRule):
+    id = "naming/fleet"
+    description = ("nnstpu_fleet_* metrics, fleet.* spans, and the "
+                   "fleet.scale_*/migrate_* event subfamilies live in "
+                   "fleet/; the replicas gauge unit is fleet-only; "
+                   "AUTOSCALE_HOOK is assigned only by "
+                   "fleet.enable()/disable()")
+    checks = (_compat.check_fleet,)

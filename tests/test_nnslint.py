@@ -1098,3 +1098,133 @@ class TestTunePlacement:
         from scripts.nnslint import naming_compat
 
         assert naming_compat.check_tune() == []
+
+# --------------------------------------------------------------------------- #
+# fleet placement (naming/fleet via naming_compat.check_fleet)
+# --------------------------------------------------------------------------- #
+
+class TestFleetPlacement:
+    """check_fleet ownership: nnstpu_fleet_* metrics, fleet.* spans,
+    and the fleet.scale_*/migrate_* event subfamilies live in
+    nnstreamer_tpu/fleet/; the replicas gauge unit is fleet-only;
+    AUTOSCALE_HOOK is assigned only by fleet/ itself — the scheduler
+    READS it behind one None check (the zero-overhead contract)."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_fleet_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_fleet_stray_total", "h", ())
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "lives with the controller" in problems[0]
+
+    def test_foreign_layer_inside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"fleet/controller.py": """
+            def setup(reg):
+                reg.counter("nnstpu_pipeline_oops_total", "h", ())
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "must use the 'fleet' layer" in problems[0]
+
+    def test_replicas_unit_outside_layer_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/stray.py": """
+            def setup(reg):
+                reg.gauge("nnstpu_serving_worker_replicas", "h", ())
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "reserved for the 'fleet' layer" in problems[0]
+
+    def test_fleet_span_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"query/router.py": """
+            def go(tracing):
+                span = tracing.start_span("fleet.migrate")
+                span.end()
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "span 'fleet.migrate'" in problems[0]
+
+    def test_scale_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            def warn(events):
+                events.record("fleet.scale_up", "w", msg="x")
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "scale_*/migrate_*" in problems[0]
+
+    def test_federation_events_stay_open(self, tmp_path):
+        # obs/fleet.py owns the federation subfamily — the event layer
+        # as a whole is NOT package-confined, only the controller verbs
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            def note(events):
+                events.record("fleet.push", "i", msg="x")
+                events.record("fleet.expire", "w", msg="x")
+                events.record("fleet.drain_confirmed", "i", msg="x")
+            """})
+        assert naming_compat.check_fleet(root) == []
+
+    def test_hook_assignment_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"sched/engine.py": """
+            from .. import fleet as _fleet
+
+            def hijack(ctl):
+                _fleet.AUTOSCALE_HOOK = ctl
+            """})
+        problems = naming_compat.check_fleet(root)
+        assert len(problems) == 1
+        assert "AUTOSCALE_HOOK assigned outside" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "fleet/__init__.py": """
+                AUTOSCALE_HOOK = None
+
+                def enable(ctl):
+                    global AUTOSCALE_HOOK
+                    AUTOSCALE_HOOK = ctl
+                """,
+            "fleet/controller.py": """
+                def setup(reg, events, tracing):
+                    reg.gauge("nnstpu_fleet_worker_replicas", "h",
+                              ("controller",))
+                    reg.counter("nnstpu_fleet_scale_actions_total", "h",
+                                ("controller", "action"))
+                    events.record("fleet.scale_in", "i", msg="x")
+                    span = tracing.start_span("fleet.migrate")
+                    span.end()
+                """,
+            "sched/engine.py": """
+                def tap(_fleet, name, occ):
+                    hook = _fleet.AUTOSCALE_HOOK
+                    if hook is not None:
+                        hook.observe_occupancy(name, occ)
+                """,
+        })
+        assert naming_compat.check_fleet(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_fleet() == []
