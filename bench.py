@@ -1780,6 +1780,89 @@ def _fleet_lane(device) -> dict:
         return {}
 
 
+def _diag_lane(device) -> dict:
+    """Incident diagnostics (obs/diag/): a traced multi-tenant sched
+    run with the diag taps live, then the two costs that decide whether
+    diag may stay on in production — ``diag_capture_seconds``, the wall
+    cost of freezing one full debug bundle (evidence rings populated),
+    and ``diag_critpath_coverage_ratio``, the fraction of root-span
+    time the segment sweep attributes to a known segment rather than
+    ``host_other`` (the attribution must explain the latency, not just
+    conserve it)."""
+    import tempfile
+    import traceback
+
+    try:
+        from nnstreamer_tpu.core.buffer import TensorMemory
+        from nnstreamer_tpu.obs import diag as _diag
+        from nnstreamer_tpu.obs import tracing as _tracing
+        from nnstreamer_tpu.sched import DeviceEngine
+
+        class _Filt:
+            def invoke(self, inputs):
+                return [inputs[0].host() * 2]
+
+            def invoke_coalesced(self, groups):
+                return [[g[0].host() * 2] for g in groups]
+
+        was_tracing = _tracing.enabled()
+        _tracing.store().reset()
+        _tracing.enable()
+        with tempfile.TemporaryDirectory() as td:
+            deng = _diag.enable(td)
+            try:
+                eng = DeviceEngine("bench-diag", autostart=False,
+                                   max_coalesce=8)
+                filt = _Filt()
+                tenants = [eng.register(f"t{i}") for i in range(4)]
+                coverages = []
+                for req in range(24):
+                    with _tracing.store().start_span(
+                            "serving.request",
+                            attrs={"tenant": f"t{req % 4}"}) as root:
+                        futs = [t.submit(
+                            filt,
+                            [TensorMemory(np.ones((8, 8), np.float32))],
+                            label="mm") for t in tenants]
+                        while eng.pending():
+                            eng.step()
+                        for f in futs:
+                            f.result(5.0)
+                    res = _diag.analyze(
+                        _tracing.store().spans_of(root.context.trace_id))
+                    if res is not None:
+                        assert (sum(res["segments"].values())
+                                == res["total_ns"])
+                        coverages.append(res["coverage_ratio"])
+                cap_secs = []
+                for i in range(5):
+                    t0 = time.monotonic()
+                    bid = deng.bundles.capture(
+                        {"kind": "manual", "key": f"bench-{i}",
+                         "detail": {}})
+                    cap_secs.append(time.monotonic() - t0)
+                    assert bid is not None
+                row = {
+                    "diag_config":
+                        "4 tenants x 24 traced requests, coalesce<=8, "
+                        "full-collector bundle x5",
+                    "diag_capture_seconds": round(
+                        float(np.median(cap_secs)), 4),
+                    "diag_critpath_coverage_ratio": round(
+                        float(np.median(coverages)), 4),
+                    "diag_traces_analyzed": len(coverages),
+                }
+            finally:
+                _diag.disable()
+                (_tracing.enable if was_tracing else _tracing.disable)()
+                _tracing.store().reset()
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -2150,6 +2233,9 @@ def main() -> None:
             if os.environ.get("BENCH_FLEET", "1") != "0":
                 _mark("fleet autoscale lane starting")
                 result.update(_fleet_lane(device))
+            if os.environ.get("BENCH_DIAG", "1") != "0":
+                _mark("diag capture/critpath lane starting")
+                result.update(_diag_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":

@@ -34,7 +34,11 @@ dispatch loop per chip coalescing same-shape work across pipelines and
 serving engines, weighted-DRR fair — docs/scheduler.md),
 --slo TENANT:p99=MS:goodput=R (per-tenant SLO objectives: cost
 attribution, goodput accounting, and burn-rate alerting via obs.slo —
-docs/observability.md "SLO & tenant accounting"). Setting the
+docs/observability.md "SLO & tenant accounting"),
+--diag[=DIR] (incident diagnostics: critical-path latency attribution
+and automatic debug bundles on SLO burn / watchdog DEGRADED / fleet
+actions / cost anomalies, inspected offline with nns-diag —
+docs/observability.md "Diagnostics & debug bundles"). Setting the
 ``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
 harness for the run (docs/resilience.md "Chaos harness").
 """
@@ -71,11 +75,11 @@ def _normalize_argv(argv):
             except ValueError:
                 deferred.append(tok)
                 continue
-        if tok == "--tune" and out and not out[0].startswith("-") \
-                and "!" in out[0]:
-            # --tune takes a PATH, not a number: defer only when the
-            # next token is unmistakably the pipeline (bang syntax) so
-            # both `--tune store.json <pipe>` and `--tune '<pipe>'`
+        if tok in ("--tune", "--diag") and out \
+                and not out[0].startswith("-") and "!" in out[0]:
+            # --tune/--diag take a PATH, not a number: defer only when
+            # the next token is unmistakably the pipeline (bang syntax)
+            # so both `--tune store.json <pipe>` and `--tune '<pipe>'`
             # parse; `--tune=store.json` needs no help
             deferred.append(tok)
             continue
@@ -109,6 +113,18 @@ def main(argv=None) -> int:
                     help="enable the flight recorder (obs.events) and dump "
                          "the event journal to PATH as JSON lines at exit "
                          "('-' dumps human-readable to stderr)")
+    ap.add_argument("--diag", metavar="DIR", nargs="?", const="",
+                    default=None,
+                    help="enable incident diagnostics (obs.diag): "
+                         "critical-path latency attribution at "
+                         "/debug/diag/critpath and automatic debug "
+                         "bundles (SLO burn, watchdog DEGRADED, fleet "
+                         "scale/migrate, cost anomaly) at "
+                         "/debug/bundles, written under DIR (default "
+                         "./.nnstpu-diag); implies --trace; inspect "
+                         "bundles offline with nns-diag — "
+                         "docs/observability.md 'Diagnostics & debug "
+                         "bundles'")
     ap.add_argument("--profile", type=int, nargs="?", const=4096,
                     default=None, metavar="N",
                     help="enable the device-time profiler (obs.profile) "
@@ -413,14 +429,27 @@ def main(argv=None) -> int:
         print(f"fleet: pushing as {psh.instance} "
               f"({'query-wire piggyback' if url is None else url})",
               file=sys.stderr)
-    if args.trace or args.profile is not None:
+    if args.trace or args.profile is not None or args.diag is not None:
         # like metrics: must be on BEFORE p.start() so the element
         # chains get the span-opening wrap at instrumentation time
         # (--profile implies tracing: the Perfetto host lanes come
-        # from pipeline.element spans)
+        # from pipeline.element spans; --diag implies tracing: the
+        # critical path is computed from spans)
         from .obs import tracing
 
         tracing.enable()
+    if args.diag is not None:
+        # AFTER --tune's enable (the trigger engine adopts the tuner's
+        # cost model for dispatch-anomaly detection when present) and
+        # BEFORE p.start() so the sched/serving taps cover warmup;
+        # events feed the bundle's flight-recorder stanza
+        from .obs import diag as _diag_mod
+        from .obs import events as _events_mod
+
+        _events_mod.enable()
+        deng = _diag_mod.enable(args.diag or None)
+        print(f"diag: bundles -> {deng.bundles.directory} "
+              "(critpath at /debug/diag/critpath)", file=sys.stderr)
     if args.profile is not None:
         # hooks install process-wide, so "before p.start()" is a
         # convention here, not a requirement — but enabling early
@@ -587,6 +616,26 @@ def main(argv=None) -> int:
 
             print(_tune_mod.report(), file=sys.stderr)
             _tune_mod.disable()  # persists the store for the next run
+        if args.diag is not None:
+            from .obs import diag as _diag_mod
+
+            deng = _diag_mod.engine()
+            if deng is not None:
+                ts = deng.triggers.stats
+                bundles = deng.bundles.list()
+                print(f"diag: {ts['fired']} bundle(s) captured "
+                      f"({ts['offered']} trigger(s) offered, "
+                      f"{ts['rate_limited']} rate-limited, "
+                      f"{ts['deduped']} deduped)", file=sys.stderr)
+                for b in bundles[:4]:
+                    cause = b.get("cause") or {}
+                    print(f"diag:   {b['id']}  cause="
+                          f"{cause.get('kind')}:{cause.get('key')}",
+                          file=sys.stderr)
+                if bundles:
+                    print(f"diag: inspect with: nns-diag "
+                          f"{deng.bundles.directory}", file=sys.stderr)
+            _diag_mod.disable()
         if args.events_dump is not None:
             from .obs import events
 

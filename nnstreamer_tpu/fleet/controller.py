@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.log import logger
 from ..graph.element import join_or_warn
+from ..obs import diag as _diag
 from ..obs import events as _events
 from ..obs import metrics as _obs
 from ..resilience import policy as _rp
@@ -164,6 +165,10 @@ class FleetController:
         self._breaker = _rp.CircuitBreaker(_rp.fleet_breaker_name(name))
         self._journal: deque = deque(maxlen=int(journal_limit))
         self._seq = 0
+        #: the signal snapshot the CURRENT tick decided on — journaled
+        #: with every action so each entry records the evidence
+        #: (occupancy, burn, census) that crossed the threshold
+        self._last_signals: Dict[str, Any] = {}
         self._occ: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._launched: Dict[str, LaunchHandle] = {}
@@ -212,6 +217,7 @@ class FleetController:
         """One deterministic tick: observe → decide → act → journal."""
         self.stats["ticks"] += 1
         signals = self.observe()
+        self._last_signals = signals
         decision = self.policy.decide(signals)
         _REPLICAS.labels(self.name).set(float(signals["replicas"]))
         if decision.action == "scale_up":
@@ -251,9 +257,16 @@ class FleetController:
                      **extra: Any) -> Dict[str, Any]:
         self._seq += 1
         entry = {"seq": self._seq, "t": self._clock(), "action": action,
-                 "reason": reason, **extra}
+                 "reason": reason,
+                 "signals": dict(self._last_signals), **extra}
         self._journal.append(entry)
         _SCALE_ACTIONS.labels(self.name, action).inc()
+        dhook = _diag.DIAG_HOOK
+        if dhook is not None:
+            # real scale/migrate actions are diag capture triggers
+            # (the hook ignores skips/holds); the journaled entry rides
+            # inside the bundle's cause detail
+            dhook.on_fleet_action(action, entry)
         return entry
 
     def actions(self) -> List[Dict[str, Any]]:

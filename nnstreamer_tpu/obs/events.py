@@ -119,7 +119,11 @@ class EventRing:
         ctx = _tracing.current_context()
         ev = {
             "seq": 0,  # assigned under the lock
+            # both clock domains: wall ("ts") correlates events across
+            # hosts in a fleet bundle, monotonic ("mono_ns") orders and
+            # measures them locally without clock-step ambiguity
             "ts": time.time(),
+            "mono_ns": time.monotonic_ns(),
             "type": etype,
             "severity": severity,
             "message": message,

@@ -31,6 +31,18 @@ health and debug surfaces:
   * ``GET /debug/slo``               — per-tenant cost attribution,
     goodput, objectives and burn rates (obs/slo.py); includes the
     fleet rollup when this process aggregates
+  * ``GET /debug/diag/critpath``     — per-tenant critical-path
+    latency attribution (obs/diag): where each tenant's P99 goes,
+    segment by segment; works from tracing alone, richer when the
+    diag engine is enabled; ``?min_ms=<float>`` filters traces
+  * ``GET /debug/bundles``           — incident debug bundles captured
+    by the diag trigger engine (newest first) plus trigger stats;
+    includes the fleet-wide bundle view when aggregating
+  * ``GET /debug/bundles/<id>``      — one full bundle document (feed
+    it to ``nns-diag`` for the offline waterfall); 503 while diag off
+  * ``GET /debug/version``           — build identity: package
+    version, jax version, device kind, python (also exported as the
+    ``nnstpu_build_info`` gauge)
   * ``POST /fleet/push``             — snapshot-push ingestion for
     workers without a query wire; 503 unless aggregating
 
@@ -74,10 +86,59 @@ from . import profile as _profile
 from . import slo as _slo
 from . import tracing as _tracing
 
-__all__ = ["MetricsExporter", "start_exporter"]
+__all__ = ["MetricsExporter", "start_exporter", "build_info"]
 
 #: Prometheus text exposition content type (format 0.0.4)
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def build_info() -> dict:
+    """Code-identity snapshot: package version, jax version, device
+    kind, python. Served at ``/debug/version``, embedded in every debug
+    bundle, and exposed as the ``nnstpu_build_info`` gauge — the three
+    places an incident reader asks "what code produced this?".
+    Import-light and failure-tolerant (jax may be absent or mid-init)."""
+    import platform
+
+    from .. import __version__
+
+    try:
+        import jax
+
+        jax_version = str(jax.__version__)
+        dev = jax.devices()[0]
+        device_kind = str(getattr(dev, "device_kind", None)
+                          or getattr(dev, "platform", "unknown"))
+    except Exception:
+        jax_version = "unavailable"
+        device_kind = "unknown"
+    return {
+        "version": __version__,
+        "jax": jax_version,
+        "device_kind": device_kind,
+        "python": platform.python_version(),
+    }
+
+
+_BUILD_INFO_PUBLISHED = False
+
+
+def _publish_build_info() -> None:
+    """Register the constant-1 ``nnstpu_build_info`` gauge (Prometheus
+    build-info idiom: the identity lives in the labels). Deferred to
+    exporter start — probing jax for the device kind at import time
+    would cost every non-serving import a device query."""
+    global _BUILD_INFO_PUBLISHED
+    if _BUILD_INFO_PUBLISHED:
+        return
+    _BUILD_INFO_PUBLISHED = True
+    info = build_info()
+    _metrics.registry().gauge(
+        "nnstpu_build_info",
+        "Build identity: constant 1; version/jax/device_kind labels "
+        "carry the information",
+        ("version", "jax", "device_kind"),
+    ).labels(info["version"], info["jax"], info["device_kind"]).set(1.0)
 
 
 class MetricsExporter:
@@ -258,6 +319,62 @@ class MetricsExporter:
                         snap if snap.get("enabled") else None)}
                 self._json(200, snap)
 
+            def _get_version(self, query):
+                self._json(200, build_info())
+
+            def _get_diag_critpath(self, query):
+                # critpath is pure span-store analysis: it answers with
+                # tracing alone even when the full diag engine (bundle
+                # capture) is off — evidence should not need opting in
+                from . import diag as _diag
+
+                try:
+                    min_ms = float(
+                        parse_qs(query).get("min_ms", ["0"])[0])
+                except ValueError:
+                    self._reply(400, "text/plain",
+                                b"min_ms must be a number")
+                    return
+                eng = _diag.DIAG_HOOK
+                if eng is not None:
+                    self._json(200, {"diag_enabled": True,
+                                     **eng.critpath(min_ms)})
+                else:
+                    self._json(200, {
+                        "diag_enabled": False,
+                        "tracing_enabled": _tracing.enabled(),
+                        **_diag.rollup(_tracing.store(), min_ms=min_ms),
+                    })
+
+            def _get_bundles(self, query):
+                from . import diag as _diag
+
+                eng = _diag.DIAG_HOOK
+                agg = _fleet.aggregator()
+                self._json(200, {
+                    "diag_enabled": eng is not None,
+                    "bundles": eng.bundles.list()
+                    if eng is not None else [],
+                    "triggers": dict(eng.triggers.stats)
+                    if eng is not None else None,
+                    "fleet": agg.diag_rollup() if agg is not None
+                    else None,
+                })
+
+            def _get_bundle(self, bid, query):
+                from . import diag as _diag
+
+                eng = _diag.DIAG_HOOK
+                if eng is None:
+                    self._json(503, {"error": "diag is off (enable "
+                                     "with --diag or NNSTPU_DIAG=1)"})
+                    return
+                doc = eng.bundles.get(bid)
+                if doc is None:
+                    self._json(404, {"error": f"unknown bundle {bid!r}"})
+                else:
+                    self._json(200, doc)
+
             def _post_fleet_push(self, query):
                 body = self._read_body()
                 if body is None:
@@ -295,9 +412,15 @@ class MetricsExporter:
                 ("GET", "/debug/profile/samples"): _get_profile_samples,
                 ("GET", "/debug/slo"): _get_slo,
                 ("GET", "/debug/tune"): _get_tune,
+                ("GET", "/debug/diag/critpath"): _get_diag_critpath,
+                ("GET", "/debug/bundles"): _get_bundles,
+                ("GET", "/debug/version"): _get_version,
                 ("POST", "/fleet/push"): _post_fleet_push,
             }
-            _PREFIX_ROUTES = ((("GET", "/debug/traces/"), _get_trace),)
+            _PREFIX_ROUTES = (
+                (("GET", "/debug/traces/"), _get_trace),
+                (("GET", "/debug/bundles/"), _get_bundle),
+            )
             _HINT = ("not found (try " + ", ".join(sorted(
                 [p if m == "GET" else f"{m} {p}" for m, p in _ROUTES]
                 + [(p if m == "GET" else f"{m} {p}") + "<id>"
@@ -321,6 +444,7 @@ class MetricsExporter:
                 pass
 
         self.registry = reg
+        _publish_build_info()
         try:
             self._server = ThreadingHTTPServer((host, int(port)), Handler)
         except OSError as e:

@@ -130,6 +130,13 @@ TUNE_ADOPT_HOOK = None
 #: only by fleet.enable()/disable() (nnslint ownership rule).
 FLEET_ACTIONS_HOOK = None
 
+#: obs/diag installs a zero-arg callable returning the local debug-
+#: bundle references + trigger accounting (DiagEngine.push_doc) so an
+#: aggregator can enumerate the whole fleet's captured evidence for
+#: one incident. None keeps the push doc exactly as before; assigned
+#: only by obs/diag enable()/disable() (nnslint diag ownership rule).
+DIAG_PUSH_HOOK = None
+
 
 def default_instance() -> str:
     """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
@@ -184,6 +191,10 @@ def build_push(instance: str, role: str, seq: int,
         # answer "who scaled what, when, and why"
         "fleet_actions": (FLEET_ACTIONS_HOOK()
                           if FLEET_ACTIONS_HOOK is not None else None),
+        # None while diag is off (same contract): bundle references +
+        # trigger accounting, so the aggregator enumerates fleet-wide
+        # incident evidence without shipping the bundles themselves
+        "diag": DIAG_PUSH_HOOK() if DIAG_PUSH_HOOK is not None else None,
     }
 
 
@@ -367,8 +378,8 @@ class _Instance:
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
                  "metrics", "health", "ready", "slo", "kv_prefix",
-                 "tune", "actions", "via", "pushes", "spans_ingested",
-                 "first_mono", "last_mono")
+                 "tune", "actions", "diag", "via", "pushes",
+                 "spans_ingested", "first_mono", "last_mono")
 
     def __init__(self, instance: str):
         self.instance = instance
@@ -389,6 +400,9 @@ class _Instance:
         #: the instance's autoscale action journal (None until a
         #: controller there pushes one)
         self.actions: Optional[List[Dict[str, Any]]] = None
+        #: the instance's diag slice: debug-bundle references +
+        #: trigger accounting (None until diag pushes one)
+        self.diag: Optional[Dict[str, Any]] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -534,6 +548,7 @@ class FleetAggregator:
         kv_prefix = doc.get("kv_prefix")
         tune_doc = doc.get("tune")
         actions_doc = doc.get("fleet_actions")
+        diag_doc = doc.get("diag")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -564,6 +579,8 @@ class FleetAggregator:
                 rec.tune = tune_doc
             if isinstance(actions_doc, list):
                 rec.actions = actions_doc
+            if isinstance(diag_doc, dict):
+                rec.diag = diag_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -873,6 +890,18 @@ class FleetAggregator:
             recs = list(self._instances.values())
         return {rec.instance: rec.actions for rec in recs
                 if rec.actions is not None}
+
+    def diag_rollup(self) -> Dict[str, Any]:
+        """Fleet-wide incident evidence (``/debug/bundles``): every
+        live instance's pushed bundle references + trigger accounting,
+        keyed by instance — given one incident's time window, this
+        enumerates which instances captured evidence for it and which
+        bundle ids to fetch from whom."""
+        self._expire_now()
+        with self._lock:
+            recs = list(self._instances.values())
+        return {rec.instance: rec.diag for rec in recs
+                if rec.diag is not None}
 
     def longest_prefix(self, hashes: Sequence[str]
                        ) -> Tuple[Optional[str], int]:

@@ -142,6 +142,14 @@ class Component:
     def set_status(self, status: Status, detail: str = "") -> None:
         if status != self.status:
             self.since = time.time()
+            if status >= Status.DEGRADED:
+                # escalation is the diag capture moment: freeze the
+                # evidence rings before they age past the incident
+                # (lazy import: diag's collectors read this module)
+                from . import diag as _diag
+                dhook = _diag.DIAG_HOOK
+                if dhook is not None:
+                    dhook.on_degraded(self.name, detail)
         self.status = status
         self.detail = detail
 

@@ -259,6 +259,20 @@ class Profiler:
         return recs if kind is None else [r for r in recs
                                           if r["kind"] == kind]
 
+    def diag_snapshot(self, max_records: int = 256) -> Dict[str, Any]:
+        """Bounded freeze for obs.diag debug bundles: full stats and
+        aggregated samples, but only the newest ``max_records`` raw
+        records — a bundle must stay shippable, and the raw ring can
+        hold tens of thousands of dispatch rows."""
+        recs = self.records()
+        return {
+            "enabled": self._enabled,
+            "stats": self.stats(),
+            "records_total": len(recs),
+            "records": recs[-max_records:],
+            "samples": self.samples(),
+        }
+
     # -- compile observability (filters/xla.py) ------------------------- #
     def on_jit_cache(self, site: str, hit: bool) -> None:
         """Count a jit-cache lookup. site="bundle" is the metadata-level

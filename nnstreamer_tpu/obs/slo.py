@@ -600,6 +600,12 @@ def event_burn_alert(component: str, data: Dict[str, Any]) -> None:
         worst_burn=data.get("worst_burn"),
         breached_objectives=data.get("breached_objectives"),
     )
+    # burn alerts are THE diag capture trigger — cold path, lazy
+    # import keeps the obs package import graph acyclic
+    from . import diag as _diag
+    dhook = _diag.DIAG_HOOK
+    if dhook is not None:
+        dhook.on_burn_alert(component, data)
 
 
 def event_burn_recover(component: str, data: Dict[str, Any]) -> None:

@@ -56,6 +56,7 @@ import random
 import socket
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -696,11 +697,10 @@ class QueryRouter:
         self._lat_lock = threading.Lock()
         self._latencies: List[float] = []
         self._caps: Callable[[], str] = lambda: ""
-        import weakref
-
         ref = weakref.ref(self)
         for be in backends.backends():
             self._register_gauges(ref, be.endpoint)
+        _live_routers.add(self)
 
     def _register_gauges(self, ref, endpoint: str) -> None:
         _BACKEND_STATE.labels(self.name, endpoint).set_function(
@@ -992,6 +992,19 @@ class QueryRouter:
 
     def close(self) -> None:
         self.backends.close()
+
+
+#: live router registry (WeakSet, like obs/tracing's pipeline
+#: registry): a collected router never lingers in a debug bundle's
+#: routing view
+_live_routers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def routing_view() -> List[Dict[str, Any]]:
+    """Snapshot of every live router — the bundle capture's routing
+    evidence (who was routable, breakers, inflight, EWMA) at incident
+    time."""
+    return [r.snapshot() for r in list(_live_routers)]
 
 
 class _ShedSignal(Exception):

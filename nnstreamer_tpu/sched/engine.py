@@ -52,6 +52,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 from ..core.log import logger
 from ..graph.element import join_or_warn
 from .. import fleet as _fleet
+from ..obs import diag as _diag
 from ..obs import health as _health
 from ..obs import profile as _profile
 from ..obs import slo as _slo
@@ -104,7 +105,7 @@ class WorkFuture:
 
 class _Work:
     __slots__ = ("tenant", "key", "filt", "inputs", "fn", "future",
-                 "t_enq", "deadline", "label")
+                 "t_enq", "deadline", "label", "diag")
 
     def __init__(self, tenant: "Tenant", key: Any, filt: Any,
                  inputs: Any, fn: Optional[Callable[[], Any]],
@@ -119,6 +120,9 @@ class _Work:
         self.t_enq = t_enq
         self.deadline = deadline
         self.label = label
+        # (trace ctx, monotonic enqueue ns) captured at submit when the
+        # diag layer is on — feeds the critical-path sched_wait span
+        self.diag: Any = None
 
 
 def _work_rows(w: "_Work") -> int:
@@ -372,6 +376,9 @@ class DeviceEngine:
             deadline = _rp.Deadline.after_ms(tenant.deadline_ms)
         work = _Work(tenant, key, filt, inputs, fn, fut,
                      self.clock(), deadline, label)
+        dhook = _diag.DIAG_HOOK
+        if dhook is not None:
+            work.diag = dhook.tap_submit()
         if deadline is not None and deadline.expired():
             self._shed(work, "deadline expired at submit")
             return fut
@@ -568,6 +575,10 @@ class DeviceEngine:
             # engine busy fraction as a scale signal, sampled at batch
             # boundaries — same one-load None gate as the hooks above
             fhook.observe_occupancy(self.name, self.occupancy())
+        dhook = _diag.DIAG_HOOK
+        if dhook is not None:
+            # critical-path spans + cost-anomaly sample for the batch
+            dhook.observe_sched_batch(self.name, batch, t0, t1)
 
     def _dispatch(self, batch: List[_Work]) -> List[Any]:
         """One device dispatch for the whole batch; returns per-item

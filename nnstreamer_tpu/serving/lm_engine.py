@@ -66,6 +66,7 @@ import numpy as np
 
 from .. import tune as _tune
 from ..models import causal_lm
+from ..obs import diag as _diag
 from ..obs import events as _events
 from ..obs import health as _health
 from ..obs import metrics as _obs
@@ -500,6 +501,10 @@ class LMEngine:
         # evicted session its migration warmth.
         self._session_paths: "OrderedDict[str, np.ndarray]" = OrderedDict()
         self._frozen_sessions: set = set()
+        # sessions whose migration was absorbed (resume_session): their
+        # NEXT prefill re-derives state the fleet failed to ship, and
+        # the diag critical path bills it as re_prefill, not compute
+        self._reprefill_sessions: set = set()
         # decode_steps/slot_steps/wasted_slot_steps account the CHUNK
         # path only (bench waste_frac reads them; its serving lane runs
         # chunk mode); speculative iterations are accounted separately
@@ -878,6 +883,7 @@ class LMEngine:
         """Lift a migration freeze (the absorb path when the page
         shipment failed and this backend must keep serving)."""
         self._frozen_sessions.discard(str(session))
+        self._reprefill_sessions.add(str(session))
 
     def export_session(self, session: str) -> Optional[Dict[str, Any]]:
         """Freeze ``session`` and export the KV pages covering its last
@@ -978,6 +984,12 @@ class LMEngine:
                 pspan = _tracing.start_span(
                     "serving.prefill", parent=req.span.context,
                     attrs={"bucket": tb, "slot": slot})
+                if req.session is not None \
+                        and req.session in self._reprefill_sessions:
+                    # post-absorb recompute, not fresh work — the diag
+                    # critical path bills this span as re_prefill
+                    self._reprefill_sessions.discard(req.session)
+                    pspan.set_attribute("re_prefill", True)
             tp0 = time.monotonic_ns() \
                 if (_profile.ENGINE_HOOK is not None
                     or _slo.ENGINE_SLO_HOOK is not None) else 0
@@ -1381,6 +1393,13 @@ class LMEngine:
                           and req.deadline.expired())
                 shook.record_outcome(
                     self._slo_tenant(), "missed" if missed else "met",
+                    max(time.monotonic() - req.t_submit, 0.0))
+            dhook = _diag.DIAG_HOOK
+            if dhook is not None:
+                dhook.observe_request(
+                    self._engine_label, req.rid, req.session,
+                    req.span.context.trace_id
+                    if req.span is not None else None,
                     max(time.monotonic() - req.t_submit, 0.0))
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None

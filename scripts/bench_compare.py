@@ -89,6 +89,13 @@ LANES: Dict[str, int] = {
     # — streams surviving a scale-in is the tentpole claim)
     "fleet_migration_seconds": -1,
     "fleet_halved_goodput_ratio": +1,
+    # incident diagnostics (obs/diag/): freezing a full debug bundle
+    # must stay cheap enough to fire from a burn alert in production,
+    # and the critical-path sweep must keep attributing root-span time
+    # to real segments (a coverage drop means the taps stopped seeing
+    # the latency they are supposed to explain)
+    "diag_capture_seconds": -1,
+    "diag_critpath_coverage_ratio": +1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

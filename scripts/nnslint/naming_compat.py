@@ -88,6 +88,19 @@ check_router enforces it, mirroring check_resilience. Cardinality note:
 the ``backend`` label on router series carries configured ``host:port``
 endpoints — bounded by fleet size, NEVER per-request/session values.
 
+Diag placement (docs/observability.md "Diagnostics & debug bundles"):
+the ``diag`` metric/span/event layer belongs to nnstreamer_tpu/obs/
+diag/ — the incident-diagnostics engine back-fills its synthetic
+``diag.sched_wait``/``diag.sched_run`` spans (via SpanStore.add_span,
+which this lint greps next to start_span) and emits its trigger/bundle
+audit events there only, and ``DIAG_HOOK`` is assigned only inside
+that package (None default plus enable()/disable()) — consumers read
+it behind a single None check, keeping the sched/serving taps
+zero-overhead while diagnostics are off. The Prometheus-conventional
+``nnstpu_build_info`` identity gauge is exempt from the
+<layer>_<name>_<unit> shape and pinned to obs/exporter.py. check_diag
+enforces all of it, mirroring check_fleet.
+
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
 calls, ``.start_span(...)`` / ``start_span(...)`` tracing calls, and
@@ -112,7 +125,13 @@ SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
           "router", "profile", "sched", "slo", "disagg", "tune",
-          "fleet")
+          "fleet", "diag")
+
+#: families exempt from the nnstpu_<layer>_<name>_<unit> shape: the
+#: Prometheus-conventional ``<prefix>_build_info`` identity gauge has
+#: no unit by design (value is constantly 1; the labels carry the
+#: payload) — check_diag pins its one registration to obs/exporter.py
+EXEMPT_NAMES = frozenset({"nnstpu_build_info"})
 UNIT_BY_TYPE = {
     "counter": ("total",),
     "histogram": ("seconds",),
@@ -127,8 +146,11 @@ UNIT_BY_TYPE = {
 #: and "router" (the dispatch span, query/router.py) and "disagg"
 #: (the KV-page transfer span, serving/disagg.py) and "fleet" (the
 #: live-migration span, fleet/migrate.py)
+#: and "diag" (the synthetic queue-wait/batch-run spans the diag
+#: engine back-fills into request traces via SpanStore.add_span,
+#: obs/diag/)
 SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
-               "disagg", "fleet")
+               "disagg", "fleet", "diag")
 #: event layers additionally allow "core" (the core/log.py bridge),
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
@@ -142,9 +164,11 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
 #: prefill/decode split: re-prefill fallbacks + page spills,
 #: serving/disagg.py), and "tune" (the autotuner's sweep/adoption
 #: audit trail, nnstreamer_tpu/tune/)
+#: and "diag" (the incident-diagnostics subsystem: trigger fires and
+#: bundle captures — obs/diag/)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
                 "fleet", "resilience", "chaos", "router", "profile",
-                "sched", "slo", "disagg", "tune")
+                "sched", "slo", "disagg", "tune", "diag")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -210,9 +234,12 @@ _CALL_RE = re.compile(
 _NAME_RE = re.compile(
     r"^nnstpu_(?P<layer>[a-z0-9]+)_(?P<body>[a-z0-9_]+)_(?P<unit>[a-z0-9]+)$")
 
-#: start_span("name"... — both module-level and store-method calls;
-#: \b keeps e.g. ``restart_spanner(`` from matching
-_SPAN_CALL_RE = re.compile(r"\bstart_span\(\s*[\"']([^\"']+)[\"']")
+#: start_span("name"... — both module-level and store-method calls —
+#: plus add_span("name"... (the diag engine's synthetic-span insertion
+#: path takes the same literal first argument); \b keeps e.g.
+#: ``restart_spanner(`` from matching
+_SPAN_CALL_RE = re.compile(
+    r"\b(?:start_span|add_span)\(\s*[\"']([^\"']+)[\"']")
 
 _SPAN_NAME_RE = re.compile(
     r"^(?P<layer>[a-z]+)\.(?P<op>[a-z][a-z0-9_]*)$")
@@ -332,6 +359,8 @@ def check_names(root: Path = SOURCE_ROOT):
     found = 0
     for path, lineno, mtype, name in iter_registrations(root):
         found += 1
+        if name in EXEMPT_NAMES:
+            continue  # identity gauges; ownership pinned by check_diag
         where = _where(path, lineno)
         m = _NAME_RE.match(name)
         if m is None:
@@ -934,6 +963,94 @@ def check_fleet(root: Path = SOURCE_ROOT):
                 f"outside nnstreamer_tpu/fleet/ — consumers read the "
                 f"hook behind one None check; only fleet.enable()/"
                 f"disable() install and clear it")
+    return problems
+
+
+#: the ``diag`` metric/span/event layer is owned by the incident-
+#: diagnostics package (obs/diag/): synthetic queue-wait/batch-run
+#: spans, trigger/bundle events, and any diag series are emitted
+#: there only. The ``nnstpu_build_info`` identity gauge is registered
+#: once, in obs/exporter.py (it serves /debug/version too).
+DIAG_LAYER = "diag"
+DIAG_PKG = ("obs", "diag")
+BUILD_INFO_NAME = "nnstpu_build_info"
+BUILD_INFO_FILE = ("obs", "exporter.py")
+#: module-level assignment to the diag hook; matches ``DIAG_HOOK =
+#: ...`` and ``_diag.DIAG_HOOK = ...`` alike. Cannot match the
+#: distinct fleet-side ``DIAG_PUSH_HOOK`` name (obs/fleet.py owns
+#: that slot; diag.enable()/disable() install and clear it)
+_DIAG_HOOK_ASSIGN_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)*DIAG_HOOK\s*=[^=]", re.MULTILINE)
+
+
+def _is_diag_pkg(path: Path) -> bool:
+    return tuple(path.parts[-3:-1]) == DIAG_PKG
+
+
+def check_diag(root: Path = SOURCE_ROOT):
+    """Incident-diagnostics naming/placement lint.
+
+    * ``diag``-layer metrics are registered only under
+      nnstreamer_tpu/obs/diag/, and the ``nnstpu_build_info`` identity
+      gauge (exempt from the <layer>_<name>_<unit> shape) only in
+      obs/exporter.py.
+    * ``diag.*`` spans — the synthetic sched_wait/sched_run spans the
+      engine back-fills via ``SpanStore.add_span`` — are created only
+      from nnstreamer_tpu/obs/diag/.
+    * ``diag.*`` events are emitted only from nnstreamer_tpu/obs/diag/.
+    * ``DIAG_HOOK`` is assigned only inside nnstreamer_tpu/obs/diag/
+      (the None default plus enable()/disable()) — every other module
+      may only *read* it behind a single None check, which is what
+      keeps the scheduler and serving taps zero-overhead while
+      diagnostics are off. Mirrors check_fleet's AUTOSCALE_HOOK rule.
+    """
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        if name == BUILD_INFO_NAME:
+            if tuple(path.parts[-2:]) != BUILD_INFO_FILE:
+                problems.append(
+                    f"{_where(path, lineno)}: {name!r} registered "
+                    f"outside nnstreamer_tpu/obs/exporter.py — the "
+                    f"build-identity gauge has one owner")
+            continue
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        if m.group("layer") == DIAG_LAYER and not _is_diag_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{DIAG_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"diag/ — diagnostics telemetry lives with the engine")
+    for path, lineno, name in iter_span_sites(root):
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == DIAG_LAYER and not _is_diag_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: span {name!r} uses the "
+                f"{DIAG_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"diag/ — only the diag engine back-fills synthetic "
+                f"spans")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == DIAG_LAYER and not _is_diag_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{DIAG_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"diag/")
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _DIAG_HOOK_ASSIGN_RE.finditer(text):
+            if _is_diag_pkg(path):
+                continue
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: DIAG_HOOK assigned outside "
+                f"nnstreamer_tpu/obs/diag/ — consumers read the hook "
+                f"behind one None check; only diag.enable()/disable() "
+                f"install and clear it")
     return problems
 
 

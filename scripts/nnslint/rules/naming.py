@@ -145,6 +145,16 @@ class TuneRule(_NamingRule):
 
 
 @register_rule
+class DiagRule(_NamingRule):
+    id = "naming/diag"
+    description = ("diag telemetry, diag.* synthetic spans, and diag.* "
+                   "events live in obs/diag/; nnstpu_build_info is "
+                   "registered only in obs/exporter.py; DIAG_HOOK is "
+                   "assigned only by diag.enable()/disable()")
+    checks = (_compat.check_diag,)
+
+
+@register_rule
 class FleetRule(_NamingRule):
     id = "naming/fleet"
     description = ("nnstpu_fleet_* metrics, fleet.* spans, and the "

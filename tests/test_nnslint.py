@@ -1228,3 +1228,134 @@ class TestFleetPlacement:
         from scripts.nnslint import naming_compat
 
         assert naming_compat.check_fleet() == []
+
+
+# --------------------------------------------------------------------------- #
+# diag placement (naming/diag via naming_compat.check_diag)
+# --------------------------------------------------------------------------- #
+
+class TestDiagPlacement:
+    """check_diag ownership: diag-layer telemetry, diag.* synthetic
+    spans (start_span AND add_span sites), and diag.* events live in
+    nnstreamer_tpu/obs/diag/; nnstpu_build_info is registered only by
+    obs/exporter.py; DIAG_HOOK is assigned only by obs/diag/ itself —
+    the sched/serving taps READ it behind one None check (the
+    zero-overhead contract)."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_diag_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_diag_bundles_total", "h", ())
+            """})
+        problems = naming_compat.check_diag(root)
+        assert len(problems) == 1
+        assert "lives with the engine" in problems[0]
+
+    def test_diag_span_outside_package_fires(self, tmp_path):
+        # the add_span form too: synthetic back-fill is diag-only
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"sched/engine.py": """
+            def go(store, ctx, t0, t1):
+                store.add_span("diag.sched_run", ctx.trace_id,
+                               ctx.span_id, t0, t1)
+            """})
+        problems = naming_compat.check_diag(root)
+        assert len(problems) == 1
+        assert "synthetic spans" in problems[0]
+
+    def test_diag_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/health.py": """
+            def warn(events):
+                events.record("diag.capture", "i", msg="x")
+            """})
+        problems = naming_compat.check_diag(root)
+        assert len(problems) == 1
+        assert "event 'diag.capture'" in problems[0]
+
+    def test_build_info_outside_exporter_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/metrics.py": """
+            def setup(reg):
+                reg.gauge("nnstpu_build_info", "h",
+                          ("version", "jax", "device_kind"))
+            """})
+        problems = naming_compat.check_diag(root)
+        assert len(problems) == 1
+        assert "one owner" in problems[0]
+
+    def test_build_info_exempt_from_name_shape(self, tmp_path):
+        # the identity gauge has no unit suffix by design — check_names
+        # must not flag it (check_diag pins its ownership instead)
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/exporter.py": """
+            def setup(reg):
+                reg.gauge("nnstpu_build_info", "h",
+                          ("version", "jax", "device_kind"))
+            """})
+        assert naming_compat.check_names(root) == []
+        assert naming_compat.check_diag(root) == []
+
+    def test_hook_assignment_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"sched/engine.py": """
+            from ..obs import diag as _diag
+
+            def hijack(eng):
+                _diag.DIAG_HOOK = eng
+            """})
+        problems = naming_compat.check_diag(root)
+        assert len(problems) == 1
+        assert "DIAG_HOOK assigned outside" in problems[0]
+
+    def test_push_hook_in_obs_fleet_stays_silent(self, tmp_path):
+        # DIAG_PUSH_HOOK is a DIFFERENT slot (obs/fleet.py owns it;
+        # diag.enable() installs into it) — the DIAG_HOOK assign regex
+        # must not cross-match it
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            DIAG_PUSH_HOOK = None
+
+            def build_push():
+                doc = DIAG_PUSH_HOOK() if DIAG_PUSH_HOOK is not None \\
+                    else None
+                return doc
+            """})
+        assert naming_compat.check_diag(root) == []
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "obs/diag/__init__.py": """
+                DIAG_HOOK = None
+
+                def enable(eng, store, ctx):
+                    global DIAG_HOOK
+                    store.add_span("diag.sched_wait", ctx.trace_id,
+                                   ctx.span_id, 0, 1)
+                    DIAG_HOOK = eng
+                """,
+            "sched/engine.py": """
+                def tap(_diag, name, batch, t0, t1):
+                    hook = _diag.DIAG_HOOK
+                    if hook is not None:
+                        hook.observe_sched_batch(name, batch, t0, t1)
+                """,
+        })
+        assert naming_compat.check_diag(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_diag() == []
