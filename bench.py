@@ -1863,6 +1863,131 @@ def _diag_lane(device) -> dict:
         return {}
 
 
+def _quality_lane(device) -> dict:
+    """Data-plane quality (obs/quality/): the two costs that decide
+    whether the layer may stay on in production —
+    ``quality_overhead_ratio``, an instrumented pipeline's throughput
+    over the uninstrumented run's (the <=5% overhead acceptance gate:
+    the ratio must hold >= 0.95), and ``quality_drift_detect_seconds``,
+    the wall time from the first frame of a shifted distribution to the
+    both-windows PSI breach against a frozen baseline (short real
+    windows — the lane proves the mechanism, not the 60s defaults)."""
+    import tempfile
+    import traceback
+
+    try:
+        from nnstreamer_tpu.core import Buffer
+        from nnstreamer_tpu.graph import Pipeline
+        from nnstreamer_tpu.obs import quality as _quality
+
+        rng = np.random.default_rng(21)
+        # the overhead gate is measured against the headline pipeline
+        # SHAPE (video src -> converter -> mobilenet filter -> decoder
+        # -> sink: every tap kind fires every frame) at a CPU-sized
+        # input; the toy scaler pipelines elsewhere in this file move
+        # bare buffers in ~100us/frame, which no per-frame statistics
+        # layer can honestly undercut 20x
+        q_size = int(os.environ.get("BENCH_QUALITY_SIZE", "96"))
+        n_frames = int(os.environ.get("BENCH_QUALITY_FRAMES", "64"))
+        labels_path = os.path.join(tempfile.mkdtemp(), "labels.txt")
+        with open(labels_path, "w", encoding="utf-8") as fp:
+            fp.write("\n".join(f"class{i}" for i in range(CLASSES)))
+
+        def run_fps() -> float:
+            p = Pipeline()
+            src = p.add_new("videotestsrc", width=q_size, height=q_size,
+                            num_buffers=n_frames, pattern="random")
+            conv = p.add_new("tensor_converter")
+            filt = p.add_new(
+                "tensor_filter", framework="xla-tpu",
+                model=f"zoo://mobilenet_v2?width=1.0&size={q_size}")
+            dec = p.add_new("tensor_decoder", mode="image_labeling",
+                            option1=labels_path, async_depth=8)
+            sink = p.add_new("tensor_sink")
+            Pipeline.link(src, conv, filt, dec, sink)
+            t0 = time.monotonic()
+            p.run(timeout=300)
+            return n_frames / max(time.monotonic() - t0, 1e-9)
+
+        _quality.disable()
+        run_fps()  # warmup (compile, element registry, allocator)
+        # interleaved off/on pairs, best-of each arm: a sequential
+        # off-block then on-block puts any slow machine-load drift
+        # entirely on one arm, and a single GC stall poisons a median
+        # of three — pairing cancels the drift, max() the stalls
+        off_runs, on_runs = [], []
+        try:
+            for _ in range(4):
+                _quality.disable()
+                off_runs.append(run_fps())
+                _quality.enable()
+                on_runs.append(run_fps())
+        finally:
+            _quality.disable()
+        fps_off = float(max(off_runs))
+        fps_on = float(max(on_runs))
+
+        # drift detection: freeze a baseline on the reference
+        # distribution, then feed a shifted stream until both windows
+        # breach (frames keep arriving while the slow window fills, so
+        # the reading is arrival-to-page wall time, not just window
+        # length)
+        fast_s, slow_s = 0.05, 0.25
+        ref = rng.normal(1.0, 0.25, (64, 32, 32)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as td:
+            base_path = os.path.join(td, "baseline.json")
+            eng = _quality.enable()
+            try:
+                for f in ref:
+                    eng.observe_chain("cam0", Buffer.of(f))
+                eng.save_baseline(base_path)
+            finally:
+                _quality.disable()
+            eng = _quality.enable(baseline=base_path,
+                                  fast_window_s=fast_s,
+                                  slow_window_s=slow_s)
+            try:
+                # healthy traffic first: both windows must hold
+                # on-baseline scores before the shift, so the reading
+                # is switch-to-breach (old low scores have to age out
+                # or be outvoted), not first-sample-into-empty-windows
+                t0 = time.monotonic()
+                i = 0
+                while time.monotonic() - t0 < slow_s * 1.2:
+                    eng.observe_chain("cam0", Buffer.of(ref[i % len(ref)]))
+                    i += 1
+                    time.sleep(0.005)
+                shifted = (ref[0] * 512.0)  # nine octaves away
+                detect_s = None
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 10.0:
+                    eng.observe_chain("cam0", Buffer.of(shifted))
+                    ev = eng.evaluate("chain:cam0")
+                    if ev is not None and ev["drift"] is not None \
+                            and ev["drift"]["breached"]:
+                        detect_s = time.monotonic() - t0
+                        break
+                    time.sleep(0.005)
+            finally:
+                _quality.disable()
+        row = {
+            "quality_config": (
+                f"{n_frames}-frame mobilenet_v2 size={q_size} headline "
+                f"shape, best of 4 interleaved off/on pairs; drift "
+                f"windows fast={fast_s}s slow={slow_s}s"),
+            "quality_overhead_ratio": round(fps_on / fps_off, 4),
+            "quality_fps_off": round(fps_off, 1),
+            "quality_fps_on": round(fps_on, 1),
+        }
+        if detect_s is not None:
+            row["quality_drift_detect_seconds"] = round(detect_s, 4)
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _last_json_record(stdout: str, key: str):
     """Last stdout line that parses as JSON and carries ``key``."""
     for line in reversed(stdout.strip().splitlines()):
@@ -2236,6 +2361,9 @@ def main() -> None:
             if os.environ.get("BENCH_DIAG", "1") != "0":
                 _mark("diag capture/critpath lane starting")
                 result.update(_diag_lane(device))
+            if os.environ.get("BENCH_QUALITY", "1") != "0":
+                _mark("quality overhead/drift lane starting")
+                result.update(_quality_lane(device))
             _mark("composite LSTM+query bench starting")
             result.update(_composite_bench())
             if os.environ.get("BENCH_SCHED_MULTIPLEX", "1") != "0":

@@ -38,7 +38,11 @@ docs/observability.md "SLO & tenant accounting"),
 --diag[=DIR] (incident diagnostics: critical-path latency attribution
 and automatic debug bundles on SLO burn / watchdog DEGRADED / fleet
 actions / cost anomalies, inspected offline with nns-diag —
-docs/observability.md "Diagnostics & debug bundles"). Setting the
+docs/observability.md "Diagnostics & debug bundles"),
+--quality[=SPEC]/--quality-record (data-plane quality telemetry:
+per-tap tensor stats, PSI drift scoring against a recorded baseline,
+NaN-storm/dead-output anomaly rules and LM confidence aggregation via
+obs.quality — docs/observability.md "Data-plane quality"). Setting the
 ``NNS_TPU_CHAOS`` env var to a JSON fault plan installs the chaos
 harness for the run (docs/resilience.md "Chaos harness").
 """
@@ -75,12 +79,13 @@ def _normalize_argv(argv):
             except ValueError:
                 deferred.append(tok)
                 continue
-        if tok in ("--tune", "--diag") and out \
+        if tok in ("--tune", "--diag", "--quality") and out \
                 and not out[0].startswith("-") and "!" in out[0]:
-            # --tune/--diag take a PATH, not a number: defer only when
-            # the next token is unmistakably the pipeline (bang syntax)
-            # so both `--tune store.json <pipe>` and `--tune '<pipe>'`
-            # parse; `--tune=store.json` needs no help
+            # --tune/--diag/--quality take a PATH/SPEC, not a number:
+            # defer only when the next token is unmistakably the
+            # pipeline (bang syntax) so both `--tune store.json <pipe>`
+            # and `--tune '<pipe>'` parse; `--tune=store.json` needs
+            # no help
             deferred.append(tok)
             continue
         out.insert(0, tok)
@@ -125,6 +130,27 @@ def main(argv=None) -> int:
                          "bundles offline with nns-diag — "
                          "docs/observability.md 'Diagnostics & debug "
                          "bundles'")
+    ap.add_argument("--quality", metavar="SPEC", nargs="?", const="",
+                    default=None,
+                    help="enable data-plane quality telemetry "
+                         "(obs.quality): per-tap tensor stats (Welford "
+                         "moments, NaN/Inf/zero counts, log-bucket "
+                         "sketch), PSI drift scoring against a "
+                         "--quality-record baseline, NaN-storm / "
+                         "dead-output anomaly rules (flip quality:<tap> "
+                         "DEGRADED under --watchdog and auto-capture a "
+                         "debug bundle under --diag), and LM confidence "
+                         "aggregation; SPEC is comma-separated "
+                         "key=value (taps=chain+filter+decoder+lm, "
+                         "every=N, psi=F, fast=SEC, slow=SEC, "
+                         "nan_storm=N, dead_frames=N, sample_cap=N, "
+                         "baseline=PATH) — docs/observability.md "
+                         "'Data-plane quality'")
+    ap.add_argument("--quality-record", metavar="PATH", default=None,
+                    help="freeze the run's cumulative per-tap sketches "
+                         "to PATH as a JSON drift baseline at exit "
+                         "(feed back via --quality baseline=PATH; "
+                         "needs --quality)")
     ap.add_argument("--profile", type=int, nargs="?", const=4096,
                     default=None, metavar="N",
                     help="enable the device-time profiler (obs.profile) "
@@ -318,6 +344,16 @@ def main(argv=None) -> int:
             slo_objectives = _slo_mod.parse_slo_spec(args.slo)
         except ValueError as e:
             ap.error(f"--slo: {e}")
+    if args.quality_record is not None and args.quality is None:
+        ap.error("--quality-record needs --quality (no stats are "
+                 "recorded without the quality layer)")
+    if args.quality:
+        from .obs import quality as _quality_mod
+
+        try:
+            _quality_mod.parse_quality_spec(args.quality)
+        except ValueError as e:
+            ap.error(f"--quality: {e}")
     if args.kv_pages is not None and args.kv_page_size is None:
         ap.error("--kv-pages needs --kv-page-size (paging is off without "
                  "a page size)")
@@ -490,6 +526,25 @@ def main(argv=None) -> int:
         print(f"slo: tracking {len(slo_objectives)} objective "
               f"tenant(s): {', '.join(sorted(slo_objectives))}",
               file=sys.stderr)
+    if args.quality is not None:
+        # BEFORE p.start() so the very first frames (and warmup
+        # prefills) are observed; events give the anomaly audit trail
+        # the same way --diag does. Anomaly → DEGRADED needs
+        # --watchdog, anomaly → debug bundle needs --diag — quality
+        # alone still records stats, drift and confidence.
+        from .obs import events as _events_mod
+        from .obs import quality as _quality_mod
+
+        _events_mod.enable()
+        try:
+            qeng = _quality_mod.enable(args.quality or None)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: --quality: {e}", file=sys.stderr)
+            return 1
+        print(f"quality: data-plane telemetry on (taps: "
+              f"{', '.join(sorted(qeng.taps_enabled))})"
+              f"{' with drift baseline' if qeng.baseline is not None else ''}",
+              file=sys.stderr)
     t0 = time.monotonic()
     try:
         p.start()
@@ -616,6 +671,19 @@ def main(argv=None) -> int:
 
             print(_tune_mod.report(), file=sys.stderr)
             _tune_mod.disable()  # persists the store for the next run
+        if args.quality is not None:
+            from .obs import quality as _quality_mod
+
+            print(_quality_mod.report(), file=sys.stderr)
+            if args.quality_record is not None:
+                try:
+                    _quality_mod.save_baseline(args.quality_record)
+                    print(f"quality: baseline -> {args.quality_record}",
+                          file=sys.stderr)
+                except OSError as e:
+                    print(f"ERROR: --quality-record: {e}",
+                          file=sys.stderr)
+            _quality_mod.disable()
         if args.diag is not None:
             from .obs import diag as _diag_mod
 

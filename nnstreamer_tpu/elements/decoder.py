@@ -13,6 +13,7 @@ from ..core.buffer import Buffer
 from ..core.types import Caps, TensorsConfig
 from ..decoders.base import Decoder, find_decoder
 from ..graph.element import Element, FlowReturn, Pad, register_element
+from ..obs import quality as _quality
 
 
 @register_element
@@ -79,7 +80,7 @@ class TensorDecoder(Element):
     def chain(self, pad: Pad, buf: Buffer) -> Optional[FlowReturn]:
         depth = int(self.async_depth or 0)
         if depth <= 0:
-            return self.push(self._decoder.decode(buf, self._config))
+            return self._emit(self._decoder.decode(buf, self._config))
         token = self._decoder.submit(buf, self._config)
         self._pending.append((token, self._config))
         ret: Optional[FlowReturn] = None
@@ -90,13 +91,22 @@ class TensorDecoder(Element):
                 len(self._pending) > depth
                 or self._decoder.token_ready(self._pending[0][0])):
             token, cfg = self._pending.popleft()
-            ret = self.push(self._decoder.complete(token, cfg))
+            ret = self._emit(self._decoder.complete(token, cfg))
         return ret
+
+    def _emit(self, out: Buffer) -> Optional[FlowReturn]:
+        """Single exit point for decoded output — both the synchronous
+        and the async-drain paths land here, so the quality tap below
+        is the one and only decoder tap (inspect-pinned)."""
+        qhook = _quality.QUALITY_HOOK
+        if qhook is not None:
+            qhook.observe_decoder(self.name, out)
+        return self.push(out)
 
     def on_eos(self) -> None:
         while self._pending:
             token, cfg = self._pending.popleft()
-            self.push(self._decoder.complete(token, cfg))
+            self._emit(self._decoder.complete(token, cfg))
 
     def stop(self) -> None:
         self._pending.clear()

@@ -27,6 +27,7 @@ from ..core.buffer import Buffer
 from ..core.types import Caps
 from ..core.log import logger
 from ..obs import events as _events
+from ..obs import quality as _quality
 from .events import Bus, Event, EventType, Message, MessageType
 
 log = logger("element")
@@ -117,6 +118,12 @@ class Pad:
             if CHAOS_CHAIN_HOOK is not None \
                     and CHAOS_CHAIN_HOOK(peer.element.name, buf):
                 return FlowReturn.OK  # buffer dropped by the fault plan
+            # data-plane quality tap (obs/quality): observes the buffer
+            # the peer actually receives — after chaos, so an injected
+            # corruption is visible to the NaN-storm rule
+            qhook = _quality.QUALITY_HOOK
+            if qhook is not None:
+                qhook.observe_chain(peer.element.name, buf)
             if PROFILE_CHAIN_HOOK is not None:
                 ret = PROFILE_CHAIN_HOOK(peer, buf)
             else:

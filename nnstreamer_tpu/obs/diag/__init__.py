@@ -145,6 +145,14 @@ class DiagEngine:
         return self.triggers.offer("watchdog_degraded", component,
                                    {"detail": detail} if detail else None)
 
+    def on_quality_anomaly(self, component: str,
+                           data: Optional[Dict[str, Any]] = None
+                           ) -> Optional[str]:
+        """obs/quality anomaly verdict (NaN storm, dead output, drift
+        breach) — fired by the watchdog *before* the generic DEGRADED
+        transition so this richer cause wins the rate limit."""
+        return self.triggers.offer("quality_anomaly", component, data)
+
     def on_fleet_action(self, action: str,
                         entry: Optional[Dict[str, Any]] = None
                         ) -> Optional[str]:

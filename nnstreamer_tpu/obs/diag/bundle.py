@@ -4,8 +4,10 @@ A bundle freezes what the bounded obs rings would otherwise age out —
 the slowest span trees (with raw integer-ns spans so the offline
 critical-path sweep stays conservation-exact), the event ring, the
 profiler's records and samples, sched occupancy/coalesce stats, the
-routing view, the fleet action journal, and the SLO burn state — plus
-the build info pinning the code that produced it.
+routing view, the fleet action journal, the SLO burn state, and the
+data-plane quality stats (per-tap tensor moments + anomaly verdicts,
+when obs/quality is on) — plus the build info pinning the code that
+produced it.
 
 Collectors are plain callables assembled in :func:`default_collectors`
 (lazy imports keep obs package cycles out); a collector that raises
@@ -88,6 +90,13 @@ def default_collectors() -> Dict[str, Callable[[], Any]]:
 
         return _exporter.build_info()
 
+    def _quality_snap() -> Any:
+        # raises when quality is off → degrades to an error stanza,
+        # which is the documented "quality was not enabled" marker
+        from .. import quality as _quality
+
+        return _quality.bundle_data()
+
     return {
         "events": _events_snap,
         "profile": _profile_snap,
@@ -96,6 +105,7 @@ def default_collectors() -> Dict[str, Callable[[], Any]]:
         "fleet_actions": _fleet_actions,
         "slo": _slo.snapshot,
         "health": _health.snapshot,
+        "quality": _quality_snap,
         "build": _build,
         "_span_store": _tracing.store,  # consumed structurally below
     }

@@ -2,7 +2,7 @@
 disk, without letting a flapping alert turn the bundle directory into
 a second event ring.
 
-Four cause kinds feed :meth:`TriggerEngine.offer`:
+Five cause kinds feed :meth:`TriggerEngine.offer`:
 
 - ``slo_burn``          — obs/slo.py burn alert (key: component)
 - ``watchdog_degraded`` — obs/health.py DEGRADED verdict (key: component)
@@ -10,6 +10,8 @@ Four cause kinds feed :meth:`TriggerEngine.offer`:
 - ``cost_anomaly``      — measured sched dispatch time vs the tune/
   cost-model expectation (or the label's own running mean when the
   model doesn't cover it), z-score above threshold (key: label)
+- ``quality_anomaly``   — obs/quality data-plane verdict (NaN storm,
+  dead output, drift breach) at the watchdog (key: component)
 
 Two independent brakes, both on an injectable clock so the
 determinism test drives them by hand:
@@ -29,7 +31,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 #: causes offer() understands — anything else is rejected loudly in
 #: tests and silently dropped in production paths
 CAUSE_KINDS = ("slo_burn", "watchdog_degraded", "fleet_action",
-               "cost_anomaly")
+               "cost_anomaly", "quality_anomaly")
 
 
 class _Welford:

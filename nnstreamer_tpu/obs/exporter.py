@@ -31,6 +31,13 @@ health and debug surfaces:
   * ``GET /debug/slo``               — per-tenant cost attribution,
     goodput, objectives and burn rates (obs/slo.py); includes the
     fleet rollup when this process aggregates
+  * ``GET /debug/quality``           — data-plane quality telemetry
+    (obs/quality): per-tap tensor stats, drift scores, confidence
+    aggregates and anomaly verdicts; includes the fleet rollup when
+    this process aggregates
+  * ``GET /debug``                   — the debug index: every route in
+    this table, as JSON, derived from the dispatch table itself so it
+    can never go stale
   * ``GET /debug/diag/critpath``     — per-tenant critical-path
     latency attribution (obs/diag): where each tenant's P99 goes,
     segment by segment; works from tracing alone, richer when the
@@ -319,6 +326,27 @@ class MetricsExporter:
                         snap if snap.get("enabled") else None)}
                 self._json(200, snap)
 
+            def _get_quality(self, query):
+                from . import quality as _quality
+
+                snap = _quality.snapshot()
+                agg = _fleet.aggregator()
+                if agg is not None:
+                    snap = {**snap,
+                            "fleet": agg.quality_rollup()}
+                self._json(200, snap)
+
+            def _get_debug_index(self, query):
+                # derived from the dispatch table, like the 404 hint:
+                # an endpoint added there shows up here for free
+                self._json(200, {
+                    "routes": sorted(
+                        f"{m} {p}" for m, p in self._ROUTES),
+                    "prefix_routes": sorted(
+                        f"{m} {p}<id>"
+                        for (m, p), _ in self._PREFIX_ROUTES),
+                })
+
             def _get_version(self, query):
                 self._json(200, build_info())
 
@@ -411,6 +439,8 @@ class MetricsExporter:
                 ("GET", "/debug/profile"): _get_profile,
                 ("GET", "/debug/profile/samples"): _get_profile_samples,
                 ("GET", "/debug/slo"): _get_slo,
+                ("GET", "/debug/quality"): _get_quality,
+                ("GET", "/debug"): _get_debug_index,
                 ("GET", "/debug/tune"): _get_tune,
                 ("GET", "/debug/diag/critpath"): _get_diag_critpath,
                 ("GET", "/debug/bundles"): _get_bundles,

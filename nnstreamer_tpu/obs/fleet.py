@@ -60,6 +60,7 @@ from urllib.parse import urlsplit
 from . import events as _events
 from . import health as _health
 from . import metrics as _metrics
+from . import quality as _quality
 from . import slo as _slo
 from . import tracing as _tracing
 from .metrics import _escape_help, _escape_label, _fmt
@@ -195,6 +196,11 @@ def build_push(instance: str, role: str, seq: int,
         # trigger accounting, so the aggregator enumerates fleet-wide
         # incident evidence without shipping the bundles themselves
         "diag": DIAG_PUSH_HOOK() if DIAG_PUSH_HOOK is not None else None,
+        # None while data-plane quality is off (same contract): the
+        # per-tap frame/NaN/PSI summary + anomaly verdicts, small
+        # enough to ride every push so an aggregator can answer
+        # "which instance's which tap is producing garbage"
+        "quality": _quality.push_data(),
     }
 
 
@@ -378,7 +384,7 @@ class _Instance:
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
                  "metrics", "health", "ready", "slo", "kv_prefix",
-                 "tune", "actions", "diag", "via", "pushes",
+                 "tune", "actions", "diag", "quality", "via", "pushes",
                  "spans_ingested", "first_mono", "last_mono")
 
     def __init__(self, instance: str):
@@ -403,6 +409,9 @@ class _Instance:
         #: the instance's diag slice: debug-bundle references +
         #: trigger accounting (None until diag pushes one)
         self.diag: Optional[Dict[str, Any]] = None
+        #: the instance's data-plane quality slice: per-tap frame/NaN/
+        #: PSI summary + anomaly verdicts (None until quality pushes)
+        self.quality: Optional[Dict[str, Any]] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -549,6 +558,7 @@ class FleetAggregator:
         tune_doc = doc.get("tune")
         actions_doc = doc.get("fleet_actions")
         diag_doc = doc.get("diag")
+        quality_doc = doc.get("quality")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -581,6 +591,8 @@ class FleetAggregator:
                 rec.actions = actions_doc
             if isinstance(diag_doc, dict):
                 rec.diag = diag_doc
+            if isinstance(quality_doc, dict):
+                rec.quality = quality_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -902,6 +914,23 @@ class FleetAggregator:
             recs = list(self._instances.values())
         return {rec.instance: rec.diag for rec in recs
                 if rec.diag is not None}
+
+    def quality_rollup(self) -> Dict[str, Any]:
+        """Fleet-wide data-plane quality (``/debug/quality``): every
+        live instance's pushed per-tap summary keyed by instance, plus
+        the flattened ``anomalous`` list (``instance/tap``) — the one
+        line an operator scans to find which instance's which tap is
+        producing garbage."""
+        self._expire_now()
+        with self._lock:
+            recs = list(self._instances.values())
+        per_instance = {rec.instance: rec.quality for rec in recs
+                        if rec.quality is not None}
+        anomalous = sorted(
+            f"{iid}/{tap}"
+            for iid, doc in per_instance.items()
+            for tap in (doc.get("anomalies") or {}))
+        return {"instances": per_instance, "anomalous": anomalous}
 
     def longest_prefix(self, hashes: Sequence[str]
                        ) -> Tuple[Optional[str], int]:

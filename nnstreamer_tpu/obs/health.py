@@ -400,6 +400,8 @@ class HealthRegistry:
                 self._check_sched(c, st, data or {}, now_ns)
             elif c.kind == "slo":
                 self._check_slo(c, st, data or {})
+            elif c.kind == "quality":
+                self._check_quality(c, st, data or {})
 
     # rule: per-element last-buffer heartbeat → STALLED
     def _check_element(self, c: Component, st: Dict[str, Any],
@@ -565,6 +567,34 @@ class HealthRegistry:
             if c.status == Status.DEGRADED:
                 c.set_status(Status.OK, "burn back under budget")
             _slo.event_burn_recover(c.name, data)
+
+    # rule: data-plane quality anomaly → DEGRADED
+    # (obs/quality registers one kind="quality" component per tap; the
+    # probe is the engine's evaluate(), so — like the slo rule — the
+    # verdict here is pure transition bookkeeping)
+    def _check_quality(self, c: Component, st: Dict[str, Any],
+                       data: Dict[str, Any]) -> None:
+        anomaly = data.get("anomaly")
+        # quality.* event literals live in obs/quality; import lazily
+        # (quality imports this module at load time, so top-level
+        # would cycle)
+        from . import quality as _quality
+        if anomaly:
+            if st.get("anomaly") != anomaly:
+                st["anomaly"] = anomaly
+                # alert first: the quality_anomaly diag cause should
+                # win the trigger rate limit over the generic
+                # watchdog_degraded cause set_status() fires next
+                _quality.event_anomaly_alert(c.name, data)
+                if c.status < Status.DEGRADED:
+                    c.set_status(
+                        Status.DEGRADED,
+                        "quality anomaly: %s (%s)"
+                        % (anomaly, data.get("detail") or "no detail"))
+        elif st.pop("anomaly", None):
+            if c.status == Status.DEGRADED:
+                c.set_status(Status.OK, "quality anomaly cleared")
+            _quality.event_anomaly_recover(c.name, data)
 
     # rule: serving request stuck in admission → STALLED
     def _check_serving(self, c: Component, st: Dict[str, Any],

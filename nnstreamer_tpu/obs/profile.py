@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import events as _events
 from . import metrics as _metrics
+from . import quality as _quality
 from . import slo as _slo
 from . import tracing as _tracing
 
@@ -653,6 +654,7 @@ class Profiler:
 
 _PID_HOST, _PID_DEVICE, _PID_SERVING, _PID_SCHED, _PID_SLO = 1, 2, 3, 4, 5
 _PID_FLEET = 6
+_PID_QUALITY = 7
 
 
 def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
@@ -679,6 +681,9 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
       * pid 6 **fleet** — fleet.* spans (session migrations, one lane
         per operation) from fleet/migrate.py, present when a
         controller has acted
+      * pid 7 **quality** — one counter track per data-plane tap
+        (mean / PSI drift score / cumulative NaN count) from
+        obs/quality, present when quality telemetry is recording
 
     All timestamps share the process monotonic clock (µs)."""
     store = span_store if span_store is not None else _tracing.store()
@@ -829,6 +834,17 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
                          "shed": pt["shed"]},
             })
 
+    q_points = _quality.trace_points()
+    if q_points:
+        meta(_PID_QUALITY, 0, "process_name", "quality")
+        for pt in q_points:
+            ev.append({
+                "name": f"{pt['tap']}.quality", "ph": "C",
+                "ts": pt["t_ns"] / 1e3, "pid": _PID_QUALITY, "tid": 0,
+                "args": {"mean": pt["mean"], "psi": pt["psi"],
+                         "nan": pt["nan"]},
+            })
+
     return {
         "traceEvents": ev,
         "displayTimeUnit": "ms",
@@ -836,6 +852,7 @@ def perfetto_trace(span_store: Optional[_tracing.SpanStore] = None,
             "profile_enabled": p.is_enabled,
             "tracing_enabled": store.is_enabled,
             "slo_enabled": _slo.enabled(),
+            "quality_enabled": _quality.enabled(),
             **p.stats(),
         },
     }

@@ -258,10 +258,39 @@ def _wire_hook(direction: str, cmd: Any, meta: Dict[str, Any],
     return payload
 
 
+def _poison_buffer(buf: Any) -> None:
+    """Graph-side corrupt: silently wreck the buffer's first tensor
+    *in place* (value-semantically — the TensorMemory is replaced, not
+    mutated). Float dtypes become all-NaN, integer dtypes saturate to
+    the dtype max, anything else goes constant-ones. Unlike the wire
+    corrupt (which fails deserialization loudly), this is the quiet
+    failure mode real accelerator bugs produce: data keeps flowing,
+    wrong — exactly what obs/quality's NaN-storm and dead-output rules
+    exist to catch."""
+    import numpy as np
+
+    from ..core.buffer import TensorMemory
+
+    if not getattr(buf, "memories", None):
+        return
+    mem = buf.memories[0]
+    arr = np.array(mem.host(), copy=True)
+    if np.issubdtype(arr.dtype, np.floating) \
+            or np.issubdtype(arr.dtype, np.complexfloating):
+        arr[...] = np.nan
+    elif np.issubdtype(arr.dtype, np.integer):
+        arr[...] = np.iinfo(arr.dtype).max
+    else:
+        arr[...] = 1
+    buf.memories[0] = TensorMemory(arr, info=mem.info)
+
+
 def _chain_hook(element: str, buf: Any) -> bool:
     """Installed as ``element.CHAOS_CHAIN_HOOK``. True drops the
-    buffer; delay sleeps in the pushing thread; disconnect/corrupt
-    raise (the graph turns that into a bus error)."""
+    buffer; delay sleeps in the pushing thread; corrupt NaN-poisons the
+    buffer's first tensor and lets it flow on (see
+    :func:`_poison_buffer`); disconnect/partition raise (the graph
+    turns that into a bus error)."""
     plan = _ACTIVE
     if plan is None:
         return False
@@ -273,6 +302,8 @@ def _chain_hook(element: str, buf: Any) -> bool:
             time.sleep(f.delay_s)
         elif f.kind == "drop":
             drop = True
+        elif f.kind == "corrupt":
+            _poison_buffer(buf)
         else:
             raise RuntimeError(f"chaos: injected {f.kind} at {target}")
     return drop

@@ -71,6 +71,7 @@ from ..obs import events as _events
 from ..obs import health as _health
 from ..obs import metrics as _obs
 from ..obs import profile as _profile
+from ..obs import quality as _quality
 from ..obs import slo as _slo
 from ..obs import tracing as _tracing
 from ..ops.int8 import stack_shape
@@ -138,6 +139,30 @@ def _prefill_admit(params, padded, true_len, skey, temp, top_k, top_p,
     first = sampling.sample_row(
         logits[0], jax.random.fold_in(skey, true_len), temp, top_k, top_p)
     return first, kc, vc, pos
+
+
+def _conf_from_row(row):
+    """Model-confidence signals from one logits row: Shannon entropy
+    (nats) of the softmax, top-1 probability, and the top-1/top-2
+    probability margin — the per-request escalation signal obs/quality
+    records at retirement. Returns a (3,) float32 array."""
+    p = jax.nn.softmax(row.astype(jnp.float32))
+    ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+    top2 = jax.lax.top_k(p, 2)[0]
+    return jnp.stack([ent, top2[0], top2[0] - top2[1]])
+
+
+@partial(jax.jit, static_argnames=("n_heads", "max_len"))
+def _prefill_admit_conf(params, padded, true_len, skey, temp, top_k, top_p,
+                        n_heads, max_len):
+    """`_prefill_admit` plus confidence signals from the first-token
+    logits — a distinct executable, compiled only when obs/quality is
+    recording (the quality-off path never pays for the extra outputs)."""
+    logits, kc, vc, pos = causal_lm.lm_prefill_masked(
+        params, padded, true_len, n_heads, max_len)
+    first = sampling.sample_row(
+        logits[0], jax.random.fold_in(skey, true_len), temp, top_k, top_p)
+    return first, kc, vc, pos, _conf_from_row(logits[0])
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -268,6 +293,19 @@ def _prefill_paged_admit(params, window, kpool, vpool, table, pos0,
     return first, kpool, vpool, pos
 
 
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(2, 3))
+def _prefill_paged_admit_conf(params, window, kpool, vpool, table, pos0,
+                              true_len, skey, temp, top_k, top_p, n_heads):
+    """`_prefill_paged_admit` plus confidence signals — the obs/quality
+    variant of the prefix-hit admission kernel."""
+    logits, kpool, vpool, pos = causal_lm.lm_prefill_paged(
+        params, window, kpool, vpool, table, pos0, true_len, n_heads)
+    first = sampling.sample_row(
+        logits[0], jax.random.fold_in(skey, pos0 + true_len),
+        temp, top_k, top_p)
+    return first, kpool, vpool, pos, _conf_from_row(logits[0])
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def _install_pages(kpool, vpool, kc, vc, table):
     """Scatter a freshly prefilled contiguous slot cache (the no-hit
@@ -322,6 +360,9 @@ class _Request:
     #: kv_cache.PageLease while admitted under paging (None otherwise):
     #: the request's page-table bookkeeping, released at retirement
     kv_lease: Any = None
+    #: (entropy, top1_prob, top2_margin) from the first-token logits —
+    #: set at admission only while obs/quality records, read at retire
+    conf: Any = None
     # tracing (None when tracing is off at submit time): the request
     # span parents admission-wait / prefill / compile / decode children
     span: Any = None            # serving.request — submit → retire
@@ -993,12 +1034,20 @@ class LMEngine:
             tp0 = time.monotonic_ns() \
                 if (_profile.ENGINE_HOOK is not None
                     or _slo.ENGINE_SLO_HOOK is not None) else 0
+            # obs/quality confidence tap: one None check selects the
+            # conf-variant prefill, which also returns the first-token
+            # logits' (entropy, top1, margin) for the retire path
+            want_conf = _quality.QUALITY_HOOK is not None
             if self._kv is None:
                 first = self._prefill_into(
-                    slot, padded, t, skey, temp, tk, tp)
+                    slot, padded, t, skey, temp, tk, tp,
+                    want_conf=want_conf)
             else:
                 first = self._prefill_paged(
-                    slot, padded, hit, ts, skey, temp, tk, tp)
+                    slot, padded, hit, ts, skey, temp, tk, tp,
+                    want_conf=want_conf)
+            if want_conf:
+                first, req.conf = first
             cspan.end()
             self.stats["prefills"] += 1
             lbl = self._engine_label
@@ -1042,19 +1091,28 @@ class LMEngine:
             self._retire_if_done(slot, req)
 
     def _prefill_into(self, slot: int, padded, true_len: int, skey,
-                      temp, tk, tp):
+                      temp, tk, tp, want_conf: bool = False):
         """Prefill one padded prompt and install its cache into ``slot``;
-        returns the first generated token. The device-layout hook a
-        mesh-sharded engine overrides (serving/tp_engine.py)."""
-        first, kc, vc, pos = _prefill_admit(
-            self.params, jnp.asarray(padded), jnp.int32(true_len),
-            skey, temp, tk, tp,
-            n_heads=self.n_heads, max_len=self.max_len)
+        returns the first generated token (with the confidence triple
+        appended when ``want_conf`` — the obs/quality admission path).
+        The device-layout hook a mesh-sharded engine overrides
+        (serving/tp_engine.py)."""
+        conf = None
+        if want_conf:
+            first, kc, vc, pos, conf = _prefill_admit_conf(
+                self.params, jnp.asarray(padded), jnp.int32(true_len),
+                skey, temp, tk, tp,
+                n_heads=self.n_heads, max_len=self.max_len)
+        else:
+            first, kc, vc, pos = _prefill_admit(
+                self.params, jnp.asarray(padded), jnp.int32(true_len),
+                skey, temp, tk, tp,
+                n_heads=self.n_heads, max_len=self.max_len)
         sl = jnp.int32(slot)
         self._kc = _slot_insert(self._kc, kc, sl)
         self._vc = _slot_insert(self._vc, vc, sl)
         self._pos = _slot_insert(self._pos, pos, sl)
-        return first
+        return (first, conf) if want_conf else first
 
     # -- paged-KV scheduling ---------------------------------------------- #
 
@@ -1091,28 +1149,42 @@ class LMEngine:
         return lease.hit_len
 
     def _prefill_paged(self, slot: int, padded, hit: int, true_len: int,
-                       skey, temp, tk, tp):
+                       skey, temp, tk, tp, want_conf: bool = False):
         """Prefill into the slot's pages: the no-hit path runs the
         UNCHANGED contiguous prefill at the slot-view capacity and
         scatters the result into pages (bit-identical by construction);
         a prefix hit prefills only the padded suffix window at pos0 =
-        hit against the gathered view."""
+        hit against the gathered view. ``want_conf`` selects the
+        conf-variant kernels (obs/quality admission path) and switches
+        the return to ``(first, conf)``."""
         kv = self._kv
+        conf = None
         table = jnp.asarray(self._table_host[slot])
         if hit == 0:
-            first, kc, vc, pos = _prefill_admit(
-                self.params, jnp.asarray(padded), jnp.int32(true_len),
-                skey, temp, tk, tp,
-                n_heads=self.n_heads, max_len=self._m_slot)
+            if want_conf:
+                first, kc, vc, pos, conf = _prefill_admit_conf(
+                    self.params, jnp.asarray(padded), jnp.int32(true_len),
+                    skey, temp, tk, tp,
+                    n_heads=self.n_heads, max_len=self._m_slot)
+            else:
+                first, kc, vc, pos = _prefill_admit(
+                    self.params, jnp.asarray(padded), jnp.int32(true_len),
+                    skey, temp, tk, tp,
+                    n_heads=self.n_heads, max_len=self._m_slot)
             kv.kpool, kv.vpool = _install_pages(
                 kv.kpool, kv.vpool, kc, vc, table)
+        elif want_conf:
+            first, kv.kpool, kv.vpool, pos, conf = _prefill_paged_admit_conf(
+                self.params, jnp.asarray(padded), kv.kpool, kv.vpool,
+                table, jnp.int32(hit), jnp.int32(true_len),
+                skey, temp, tk, tp, n_heads=self.n_heads)
         else:
             first, kv.kpool, kv.vpool, pos = _prefill_paged_admit(
                 self.params, jnp.asarray(padded), kv.kpool, kv.vpool,
                 table, jnp.int32(hit), jnp.int32(true_len),
                 skey, temp, tk, tp, n_heads=self.n_heads)
         self._pos = _slot_insert(self._pos, pos, jnp.int32(slot))
-        return first
+        return (first, conf) if want_conf else first
 
     def _ensure_pages(self, active: List[int], w: int) -> None:
         """Grow active slots' page tables to cover the next ``w``
@@ -1401,6 +1473,15 @@ class LMEngine:
                     req.span.context.trace_id
                     if req.span is not None else None,
                     max(time.monotonic() - req.t_submit, 0.0))
+            qhook = _quality.QUALITY_HOOK
+            if qhook is not None and req.conf is not None:
+                # materialize the (3,) confidence triple the admission
+                # prefill computed on-device; quality-off runs never
+                # allocate it, so this D2H read costs nothing then
+                ent, top1, margin = np.asarray(req.conf, np.float64)
+                qhook.record_confidence(
+                    self._engine_label, self._slo_tenant(), req.session,
+                    float(ent), float(top1), float(margin))
             self._finished[req.rid] = req.out
             self._slot_req[slot] = None
             if self._kv is not None and req.kv_lease is not None:

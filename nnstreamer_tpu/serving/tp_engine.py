@@ -74,7 +74,8 @@ from ..parallel.tp_decode import (strip_device_leaves, tp_param_specs,
                                   tp_window_step)
 from ..parallel.tp_prefill import make_tp_prefill
 from . import sampling
-from .lm_engine import LMEngine, _accept_from_window, _slot_insert
+from .lm_engine import (LMEngine, _accept_from_window, _conf_from_row,
+                        _slot_insert)
 
 __all__ = ["TPLMEngine"]
 
@@ -255,7 +256,8 @@ class TPLMEngine(LMEngine):
         return (jax.device_put(zero(shape), dev),
                 jax.device_put(zero(shape), dev))
 
-    def _prefill_into(self, slot, padded, true_len, skey, temp, tk, tp):
+    def _prefill_into(self, slot, padded, true_len, skey, temp, tk, tp,
+                      want_conf=False):
         # head-sharded prompt forward; the cache arrives already in the
         # TP transport layout. First-token sampling keys match the base
         # engine's (fold_in(seed, consumed)) on the replicated logits
@@ -269,6 +271,10 @@ class TPLMEngine(LMEngine):
         self._kc = _slot_insert(self._kc, kc_tp, sl)
         self._vc = _slot_insert(self._vc, vc_tp, sl)
         self._pos = _slot_insert(self._pos, pos, sl)
+        if want_conf:
+            # the psum'd logits are replicated, so the confidence triple
+            # (obs/quality) computes eagerly on the local shard's view
+            return first, _conf_from_row(logits[0])
         return first
 
     def _run_chunk(self, n_steps: int):

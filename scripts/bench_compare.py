@@ -96,6 +96,12 @@ LANES: Dict[str, int] = {
     # the latency they are supposed to explain)
     "diag_capture_seconds": -1,
     "diag_critpath_coverage_ratio": +1,
+    # data-plane quality (obs/quality/): the instrumented pipeline must
+    # keep >= 95% of the uninstrumented rate (the <= 5% overhead
+    # acceptance gate rides this ratio), and a frozen-baseline
+    # distribution shift must breach both drift windows quickly
+    "quality_overhead_ratio": +1,
+    "quality_drift_detect_seconds": -1,
 }
 
 #: current lane name -> names it may carry in OLDER baselines

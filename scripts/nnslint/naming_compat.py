@@ -101,6 +101,19 @@ zero-overhead while diagnostics are off. The Prometheus-conventional
 <layer>_<name>_<unit> shape and pinned to obs/exporter.py. check_diag
 enforces all of it, mirroring check_fleet.
 
+Quality placement (docs/observability.md "Data-plane quality"): the
+``quality`` metric/span/event layer belongs to nnstreamer_tpu/obs/
+quality/ — per-tap tensor stats, drift gauges, and the anomaly audit
+events are registered there only (the element/filter/decoder/serving
+taps feed the engine through the None-gated ``QUALITY_HOOK``, never by
+minting quality.* names), the ``psi`` gauge unit (population-stability
+drift scores) is reserved to that layer, and ``QUALITY_HOOK`` is
+assigned only inside that package (None default plus
+enable()/disable()) — consumers read it behind a single None check,
+which is what keeps the data-plane taps zero-overhead while quality
+telemetry is off. check_quality enforces all of it, mirroring
+check_diag.
+
 The check greps source for literal first arguments of
 ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` registry
 calls, ``.start_span(...)`` / ``start_span(...)`` tracing calls, and
@@ -125,7 +138,7 @@ SOURCE_ROOT = REPO_ROOT / "nnstreamer_tpu"
 
 LAYERS = ("pipeline", "query", "serving", "resilience", "chaos",
           "router", "profile", "sched", "slo", "disagg", "tune",
-          "fleet", "diag")
+          "fleet", "diag", "quality")
 
 #: families exempt from the nnstpu_<layer>_<name>_<unit> shape: the
 #: Prometheus-conventional ``<prefix>_build_info`` identity gauge has
@@ -138,9 +151,10 @@ UNIT_BY_TYPE = {
     # _state: enumerated-condition gauges (e.g. breaker 0/1/2);
     # _pages: KV-page pool occupancy (serving kv_ family only);
     # _ratio/_flops: utilization + roofline gauges (profile layer only);
-    # _replicas: live-backend census (fleet controller only)
+    # _replicas: live-backend census (fleet controller only);
+    # _psi: population-stability drift scores (quality layer only)
     "gauge": ("depth", "slots", "bytes", "state", "pages", "ratio",
-              "flops", "replicas"),
+              "flops", "replicas", "psi"),
 }
 #: span layers add "device" — device.xprof has no metric series —
 #: and "router" (the dispatch span, query/router.py) and "disagg"
@@ -150,7 +164,7 @@ UNIT_BY_TYPE = {
 #: engine back-fills into request traces via SpanStore.add_span,
 #: obs/diag/)
 SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
-               "disagg", "fleet", "diag")
+               "disagg", "fleet", "diag", "quality")
 #: event layers additionally allow "core" (the core/log.py bridge),
 #: "obs" (the obs subsystem's own events), "fleet" (cross-process
 #: federation: push/expiry/merge-conflict audit trail, obs/fleet.py),
@@ -168,7 +182,7 @@ SPAN_LAYERS = ("pipeline", "query", "serving", "device", "router",
 #: bundle captures — obs/diag/)
 EVENT_LAYERS = ("pipeline", "query", "serving", "device", "core", "obs",
                 "fleet", "resilience", "chaos", "router", "profile",
-                "sched", "slo", "disagg", "tune", "diag")
+                "sched", "slo", "disagg", "tune", "diag", "quality")
 
 #: layers OWNED by the resilience package: registrations under these
 #: names must live in RESILIENCE_DIR and vice versa (see module doc)
@@ -396,6 +410,7 @@ def check(root: Path = SOURCE_ROOT):
     problems += check_profile(root)
     problems += check_sched(root)
     problems += check_slo(root)
+    problems += check_quality(root)
     return problems
 
 
@@ -1051,6 +1066,89 @@ def check_diag(root: Path = SOURCE_ROOT):
                 f"nnstreamer_tpu/obs/diag/ — consumers read the hook "
                 f"behind one None check; only diag.enable()/disable() "
                 f"install and clear it")
+    return problems
+
+
+#: the ``quality`` metric/span/event layer is owned by the data-plane
+#: quality package (obs/quality/): per-tap stat/drift series and the
+#: anomaly audit events are emitted there only, and the ``psi`` gauge
+#: unit (population-stability drift scores) is reserved to it
+QUALITY_LAYER = "quality"
+QUALITY_PKG = ("obs", "quality")
+QUALITY_UNITS = frozenset({"psi"})
+#: module-level assignment to the quality hook; matches
+#: ``QUALITY_HOOK = ...`` and ``_quality.QUALITY_HOOK = ...`` alike
+_QUALITY_HOOK_ASSIGN_RE = re.compile(
+    r"^\s*(?:\w+\s*\.\s*)*QUALITY_HOOK\s*=[^=]", re.MULTILINE)
+
+
+def _is_quality_pkg(path: Path) -> bool:
+    return tuple(path.parts[-3:-1]) == QUALITY_PKG
+
+
+def check_quality(root: Path = SOURCE_ROOT):
+    """Data-plane quality naming/placement lint.
+
+    * ``quality``-layer metrics are registered only under
+      nnstreamer_tpu/obs/quality/, and the ``psi`` gauge unit stays
+      reserved to that layer (a drift score elsewhere should route
+      through the quality engine, not fork the convention).
+    * ``quality.*`` spans and events are emitted only from
+      nnstreamer_tpu/obs/quality/.
+    * ``QUALITY_HOOK`` is assigned only inside nnstreamer_tpu/obs/
+      quality/ (the None default plus enable()/disable()) — every
+      other module may only *read* it behind a single None check,
+      which is what keeps the element/filter/decoder/serving taps
+      zero-overhead while quality telemetry is off. Mirrors
+      check_diag's DIAG_HOOK rule.
+    """
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        m = _NAME_RE.match(name)
+        if m is None:
+            continue  # shape violations already reported by check()
+        layer = m.group("layer")
+        in_pkg = _is_quality_pkg(path)
+        if layer == QUALITY_LAYER and not in_pkg:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{QUALITY_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"quality/ — taps feed the engine through QUALITY_HOOK;"
+                f" only it counts its own observations")
+        elif m.group("unit") in QUALITY_UNITS and layer != QUALITY_LAYER:
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the "
+                f"{m.group('unit')!r} gauge unit reserved for the "
+                f"{QUALITY_LAYER!r} layer")
+    for path, lineno, name in iter_span_sites(root):
+        m = _SPAN_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == QUALITY_LAYER and not _is_quality_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: span {name!r} uses the "
+                f"{QUALITY_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"quality/")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == QUALITY_LAYER and not _is_quality_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the "
+                f"{QUALITY_LAYER!r} layer outside nnstreamer_tpu/obs/"
+                f"quality/")
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _QUALITY_HOOK_ASSIGN_RE.finditer(text):
+            if _is_quality_pkg(path):
+                continue
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: QUALITY_HOOK assigned "
+                f"outside nnstreamer_tpu/obs/quality/ — consumers read "
+                f"the hook behind one None check; only "
+                f"quality.enable()/disable() install and clear it")
     return problems
 
 

@@ -155,6 +155,16 @@ class DiagRule(_NamingRule):
 
 
 @register_rule
+class QualityRule(_NamingRule):
+    id = "naming/quality"
+    description = ("quality telemetry, quality.* spans, and quality.* "
+                   "events live in obs/quality/; the psi gauge unit is "
+                   "quality-only; QUALITY_HOOK is assigned only by "
+                   "quality.enable()/disable()")
+    checks = (_compat.check_quality,)
+
+
+@register_rule
 class FleetRule(_NamingRule):
     id = "naming/fleet"
     description = ("nnstpu_fleet_* metrics, fleet.* spans, and the "

@@ -1359,3 +1359,103 @@ class TestDiagPlacement:
         from scripts.nnslint import naming_compat
 
         assert naming_compat.check_diag() == []
+
+
+class TestQualityPlacement:
+    """check_quality ownership: quality-layer telemetry, quality.*
+    events, and the psi gauge unit live in nnstreamer_tpu/obs/quality/;
+    QUALITY_HOOK is assigned only by obs/quality/ itself — the
+    element/filter/decoder/serving taps READ it behind one None check
+    (the zero-overhead contract)."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_quality_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/stray.py": """
+            def setup(reg):
+                reg.counter("nnstpu_quality_frames_total", "h", ())
+            """})
+        problems = naming_compat.check_quality(root)
+        assert len(problems) == 1
+        assert "QUALITY_HOOK" in problems[0]
+
+    def test_psi_unit_outside_layer_fires(self, tmp_path):
+        # the drift-score unit is quality vocabulary, like ratio/flops
+        # are profile vocabulary
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/slo.py": """
+            def setup(reg):
+                reg.gauge("nnstpu_slo_drift_psi", "h", ())
+            """})
+        problems = naming_compat.check_quality(root)
+        assert len(problems) == 1
+        assert "reserved for the 'quality' layer" in problems[0]
+
+    def test_quality_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/health.py": """
+            def warn(events):
+                events.record("quality.anomaly", "i", msg="x")
+            """})
+        problems = naming_compat.check_quality(root)
+        assert len(problems) == 1
+        assert "event 'quality.anomaly'" in problems[0]
+
+    def test_quality_span_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"elements/filter.py": """
+            def tap(tracer):
+                with tracer.start_span("quality.observe"):
+                    pass
+            """})
+        problems = naming_compat.check_quality(root)
+        assert len(problems) == 1
+        assert "span 'quality.observe'" in problems[0]
+
+    def test_hook_assignment_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"graph/element.py": """
+            from ..obs import quality as _quality
+
+            def hijack(eng):
+                _quality.QUALITY_HOOK = eng
+            """})
+        problems = naming_compat.check_quality(root)
+        assert len(problems) == 1
+        assert "QUALITY_HOOK assigned outside" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "obs/quality/__init__.py": """
+                QUALITY_HOOK = None
+
+                def setup(reg, events):
+                    reg.gauge("nnstpu_quality_drift_psi", "h",
+                              ("tap", "window"))
+                    events.record("quality.anomaly", "i", msg="x")
+
+                def enable(eng):
+                    global QUALITY_HOOK
+                    QUALITY_HOOK = eng
+                """,
+            "graph/element.py": """
+                def push(_quality, peer, buf):
+                    qhook = _quality.QUALITY_HOOK
+                    if qhook is not None:
+                        qhook.observe_chain(peer, buf)
+                """,
+        })
+        assert naming_compat.check_quality(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_quality() == []
