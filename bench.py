@@ -34,6 +34,7 @@ import faulthandler
 import json
 import os
 import signal
+import statistics
 import subprocess
 import sys
 import time
@@ -1780,6 +1781,163 @@ def _fleet_lane(device) -> dict:
         return {}
 
 
+def _fleet_restore_lane(device) -> dict:
+    """Crash restore (fleet/checkpoint.py): checkpoint a 3-worker
+    fleet to neighbor shelves, SIGKILL-equivalent one worker
+    (``DisaggWorker.kill()`` — no drain, no goodbye), and restore its
+    sessions onto survivors. ``fleet_restore_seconds`` is the
+    end-to-end bill (re-pin + checkpoint_send + page splice);
+    ``fleet_restore_warm_ratio`` is what freshness buys — the fraction
+    of post-restore prompt tokens served from restored prefix pages
+    (re-prefill fallback would score ~0). The overhead sub-run prices
+    the daemon itself: ``fleet_checkpoint_overhead_ratio`` is serving
+    throughput with a checkpoint pass after every request over
+    throughput without — gated at >= 0.95 in bench_compare."""
+    import traceback
+
+    try:
+        import jax
+
+        from nnstreamer_tpu.fleet import checkpoint as _ckpt
+        from nnstreamer_tpu.fleet.migrate import LM_CAPS
+        from nnstreamer_tpu.models import causal_lm
+        from nnstreamer_tpu.query.router import BackendSet, QueryRouter
+        from nnstreamer_tpu.serving import LMEngine
+        from nnstreamer_tpu.serving import disagg as _dsg
+
+        V, D, H, L = 512, 64, 4, 2
+        max_len, chunk, ps = 128, 8, 8
+        n_workers, n_sessions, gen = 3, 6, 8
+        kv_pages = 4 * max_len // ps
+        params = causal_lm.init_causal_lm(
+            jax.random.PRNGKey(0), V, D, H, L, max_len)
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, V, 3 * ps).astype(np.int32)
+                   for _ in range(n_sessions)]
+
+        def mkeng():
+            return LMEngine(params, H, max_len, n_slots=2, chunk=chunk,
+                            kv_page_size=ps, kv_pages=kv_pages)
+
+        engines = [mkeng() for _ in range(n_workers)]
+        workers = [_dsg.DisaggWorker(e) for e in engines]
+        router = QueryRouter(
+            BackendSet([(w.host, w.port) for w in workers],
+                       "restore-bench"), "restore-bench")
+        router.set_caps_provider(lambda: LM_CAPS)
+        daemons = []
+        try:
+            _mark("fleet restore lane first turns starting (compiles)")
+            hist = {}
+            for i, prompt in enumerate(prompts):
+                sid = f"bench-r{i}"
+                rmeta, _ = router.dispatch(
+                    {"lm": {"prompt": [int(x) for x in prompt],
+                            "max_new": gen, "session": sid}},
+                    b"", session=sid)
+                hist[sid] = [int(x) for x in prompt] + \
+                    [int(t) for t in rmeta.get("tokens") or []]
+            # checkpoint every engine to its neighbors' shelves — the
+            # default deployment topology (NeighborStore over the
+            # KV_PAGE_XFER wire)
+            for i, w in enumerate(workers):
+                peers = [workers[j].endpoint for j in range(n_workers)
+                         if j != i]
+                d = _ckpt.CheckpointDaemon(
+                    engines[i], _ckpt.NeighborStore(peers),
+                    lock=w._elock, name=f"bench-ckpt-{i}")
+                d.run_once()
+                daemons.append(d)
+            # the busiest worker dies: ring placement varies with the
+            # OS-assigned ports, and killing an idle worker would
+            # leave nothing to restore
+            vi = max(range(n_workers), key=lambda i: len(
+                router.backends.sessions_owned(workers[i].endpoint)))
+            victim = workers[vi]
+            moved = router.backends.sessions_owned(victim.endpoint)
+            _mark("fleet restore lane kill + restore starting")
+            victim.kill()
+            restorer = _ckpt.SessionRestorer(router)
+            t0 = time.monotonic()
+            report = restorer.restore_instance(
+                victim.instance, victim.endpoint,
+                daemons[vi].watermarks())
+            restore_secs = time.monotonic() - t0
+            # post-restore turn per moved session: warm ratio is the
+            # prefix-hit fraction of the resent history, read off the
+            # survivors' KV accounting
+            live = [e for i, e in enumerate(engines) if i != vi]
+            hit0 = sum(e._kv.stats["hit_tokens"] for e in live)
+            tok0 = sum(e._kv.stats["prompt_tokens"] for e in live)
+            for sid in moved:
+                rmeta, _ = router.dispatch(
+                    {"lm": {"prompt": hist[sid], "max_new": gen,
+                            "session": sid}}, b"", session=sid)
+                assert rmeta.get("tokens"), f"post-restore {sid} died"
+            hits = sum(e._kv.stats["hit_tokens"] for e in live) - hit0
+            toks = sum(e._kv.stats["prompt_tokens"] for e in live) - tok0
+            warm = hits / max(1, toks)
+        finally:
+            router.close()
+            for d in daemons:
+                d.stop()
+            for w in workers:
+                w.stop()
+
+        # daemon overhead: multi-turn serving with a synchronous
+        # checkpoint pass every other turn-round vs none. Every pass
+        # re-shelves all six advanced sessions, so this is still far
+        # more frequent than the deployed shape (DEFAULT_INTERVAL_S
+        # covers hundreds of turns); medians over interleaved reps
+        # keep run-to-run scheduler noise out of the ratio
+        def serve(checkpointed, ov_rounds=4):
+            eng = mkeng()
+            daemon = _ckpt.CheckpointDaemon(eng, _ckpt.MemoryStore(),
+                                            name="bench-ov")
+            ov_hist = {i: [int(x) for x in p]
+                       for i, p in enumerate(prompts)}
+            n_tok, t0 = 0, time.monotonic()
+            for r in range(ov_rounds):
+                for i in range(n_sessions):
+                    rid = eng.submit(
+                        np.asarray(ov_hist[i], np.int32), max_new=gen,
+                        session=f"ov-{i}")
+                    eng.run()
+                    toks = [int(t) for t in eng.results[rid]]
+                    ov_hist[i] += toks
+                    n_tok += len(toks)
+                if checkpointed and r % 2 == 1:
+                    daemon.run_once()
+            return n_tok / (time.monotonic() - t0)
+
+        _mark("fleet restore lane overhead sub-run starting")
+        serve(True)  # warm both paths (compiles, gather buckets)
+        base_runs, ckpt_runs = [], []
+        for _ in range(5):
+            base_runs.append(serve(False))
+            ckpt_runs.append(serve(True))
+        base_tps = statistics.median(base_runs)
+        ckpt_tps = statistics.median(ckpt_runs)
+        row = {
+            "fleet_restore_config":
+                f"d{D} L{L} V{V} page{ps} {n_workers} unified workers, "
+                f"{n_sessions} sessions gen{gen} greedy, kill worker 0 "
+                f"after neighbor checkpoint, restore onto survivors",
+            "fleet_restore_seconds": round(restore_secs, 4),
+            "fleet_restore_warm_ratio": round(warm, 3),
+            "fleet_checkpoint_overhead_ratio": round(
+                ckpt_tps / max(base_tps, 1e-9), 3),
+            "fleet_restored_sessions": report["restored"],
+            "fleet_reprefilled_sessions": report["re_prefilled"],
+            "fleet_restore_moved": len(moved),
+        }
+        _partial.update(row)
+        return row
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return {}
+
+
 def _diag_lane(device) -> dict:
     """Incident diagnostics (obs/diag/): a traced multi-tenant sched
     run with the diag taps live, then the two costs that decide whether
@@ -2358,6 +2516,9 @@ def main() -> None:
             if os.environ.get("BENCH_FLEET", "1") != "0":
                 _mark("fleet autoscale lane starting")
                 result.update(_fleet_lane(device))
+            if os.environ.get("BENCH_FLEET_RESTORE", "1") != "0":
+                _mark("fleet checkpoint/restore lane starting")
+                result.update(_fleet_restore_lane(device))
             if os.environ.get("BENCH_DIAG", "1") != "0":
                 _mark("diag capture/critpath lane starting")
                 result.update(_diag_lane(device))

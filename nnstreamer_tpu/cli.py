@@ -211,6 +211,19 @@ def main(argv=None) -> int:
                          "live sessions off drained backends with zero "
                          "stream loss; policy is 'default' or 'priced' "
                          "(needs --backends — docs/autoscale.md)")
+    ap.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                    help="crash-checkpoint every DisaggWorker built "
+                         "during the run: a CheckpointDaemon snapshots "
+                         "live sessions (token path + KV pages) into a "
+                         "LocalDirStore at DIR, and a crash-restore "
+                         "splices the freshest valid snapshot back in "
+                         "(sets NNS_FLEET_CKPT_DIR — docs/autoscale.md "
+                         "'Checkpoint/restore & rolling upgrades')")
+    ap.add_argument("--checkpoint-interval", type=float, default=None,
+                    metavar="S",
+                    help="seconds between checkpoint passes (default 5; "
+                         "sets NNS_FLEET_CKPT_INTERVAL; needs "
+                         "--checkpoint-dir)")
     ap.add_argument("--kv-page-size", type=int, default=None, metavar="TOK",
                     help="enable the paged KV cache on every LMEngine built "
                          "during the run: tokens per page (must divide the "
@@ -381,6 +394,19 @@ def main(argv=None) -> int:
         except ValueError as e:
             ap.error(f"--disagg: {e}")
         os.environ["NNS_LM_DISAGG"] = args.disagg
+    if args.checkpoint_interval is not None:
+        if args.checkpoint_dir is None:
+            ap.error("--checkpoint-interval needs --checkpoint-dir "
+                     "(no daemon runs without a store)")
+        if args.checkpoint_interval <= 0:
+            ap.error("--checkpoint-interval must be > 0")
+    if args.checkpoint_dir is not None:
+        # env transport like NNS_LM_*: DisaggWorker reads these at
+        # __init__ and starts its own daemon against a LocalDirStore
+        os.environ["NNS_FLEET_CKPT_DIR"] = args.checkpoint_dir
+        if args.checkpoint_interval is not None:
+            os.environ["NNS_FLEET_CKPT_INTERVAL"] = str(
+                args.checkpoint_interval)
 
     from .graph.parse import parse_pipeline
 

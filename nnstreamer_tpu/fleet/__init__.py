@@ -9,6 +9,17 @@ policy (fleet/autoscale.py) — and migrates live sessions off draining
 backends over the existing KV_PAGE_XFER wire (fleet/migrate.py) so a
 scale-in never kills a stream.
 
+fleet/checkpoint.py extends the arc to crashes: a
+:class:`~nnstreamer_tpu.fleet.checkpoint.CheckpointDaemon` snapshots
+live sessions into a pluggable store, and when the aggregator
+tombstones an instance without a drain the controller's ``restore``
+reconcile action re-pins its sessions onto survivors and splices the
+freshest valid checkpoint back in (stale/missing falls back to
+re-prefill, token-identically either way). ``upgrade()`` rides the
+same machinery for rolling upgrades: checkpoint → drain one →
+terminate → relaunch behind ``/readyz`` → confirm via the SLO burn
+tap → next.
+
 Zero-overhead contract: the only hot-path wiring is the module global
 :data:`AUTOSCALE_HOOK`, gated exactly like ``TUNE_HOOK`` —
 

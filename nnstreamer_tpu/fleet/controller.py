@@ -148,6 +148,7 @@ class FleetController:
     def __init__(self, router: Any, policy: AutoscalePolicy, *,
                  launcher: Any = None, aggregator: Any = None,
                  migrator: Optional[SessionMigrator] = None,
+                 restorer: Any = None,
                  interval_s: float = 1.0,
                  drain_timeout_s: float = 30.0,
                  journal_limit: int = 256,
@@ -158,6 +159,9 @@ class FleetController:
         self.launcher = launcher
         self.aggregator = aggregator
         self.migrator = migrator or SessionMigrator(router, clock=clock)
+        # built lazily (fleet/checkpoint.py import) on the first dead
+        # instance — controllers that never see a crash never pay it
+        self._restorer = restorer
         self.interval_s = float(interval_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.name = name
@@ -176,7 +180,7 @@ class FleetController:
         self._stop = threading.Event()
         self.stats: Dict[str, int] = {
             "ticks": 0, "scale_up": 0, "scale_in": 0, "holds": 0,
-            "migrations": 0}
+            "migrations": 0, "restores": 0, "upgrades": 0}
 
     # -- signals (IN) -----------------------------------------------------
 
@@ -214,8 +218,13 @@ class FleetController:
     # -- the loop ---------------------------------------------------------
 
     def reconcile_once(self) -> Decision:
-        """One deterministic tick: observe → decide → act → journal."""
+        """One deterministic tick: restore the dead, then
+        observe → decide → act → journal."""
         self.stats["ticks"] += 1
+        # crash-restore BEFORE observing: a just-tombstoned instance
+        # must be re-pinned onto survivors before the policy reads the
+        # census, or one tick of decisions is made against ghosts
+        self.restore_dead()
         signals = self.observe()
         self._last_signals = signals
         decision = self.policy.decide(signals)
@@ -297,6 +306,7 @@ class FleetController:
             return
         self._breaker.record_success()
         self._launched[handle.endpoint] = handle
+        self._register_kill(handle)
         self.stats["scale_up"] += 1
         self._journal_add("scale_up", decision.reason,
                           endpoint=handle.endpoint)
@@ -341,6 +351,7 @@ class FleetController:
         handle = self._launched.pop(victim.endpoint, None)
         if handle is not None and self.launcher is not None:
             self.launcher.terminate(handle)
+        self._unregister_kill(victim.endpoint)
         self.stats["scale_in"] += 1
         self._journal_add(
             "scale_in", decision.reason, endpoint=victim.endpoint,
@@ -351,6 +362,184 @@ class FleetController:
                        f"({len(migrated)} sessions migrated)",
                        controller=self.name, endpoint=victim.endpoint,
                        sessions=len(migrated))
+
+    # -- crash restore ----------------------------------------------------
+
+    def _register_kill(self, handle: Any) -> None:
+        """Expose a launched subprocess to the chaos ``kill`` fault so
+        the crash-restore acceptance test can SIGKILL it by endpoint.
+        Registration is a dict insert — free when chaos is off."""
+        proc = getattr(handle, "proc", None)
+        if proc is None:
+            return
+        from ..resilience import chaos as _chaos
+        _chaos.register_kill_target(handle.endpoint, proc)
+
+    def _unregister_kill(self, endpoint: str) -> None:
+        from ..resilience import chaos as _chaos
+        _chaos.unregister_kill_target(endpoint)
+
+    def _restorer_get(self) -> Any:
+        if self._restorer is None:
+            from .checkpoint import SessionRestorer
+            self._restorer = SessionRestorer(self.router,
+                                             clock=self._clock)
+        return self._restorer
+
+    def restore_dead(self) -> List[Dict[str, Any]]:
+        """The ``restore`` reconcile action: claim every tombstoned
+        instance the aggregator declared dead-without-drain, re-pin its
+        sessions onto survivors, and splice checkpoints (fresh) or fall
+        back to re-prefill (stale/missing) — see fleet/checkpoint.py.
+
+        ``consume_restore`` is an atomic first-caller-wins claim, so
+        concurrent controllers (or a tick racing the background thread)
+        never restore the same instance twice.
+        """
+        if self.aggregator is None:
+            return []
+        reports: List[Dict[str, Any]] = []
+        for row in self.aggregator.restorables():
+            payload = self.aggregator.consume_restore(row["instance"])
+            if payload is None:
+                continue  # another claimant won the race
+            ep = payload["endpoint"]
+            # reap the corpse first: a dead subprocess handle must not
+            # linger as a terminate target or a chaos kill victim
+            handle = self._launched.pop(ep, None)
+            if handle is not None and self.launcher is not None:
+                self.launcher.terminate(handle)
+            self._unregister_kill(ep)
+            try:
+                report = self._restorer_get().restore_instance(
+                    payload["instance"], ep,
+                    payload.get("checkpoints"),
+                    deadline=_rp.Deadline.after_s(self.drain_timeout_s))
+            except Exception as e:
+                self._journal_add("restore_failed",
+                                  f"{type(e).__name__}: {e}", endpoint=ep)
+                log.exception("restore of %s failed", ep)
+                continue
+            self.aggregator.confirm_drain(payload["instance"])
+            self.stats["restores"] += 1
+            self._journal_add(
+                "restore",
+                f"instance {payload['instance']} died at {ep}",
+                endpoint=ep, sessions=report["sessions"],
+                restored=report["restored"],
+                re_prefilled=report["re_prefilled"])
+            reports.append(report)
+        return reports
+
+    # -- rolling upgrade --------------------------------------------------
+
+    def upgrade(self, *,
+                checkpoint: Optional[Callable[[], Any]] = None
+                ) -> Dict[str, Any]:
+        """Rolling upgrade: for each active backend in turn —
+        checkpoint → drain one → terminate → relaunch behind the
+        launcher's ``/readyz`` gate → confirm → next.
+
+        ``checkpoint`` is an optional pre-drain tick (usually the
+        :class:`~..fleet.checkpoint.CheckpointDaemon`'s ``run_once``)
+        so every session has a fresh snapshot before its owner goes
+        down — a mid-upgrade crash then restores instead of
+        re-prefilling. Confirmation is the SLO burn tap: any breached
+        window after a step aborts the remaining plan, leaving the
+        fleet in a mixed-version but healthy state.
+        """
+        plan = sorted(be.endpoint
+                      for be in self.router.backends.backends()
+                      if be.state == "active")
+        report: Dict[str, Any] = {"plan": list(plan), "upgraded": [],
+                                  "aborted": None}
+        if self.launcher is None:
+            report["aborted"] = "no launcher"
+            self._journal_add("upgrade_skipped", "no launcher")
+            return report
+        self._journal_add("upgrade_start", f"{len(plan)} backend(s)",
+                          plan=list(plan))
+        _events.record("fleet.upgrade",
+                       f"rolling upgrade of {len(plan)} backend(s)",
+                       controller=self.name, backends=len(plan))
+        for ep in plan:
+            victim = next((be for be in self.router.backends.backends()
+                           if be.endpoint == ep and be.state == "active"),
+                          None)
+            if victim is None:
+                continue  # vanished since the plan snapshot
+            if checkpoint is not None:
+                try:
+                    checkpoint()
+                except Exception:
+                    log.exception("pre-drain checkpoint tick failed")
+            # drain one: live-migrate every owned session, then drain
+            dl = _rp.Deadline.after_s(self.drain_timeout_s)
+            migrated = 0
+            for s in sorted(self.router.backends.sessions_owned(ep)):
+                target = self.router.backends.pick(session=s,
+                                                   exclude={ep})
+                if target is None:
+                    continue
+                m = self.migrator.migrate(s, victim, target, deadline=dl)
+                migrated += 1 if m["ok"] else 0
+                self.stats["migrations"] += 1
+            try:
+                self.router.remove_backend(ep, drain=True)
+            except KeyError:
+                pass
+            if self.aggregator is not None:
+                self.aggregator.confirm_drain(victim.instance or ep)
+            # terminate the old worker
+            handle = self._launched.pop(ep, None)
+            if handle is not None:
+                self.launcher.terminate(handle)
+            self._unregister_kill(ep)
+            # relaunch: launch() blocks behind the /readyz gate, so the
+            # replacement is never routable before it can serve
+            try:
+                new = self.launcher.launch()
+                self.router.add_backend(new.endpoint)
+            except Exception as e:
+                report["aborted"] = f"relaunch failed: {e}"
+                self._journal_add("upgrade_abort",
+                                  f"relaunch after {ep} failed: {e}",
+                                  endpoint=ep)
+                _events.record("fleet.upgrade",
+                               f"aborted: relaunch after {ep} failed: {e}",
+                               severity="warning", controller=self.name,
+                               endpoint=ep)
+                return report
+            self._launched[new.endpoint] = new
+            self._register_kill(new)
+            report["upgraded"].append({"old": ep, "new": new.endpoint,
+                                       "migrated": migrated})
+            self._journal_add("upgrade_step", f"{ep} -> {new.endpoint}",
+                              old=ep, new=new.endpoint, migrated=migrated)
+            # confirm: the SLO burn tap decides whether to continue
+            if self.aggregator is not None:
+                breached = self.aggregator.scale_signals().get(
+                    "breached", [])
+                if breached:
+                    report["aborted"] = f"slo breach: {breached}"
+                    self._journal_add(
+                        "upgrade_abort",
+                        f"SLO burn breached after {ep}: {breached}",
+                        endpoint=ep, breached=list(breached))
+                    _events.record(
+                        "fleet.upgrade",
+                        f"aborted after {ep}: SLO burn {breached}",
+                        severity="warning", controller=self.name,
+                        endpoint=ep)
+                    return report
+        self.stats["upgrades"] += 1
+        self._journal_add("upgrade_done",
+                          f"{len(report['upgraded'])} backend(s) upgraded")
+        _events.record("fleet.upgrade",
+                       f"done: {len(report['upgraded'])} backend(s)",
+                       controller=self.name,
+                       backends=len(report["upgraded"]))
+        return report
 
     def snapshot(self) -> Dict[str, Any]:
         """The ``/debug/fleet/actions`` payload."""

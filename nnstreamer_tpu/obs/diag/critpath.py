@@ -23,10 +23,12 @@ from typing import Any, Dict, List, Optional, Tuple
 #: attribution buckets, waterfall order — where a request's wall-clock
 #: latency can go (host_other is the unexplained residual)
 SEGMENTS = ("admission_wait", "sched_wait", "device_compute", "wire",
-            "kv_transfer", "migration", "re_prefill", "host_other")
+            "kv_transfer", "migration", "re_prefill", "restore",
+            "host_other")
 
 #: span name -> segment. serving.prefill is handled specially (its
-#: re_prefill attr promotes it); anything absent here is host_other.
+#: re_prefill/restore attrs promote it); anything absent here is
+#: host_other.
 _SEGMENT_BY_NAME = {
     "serving.admission_wait": "admission_wait",
     "diag.sched_wait": "sched_wait",
@@ -44,8 +46,14 @@ _SEGMENT_BY_NAME = {
 
 def segment_of(name: str, attrs: Optional[Dict[str, Any]] = None) -> str:
     """Segment for one span; unknown names are host_other."""
-    if name == "serving.prefill" and attrs and attrs.get("re_prefill"):
-        return "re_prefill"
+    if name == "serving.prefill" and attrs:
+        if attrs.get("restore"):
+            # first prefill after a crash-restore checkpoint splice —
+            # warm by construction; kept distinct from re_prefill so
+            # the restore-vs-fallback attribution survives aggregation
+            return "restore"
+        if attrs.get("re_prefill"):
+            return "re_prefill"
     return _SEGMENT_BY_NAME.get(name, "host_other")
 
 

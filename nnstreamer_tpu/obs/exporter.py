@@ -22,6 +22,10 @@ health and debug surfaces:
     (obs/events.py), oldest first; ``?n=<int>`` keeps the newest N
   * ``GET /debug/fleet``             — per-instance fleet state when
     this process aggregates (obs/fleet.py); 503 otherwise
+  * ``GET /debug/fleet/checkpoints`` — the local checkpoint daemon's
+    session watermarks (fleet/checkpoint.py) plus, when aggregating,
+    the fleet rollup: every instance's pushed watermarks and the
+    tombstoned instances whose checkpoints still await a restore
   * ``GET /debug/profile``           — Chrome trace_event / Perfetto
     JSON timeline (obs/profile.py): host lanes per pipeline thread,
     device lanes per dispatch label, serving lanes + occupancy counter
@@ -318,6 +322,17 @@ class MetricsExporter:
                     else None,
                 })
 
+            def _get_fleet_checkpoints(self, query):
+                # local watermarks ride the same hook the push doc
+                # reads; the rollup needs this process to aggregate
+                hook = _fleet.CHECKPOINT_HOOK
+                agg = _fleet.aggregator()
+                self._json(200, {
+                    "local": None if hook is None else hook(),
+                    "fleet": agg.checkpoints_rollup() if agg is not None
+                    else None,
+                })
+
             def _get_slo(self, query):
                 snap = _slo.snapshot()
                 agg = _fleet.aggregator()
@@ -436,6 +451,7 @@ class MetricsExporter:
                 ("GET", "/debug/events"): _get_events,
                 ("GET", "/debug/fleet"): _get_fleet,
                 ("GET", "/debug/fleet/actions"): _get_fleet_actions,
+                ("GET", "/debug/fleet/checkpoints"): _get_fleet_checkpoints,
                 ("GET", "/debug/profile"): _get_profile,
                 ("GET", "/debug/profile/samples"): _get_profile_samples,
                 ("GET", "/debug/slo"): _get_slo,

@@ -138,6 +138,26 @@ FLEET_ACTIONS_HOOK = None
 #: only by obs/diag enable()/disable() (nnslint diag ownership rule).
 DIAG_PUSH_HOOK = None
 
+#: fleet/checkpoint.py installs a zero-arg callable returning the
+#: local CheckpointDaemon's session → last-checkpointed-seq watermarks
+#: (daemon.watermarks()). They ride every push doc so that when this
+#: instance dies WITHOUT a drain, its tombstone still says which
+#: checkpoints must exist somewhere — the staleness bar the restore
+#: path holds survivors' blobs to. None-gated like every hook here;
+#: assigned only by fleet/checkpoint.py (nnslint checkpoint rule).
+CHECKPOINT_HOOK = None
+
+#: checkpoint watermark entries per push/tombstone — bounds both the
+#: doc and what a tombstone pins in memory awaiting restore
+MAX_CHECKPOINT_SESSIONS = 256
+
+#: tombstones still carrying unconsumed checkpoint watermarks are
+#: protected from compaction for this long after expiry (the restore
+#: window), and at most this many are protected at once — past either
+#: bound they compact like any other stone (the bounded-window fix)
+RESTORE_WINDOW_S = 60.0
+RESTORE_PROTECT_LIMIT = 16
+
 
 def default_instance() -> str:
     """``host:pid`` unless ``NNSTPU_INSTANCE`` names the process —
@@ -152,7 +172,9 @@ def build_push(instance: str, role: str, seq: int,
                health_registry: Optional[_health.HealthRegistry] = None,
                span_store: Optional[_tracing.SpanStore] = None,
                max_spans: int = MAX_SPANS_PER_PUSH,
-               kv_prefix: Optional[List[str]] = None) -> Dict[str, Any]:
+               kv_prefix: Optional[List[str]] = None,
+               checkpoints: Optional[Dict[str, int]] = None,
+               endpoint: Optional[str] = None) -> Dict[str, Any]:
     """Assemble one push document from the given (default: process-
     global) registries — the single source of truth for the push
     schema, shared by the pusher, the wire piggyback, and tests."""
@@ -163,6 +185,8 @@ def build_push(instance: str, role: str, seq: int,
     ready, conds = hreg.readiness()
     if kv_prefix is None and KV_DIGEST_HOOK is not None:
         kv_prefix = KV_DIGEST_HOOK()
+    if checkpoints is None and CHECKPOINT_HOOK is not None:
+        checkpoints = CHECKPOINT_HOOK()
     return {
         "v": PUSH_VERSION,
         "instance": instance,
@@ -201,6 +225,18 @@ def build_push(instance: str, role: str, seq: int,
         # enough to ride every push so an aggregator can answer
         # "which instance's which tap is producing garbage"
         "quality": _quality.push_data(),
+        # None while no checkpoint daemon runs here (same contract):
+        # session → last-checkpointed seq, bounded — the slice a
+        # tombstone keeps so a crash restore knows what freshness to
+        # demand of survivors' shelved blobs
+        "checkpoints": (None if checkpoints is None else
+                        {str(s): int(q) for s, q in
+                         sorted(checkpoints.items())
+                         [:MAX_CHECKPOINT_SESSIONS]}),
+        # None unless the worker serves a wire endpoint: how the fleet
+        # controller maps a tombstoned instance back to the router
+        # backend whose sessions need re-homing
+        "endpoint": None if endpoint is None else str(endpoint),
     }
 
 
@@ -384,7 +420,8 @@ class _Instance:
 
     __slots__ = ("instance", "role", "seq", "ts", "interval_s",
                  "metrics", "health", "ready", "slo", "kv_prefix",
-                 "tune", "actions", "diag", "quality", "via", "pushes",
+                 "tune", "actions", "diag", "quality", "checkpoints",
+                 "endpoint", "via", "pushes",
                  "spans_ingested", "first_mono", "last_mono")
 
     def __init__(self, instance: str):
@@ -412,6 +449,14 @@ class _Instance:
         #: the instance's data-plane quality slice: per-tap frame/NaN/
         #: PSI summary + anomaly verdicts (None until quality pushes)
         self.quality: Optional[Dict[str, Any]] = None
+        #: the instance's checkpoint watermarks, session → seq (None
+        #: until a checkpoint daemon there pushes them) — copied into
+        #: the tombstone on expiry so the restore path outlives the
+        #: worker
+        self.checkpoints: Optional[Dict[str, int]] = None
+        #: the instance's wire endpoint (None until advertised) — the
+        #: router-backend join key a restore needs
+        self.endpoint: Optional[str] = None
         self.via = "http"
         self.pushes = 0
         self.spans_ingested = 0
@@ -475,8 +520,17 @@ class FleetAggregator:
                     # asking about this instance must see "known dead"
                     # (routable=False), not an absent key it could
                     # misread as "never part of the fleet"
-                    self._tombstones[iid] = {
+                    stone: Dict[str, Any] = {
                         "role": rec.role, "expired_mono": now}
+                    # carry the last pushed checkpoint watermarks +
+                    # endpoint into the stone: the worker is gone, so
+                    # this copy is all a crash restore has to judge
+                    # survivors' blobs by (bounded at ingest)
+                    if rec.endpoint:
+                        stone["endpoint"] = rec.endpoint
+                    if rec.checkpoints is not None:
+                        stone["checkpoints"] = dict(rec.checkpoints)
+                    self._tombstones[iid] = stone
                     self._tombstones.move_to_end(iid)
             self._compact_tombstones()
         for rec in dead:
@@ -491,10 +545,34 @@ class FleetAggregator:
         tombstone census past the bound, evict the stones that expired
         EARLIEST (by expiry time, tiebroken by instance id) — never
         whichever insertion order a re-expiry happened to leave. The
-        newest deaths are the ones a router still needs to learn."""
+        newest deaths are the ones a router still needs to learn.
+
+        Stones still carrying unconsumed checkpoint watermarks are
+        skipped while inside the RESTORE_WINDOW_S grace (a restore
+        that hasn't run yet must still find them), but the protection
+        is bounded twice over: the grace expires, and at most
+        RESTORE_PROTECT_LIMIT stones enjoy it at once — the OLDEST
+        protected stones lose it first when crash churn exceeds the
+        bound, so compaction always terminates."""
+        now = time.monotonic()
+
+        def protected(stone: Dict[str, Any]) -> bool:
+            return ("checkpoints" in stone
+                    and now - float(stone.get("expired_mono", 0.0))
+                    <= RESTORE_WINDOW_S)
+
+        guard = sorted(
+            (kv for kv in self._tombstones.items() if protected(kv[1])),
+            key=lambda kv: (-float(kv[1].get("expired_mono", 0.0)),
+                            kv[0]))
+        immune = {iid for iid, _ in guard[:RESTORE_PROTECT_LIMIT]}
         while len(self._tombstones) > TOMBSTONE_LIMIT:
+            evictable = [kv for kv in self._tombstones.items()
+                         if kv[0] not in immune]
+            if not evictable:
+                break  # every stone is inside the bounded window
             oldest = min(
-                self._tombstones.items(),
+                evictable,
                 key=lambda kv: (float(kv[1].get("expired_mono", 0.0)),
                                 kv[0]))[0]
             del self._tombstones[oldest]
@@ -515,6 +593,45 @@ class FleetAggregator:
                 f"instance {iid} drained by controller — record and "
                 f"tombstone cleared", instance=iid)
         return cleared
+
+    def restorables(self) -> List[Dict[str, Any]]:
+        """Tombstoned instances a crash restore should handle: died
+        without a drain, advertised a wire endpoint, and their
+        checkpoint watermarks are still unconsumed. Sorted oldest
+        death first — the controller works the backlog in the order
+        the fleet lost them."""
+        self._expire_now()
+        with self._lock:
+            rows = [
+                {"instance": iid,
+                 "endpoint": stone["endpoint"],
+                 "checkpoints": dict(stone.get("checkpoints") or {}),
+                 "expired_mono": float(stone.get("expired_mono", 0.0))}
+                for iid, stone in self._tombstones.items()
+                if stone.get("endpoint")
+                and not stone.get("restore_consumed")]
+        return sorted(rows, key=lambda r: (r["expired_mono"],
+                                           r["instance"]))
+
+    def consume_restore(self, iid: str) -> Optional[Dict[str, Any]]:
+        """Atomically claim a tombstone's restore payload (endpoint +
+        checkpoint watermarks). First caller wins — a second restore
+        attempt gets None instead of splicing the same sessions twice.
+        The stone itself stays for the routing view until
+        ``confirm_drain`` clears it, but once consumed it loses its
+        compaction protection (the window closes on consumption, not
+        just on time)."""
+        with self._lock:
+            stone = self._tombstones.get(iid)
+            if stone is None or stone.get("restore_consumed") \
+                    or not stone.get("endpoint"):
+                return None
+            stone["restore_consumed"] = True
+            payload = {"instance": iid,
+                       "endpoint": stone["endpoint"],
+                       "checkpoints": dict(
+                           stone.pop("checkpoints", None) or {})}
+        return payload
 
     # -- ingestion ------------------------------------------------------- #
     def ingest(self, doc: Any, via: str = "http") -> None:
@@ -559,6 +676,8 @@ class FleetAggregator:
         actions_doc = doc.get("fleet_actions")
         diag_doc = doc.get("diag")
         quality_doc = doc.get("quality")
+        ckpt_doc = doc.get("checkpoints")
+        endpoint_doc = doc.get("endpoint")
         new = False
         with self._lock:
             rec = self._instances.get(iid)
@@ -593,6 +712,20 @@ class FleetAggregator:
                 rec.diag = diag_doc
             if isinstance(quality_doc, dict):
                 rec.quality = quality_doc
+            if isinstance(ckpt_doc, dict):
+                # replace, never merge — the watermarks are a snapshot
+                # of what the daemon has stored NOW; junk values drop
+                # per-entry rather than poisoning the slice
+                marks: Dict[str, int] = {}
+                for s, q in list(ckpt_doc.items())[
+                        :MAX_CHECKPOINT_SESSIONS]:
+                    try:
+                        marks[str(s)] = int(q)
+                    except (TypeError, ValueError):
+                        continue
+                rec.checkpoints = marks
+            if isinstance(endpoint_doc, str) and endpoint_doc:
+                rec.endpoint = endpoint_doc
             rec.via = via
             rec.pushes += 1
             rec.last_mono = time.monotonic()
@@ -902,6 +1035,30 @@ class FleetAggregator:
             recs = list(self._instances.values())
         return {rec.instance: rec.actions for rec in recs
                 if rec.actions is not None}
+
+    def checkpoints_rollup(self) -> Dict[str, Any]:
+        """Fleet-wide checkpoint state (``/debug/fleet/checkpoints``):
+        every live instance's pushed watermarks keyed by instance,
+        plus the tombstoned instances whose watermarks still await a
+        restore — the one view an operator scans to answer "whose
+        sessions are covered, and who died holding coverage"."""
+        self._expire_now()
+        with self._lock:
+            recs = list(self._instances.values())
+            pending = [
+                {"instance": iid,
+                 "endpoint": stone.get("endpoint"),
+                 "sessions": len(stone.get("checkpoints") or {}),
+                 "consumed": bool(stone.get("restore_consumed"))}
+                for iid, stone in self._tombstones.items()
+                if "checkpoints" in stone or stone.get("restore_consumed")]
+        return {
+            "instances": {rec.instance: {"endpoint": rec.endpoint,
+                                         "checkpoints": rec.checkpoints}
+                          for rec in recs
+                          if rec.checkpoints is not None},
+            "pending_restore": pending,
+        }
 
     def diag_rollup(self) -> Dict[str, Any]:
         """Fleet-wide incident evidence (``/debug/bundles``): every

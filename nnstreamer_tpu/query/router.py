@@ -487,6 +487,29 @@ class BackendSet:
                 element=self.owner, backend=endpoint, sessions=moved)
         return moved
 
+    def repin_dead_owner(self, endpoint: str) -> List[Tuple[str, str]]:
+        """Crash re-pin (fleet/checkpoint restore): the owner died
+        WITHOUT a drain — no export round trip happened — so re-home
+        every session it owned onto survivors and return the
+        ``(session, new_endpoint)`` map the checkpoint splice needs.
+        Must run BEFORE :meth:`remove`, which drops the ownership
+        census this reads."""
+        moved: List[Tuple[str, str]] = []
+        for s in self.sessions_owned(endpoint):
+            be = self.pick(session=s, exclude=frozenset({endpoint}))
+            if be is None:
+                continue
+            self.pin_session(s, be.endpoint)
+            moved.append((s, be.endpoint))
+        if moved:
+            _events.record(
+                "router.repin_dead",
+                f"{self.owner}: {len(moved)} session(s) re-pinned off "
+                f"dead owner {endpoint}",
+                severity="warning", element=self.owner, backend=endpoint,
+                sessions=len(moved))
+        return moved
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._backends)

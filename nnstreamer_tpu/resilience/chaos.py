@@ -4,7 +4,7 @@ the graph.
 Testing the resilience policies used to require hand-rolled socket
 games (kill a server mid-recv, hope the timing lands). This harness
 makes faults first-class and REPRODUCIBLE: a :class:`FaultPlan` is a
-seeded schedule of drop/delay/corrupt/disconnect faults, fired either
+seeded schedule of drop/delay/corrupt/disconnect/kill faults, fired either
 on the Nth matching call or probabilistically from a per-fault PRNG —
 the same seed always yields the same schedule, independent of wall
 clock and (per target) of thread interleaving.
@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import signal
 import threading
 import time
 from dataclasses import dataclass, field
@@ -46,11 +47,54 @@ log = logger("chaos")
 #: environment variable carrying a JSON fault plan (nns-launch honors it)
 ENV_VAR = "NNS_TPU_CHAOS"
 
-KINDS = ("drop", "delay", "corrupt", "disconnect", "partition")
+KINDS = ("drop", "delay", "corrupt", "disconnect", "partition", "kill")
 
 _INJECTED_TOTAL = _obs.registry().counter(
     "nnstpu_chaos_injected_total",
     "Faults fired by the installed fault plan", ("kind",))
+
+#: endpoint -> kill handle for the ``kill`` fault kind: a launched
+#: backend's pid (int), a Popen-like object exposing ``.pid``, or a
+#: zero-arg callable (how tests SIGKILL an in-process worker shim).
+#: A plain dict guarded by its own lock — registration happens at
+#: launch/teardown time, never on the wire hot path, and the hook only
+#: reads it after a fault already fired.
+_KILL_TARGETS: Dict[str, Any] = {}
+_KILL_LOCK = threading.Lock()
+
+
+def register_kill_target(endpoint: str, target: Any) -> None:
+    """Make ``endpoint`` killable by a planned ``kill`` fault.
+
+    ``target`` is SIGKILLed when the fault fires: an int pid, an
+    object with ``.pid`` (subprocess.Popen), or a zero-arg callable
+    (in-process workers — tests register ``worker.kill``). Launchers
+    register their children here so a chaos plan can crash exactly one
+    backend of a routed set, no drain, no goodbye."""
+    with _KILL_LOCK:
+        _KILL_TARGETS[str(endpoint)] = target
+
+
+def unregister_kill_target(endpoint: str) -> None:
+    with _KILL_LOCK:
+        _KILL_TARGETS.pop(str(endpoint), None)
+
+
+def _do_kill(endpoint: Optional[str]) -> str:
+    """SIGKILL the registered target for ``endpoint``; returns a
+    human-readable note for the audit event. An unregistered endpoint
+    is a no-op beyond the note — the fault still severs the frame, so
+    the plan's schedule is unchanged either way."""
+    with _KILL_LOCK:
+        target = _KILL_TARGETS.get(str(endpoint))
+    if target is None:
+        return f"no kill target registered for {endpoint}"
+    if callable(target):
+        target()
+        return f"killed in-process target for {endpoint}"
+    pid = getattr(target, "pid", target)
+    os.kill(int(pid), signal.SIGKILL)
+    return f"SIGKILLed pid {int(pid)} ({endpoint})"
 
 
 @dataclass
@@ -72,6 +116,14 @@ class Fault:
     fault latches and EVERY subsequent matching frame raises
     ConnectionError — one side of a network partition, not a one-shot
     disconnect. The latch counts as a single fire in the audit log.
+
+    Kind ``kill`` SIGKILLs the backend behind the matched frame (the
+    fault's ``endpoint`` names the victim; see
+    :func:`register_kill_target`) and then raises ConnectionError —
+    a planned crash with no drain and no goodbye, for the
+    fleet/checkpoint restore acceptance tests. Subsequent frames to
+    the dead endpoint fail naturally, so ``max_fires=1`` is the usual
+    spelling.
     """
 
     kind: str
@@ -244,6 +296,16 @@ def _wire_hook(direction: str, cmd: Any, meta: Dict[str, Any],
             raise ConnectionError(
                 f"chaos: partition active ({direction} {name} "
                 f"endpoint={endpoint})")
+        if f.kind == "kill":
+            # kill -9 the backend BEHIND this frame (no drain, no
+            # goodbye), then die like the severed connection the peer
+            # would actually see. The fault's own endpoint wins over
+            # the frame's — a recv-side plan can still name its victim
+            note = _do_kill(f.endpoint or endpoint)
+            _fire(f, direction, f"cmd={name} {note}")
+            raise ConnectionError(
+                f"chaos: backend killed ({direction} {name} "
+                f"endpoint={f.endpoint or endpoint})")
         _fire(f, direction, f"cmd={name}" if endpoint is None
               else f"cmd={name} endpoint={endpoint}")
         if f.kind == "delay":

@@ -43,6 +43,7 @@ nnstpu_disagg_pages_received_total`` on a clean run.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -210,19 +211,33 @@ class PageTransferClient:
         return sock
 
     def send_pages(self, doc: Dict[str, Any],
-                   deadline: Optional[_rp.Deadline] = None) -> int:
+                   deadline: Optional[_rp.Deadline] = None,
+                   extra: Optional[Dict[str, Any]] = None) -> int:
         """One transfer round trip: returns the peer's spliced-page
         count. Raises ConnectionError/OSError/QueryProtocolError when
         the peer is gone or rejects the document — the caller's
-        re-prefill / keep-local decision point."""
+        re-prefill / keep-local decision point. ``extra`` merges extra
+        meta keys into the frame (the fleet restore tag rides here)."""
         meta, payload = encode_pages(doc)
+        if extra:
+            meta.update(extra)
+        rmeta = self.send_frame(meta, payload, deadline,
+                                pages=len(doc["entries"]))
+        _PAGES_SENT.inc(len(doc["entries"]))
+        return int(rmeta.get("kv_imported", 0))
+
+    def send_frame(self, meta: Dict[str, Any], payload: bytes,
+                   deadline: Optional[_rp.Deadline] = None, *,
+                   pages: int = 0) -> Dict[str, Any]:
+        """One raw KV_PAGE_XFER round trip (page docs AND the fleet
+        checkpoint frames that reuse the op); returns the reply meta."""
         if deadline is not None:
             # remaining-ms on the wire, re-anchored by the receiver —
             # the transfer spends the same budget the request does
             meta[_rp.WIRE_KEY] = deadline.to_wire()
         span = _tracing.start_span(
             "disagg.xfer", parent=_tracing.current_context(),
-            attrs={"peer": self.endpoint, "pages": len(doc["entries"]),
+            attrs={"peer": self.endpoint, "pages": pages,
                    "bytes": len(payload)})
         t0 = time.monotonic()
         try:
@@ -243,10 +258,9 @@ class PageTransferClient:
                     self._drop_conn()
                     raise QueryProtocolError(
                         f"unexpected transfer reply {cmd}")
-            _PAGES_SENT.inc(len(doc["entries"]))
             _XFER_BYTES.inc(len(payload))
             _XFER_SECONDS.observe(time.monotonic() - t0)
-            return int(rmeta.get("kv_imported", 0))
+            return rmeta
         except (ConnectionError, OSError, QueryProtocolError):
             span.set_attribute("error", True)
             raise
@@ -350,8 +364,35 @@ class DisaggWorker:
         self.instance = instance or self.endpoint
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
         self._xfer_clients: Dict[str, PageTransferClient] = {}
         self._push_seq = 0
+        # neighbor checkpoint shelf (fleet/checkpoint.py): blobs OTHER
+        # workers shipped here for safekeeping, served back on the
+        # restore path (lm_ctl: checkpoint_send). Attached explicitly
+        # or created lazily on the first checkpoint frame.
+        self._ckpt_store: Optional[Any] = None
+        # this worker's own daemon, when one runs (push_fleet
+        # advertises its watermarks so a restore can judge staleness
+        # after this worker is gone)
+        self._ckpt_daemon: Optional[Any] = None
+        self._ckpt_owned = False
+        # zero-code deployment path (nns-launch --checkpoint-dir):
+        # NNS_FLEET_CKPT_DIR starts a daemon snapshotting this engine
+        # into a shared LocalDirStore every NNS_FLEET_CKPT_INTERVAL s
+        ckpt_dir = os.environ.get("NNS_FLEET_CKPT_DIR")
+        if ckpt_dir:
+            from ..fleet import checkpoint as _ckpt
+            store = _ckpt.LocalDirStore(ckpt_dir)
+            self._ckpt_store = store
+            self._ckpt_daemon = _ckpt.CheckpointDaemon(
+                engine, store,
+                interval_s=float(os.environ.get(
+                    "NNS_FLEET_CKPT_INTERVAL",
+                    _ckpt.DEFAULT_INTERVAL_S)),
+                lock=self._elock, name=f"ckpt:{self.endpoint}")
+            self._ckpt_daemon.start()
+            self._ckpt_owned = True
         # default fleet wiring: a worker that serves a KV cache IS the
         # process's digest source, so installing fleet.KV_DIGEST_HOOK here
         # means any FleetPusher in the process advertises this engine's
@@ -371,6 +412,29 @@ class DisaggWorker:
         self._threads.append(t)
         t.start()
 
+    # -- checkpoints (fleet/checkpoint.py) ---------------------------------- #
+    @property
+    def checkpoint_store(self) -> Optional[Any]:
+        return self._ckpt_store
+
+    def attach_checkpoint_store(self, store: Any) -> None:
+        """Install the shelf this worker files neighbor checkpoint
+        frames into AND serves ``checkpoint_send`` from. A shared
+        LocalDirStore makes every worker a read replica; the default
+        (lazy MemoryStore) keeps each worker's shelf private."""
+        self._ckpt_store = store
+
+    def attach_checkpoint_daemon(self, daemon: Any) -> None:
+        """Advertise the local daemon's watermarks in this worker's
+        push docs (the tombstone slice restores judge staleness by)."""
+        self._ckpt_daemon = daemon
+
+    def _ckpt_shelf(self) -> Any:
+        if self._ckpt_store is None:
+            from ..fleet import checkpoint as _ckpt
+            self._ckpt_store = _ckpt.MemoryStore()
+        return self._ckpt_store
+
     # -- fleet ------------------------------------------------------------- #
     def push_fleet(self, agg: Optional[_fleet.FleetAggregator] = None
                    ) -> Dict[str, Any]:
@@ -382,8 +446,11 @@ class DisaggWorker:
         self._push_seq += 1
         with self._elock:
             digest = self.engine.kv_prefix_digest()
+        marks = None if self._ckpt_daemon is None \
+            else self._ckpt_daemon.watermarks()
         doc = _fleet.build_push(self.instance, self.role, self._push_seq,
-                                kv_prefix=digest)
+                                kv_prefix=digest, checkpoints=marks,
+                                endpoint=self.endpoint)
         # readiness here is the worker's, not the process health
         # registry's: this method runs iff the accept loop is serving
         doc["ready"] = {"ready": not self._stop.is_set(), "conditions": {}}
@@ -402,6 +469,7 @@ class DisaggWorker:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
             t = threading.Thread(target=self._conn_loop, args=(conn,),
                                  daemon=True,
                                  name=f"disagg-conn:{self.endpoint}")
@@ -441,13 +509,33 @@ class DisaggWorker:
                    dl: Optional[_rp.Deadline]) -> int:
         """Synchronous splice under the engine lock — when the sender
         sees the RESULT ack, the pages are already in the pool, so a
-        decode request racing in right behind it prefix-hits them."""
+        decode request racing in right behind it prefix-hits them.
+
+        Two fleet/checkpoint.py frame kinds ride the same op: a
+        ``meta["checkpoint"]`` frame is a neighbor's blob to shelve
+        (payload = the blob, never touches the pool); a
+        ``meta["restore"]`` tag on a normal page frame additionally
+        adopts the session once the splice lands, so its next prefill
+        carries the ``restore`` diag attribution."""
+        ck = meta.get("checkpoint")
+        if isinstance(ck, dict):
+            session, seq = ck.get("session"), ck.get("seq")
+            if not isinstance(session, str) or not session:
+                raise ValueError("checkpoint frame needs a 'session'")
+            self._ckpt_shelf().put(session, int(seq or 0), payload)
+            return 0
         doc = decode_pages(meta, payload)
+        rs = meta.get("restore")
         with self._elock:
             kv: Optional[PagedKVCache] = self.engine._kv
             if kv is None:
                 raise RuntimeError("engine has no paged KV cache")
             n = kv.import_pages(doc)
+            if isinstance(rs, dict) and rs.get("session"):
+                # adoption only after a successful splice — a rejected
+                # doc raises above and the sender falls back
+                self.engine.adopt_restored_session(
+                    str(rs["session"]), rs.get("path"), restored=True)
         _PAGES_RECV.inc(len(doc["entries"]))
         return n
 
@@ -526,9 +614,62 @@ class DisaggWorker:
                 self.engine.resume_session(str(session))
             send_message(conn, Cmd.RESULT, {"session": str(session),
                                             "resumed": True})
+        elif op == "checkpoint_send":
+            send_message(conn, Cmd.RESULT,
+                         self._checkpoint_send(str(session), ctl, dl))
+        elif op == "adopt_session":
+            # crash-restore fallback (fleet/checkpoint.SessionRestorer):
+            # this worker becomes the session's home with no pages —
+            # restored=False marks its next prefill re_prefill
+            with self._elock:
+                self.engine.adopt_restored_session(
+                    str(session), ctl.get("path"),
+                    restored=bool(ctl.get("restored", False)))
+            send_message(conn, Cmd.RESULT, {"session": str(session),
+                                            "adopted": True})
         else:
             send_message(conn, Cmd.ERROR,
                          {"error": f"unknown lm_ctl op {op!r}"})
+
+    def _checkpoint_send(self, session: str, ctl: Dict[str, Any],
+                         dl: Optional[_rp.Deadline]) -> Dict[str, Any]:
+        """Serve one shelved checkpoint to a restore target: newest
+        valid blob for ``session``, refused as stale when older than
+        ``min_seq`` (the dead worker's last pushed watermark), shipped
+        to ``xfer_to`` as a restore-tagged page frame the target
+        splices AND adopts in one ack."""
+        reply: Dict[str, Any] = {"session": session, "found": False,
+                                 "sent": False}
+        store = self._ckpt_store
+        ck = store.latest(session) if store is not None else None
+        if ck is None:
+            return reply
+        reply["found"] = True
+        reply["seq"] = int(ck["seq"])
+        min_seq = int(ctl.get("min_seq") or 0)
+        if ck["seq"] < min_seq:
+            reply["stale"] = True
+            return reply
+        xfer_to = ctl.get("xfer_to")
+        if ck["doc"] is None or not xfer_to:
+            return reply  # path-only blob: nothing to warm with
+        meta, payload = encode_pages(ck["doc"])
+        meta["restore"] = {"session": session, "seq": int(ck["seq"]),
+                           "path": [int(t) for t in ck["path"]]}
+        try:
+            client = self._xfer_clients.get(str(xfer_to))
+            if client is None:
+                (host, port), = parse_endpoints(str(xfer_to))
+                client = PageTransferClient(host, port)
+                self._xfer_clients[str(xfer_to)] = client
+            client.send_frame(meta, payload, dl,
+                              pages=len(ck["doc"]["entries"]))
+        except Exception as e:  # noqa: BLE001 — reply carries the failure
+            reply["xfer_error"] = str(e)
+            return reply
+        reply["sent"] = True
+        reply["pages"] = len(ck["doc"]["entries"])
+        return reply
 
     def _ship(self, doc: Dict[str, Any], xfer_to: str,
               dl: Optional[_rp.Deadline], reply: Dict[str, Any]) -> int:
@@ -549,6 +690,8 @@ class DisaggWorker:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._ckpt_owned and self._ckpt_daemon is not None:
+            self._ckpt_daemon.stop()
         if self._digest_hook_installed \
                 and _fleet.KV_DIGEST_HOOK is self._digest_hook:
             _fleet.KV_DIGEST_HOOK = None
@@ -562,6 +705,32 @@ class DisaggWorker:
         for t in self._threads:
             if t is not cur:
                 t.join(timeout=2.0)
+
+    def kill(self) -> None:
+        """kill -9 semantics for in-process workers (the chaos ``kill``
+        fault's shim target): no drain, no export round trip, no
+        goodbye push — the listener and every live connection just die
+        mid-frame, exactly what peers of a SIGKILLed subprocess see.
+        The engine object survives only because the test process does;
+        nothing reads it again."""
+        self._stop.set()
+        if self._ckpt_owned and self._ckpt_daemon is not None:
+            # a real SIGKILL takes the daemon thread with it; stopping
+            # (not flushing) ours is the in-process equivalent
+            self._ckpt_daemon.stop()
+        if self._digest_hook_installed \
+                and _fleet.KV_DIGEST_HOOK is self._digest_hook:
+            _fleet.KV_DIGEST_HOOK = None
+        # sever live connections too: a conn thread parked in recv on
+        # an already-delivered frame must die mid-frame, not serve one
+        # last request the way a graceful stop() would
+        for sock in [self._listener, *self._conns,
+                     *[c._sock for c in self._xfer_clients.values()
+                       if c._sock is not None]]:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 # --------------------------------------------------------------------------- #

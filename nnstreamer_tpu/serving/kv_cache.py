@@ -121,6 +121,14 @@ def _pool_copy(pool, dst, src):
     return pool.at[dst].set(pool[src])
 
 
+@jax.jit
+def _gather_pages(kpool, vpool, idx):
+    # the export primitive: one compiled gather over the whole path —
+    # an eager pool[idx] pays gather-tracing per call, which dominates
+    # a checkpoint pass (recompiles per distinct path length only)
+    return kpool[idx], vpool[idx]
+
+
 class PageNode:
     """One radix-tree node = one FULL page of tokens. ``key`` is the
     page's token tuple; ``page`` its device page id (None when evicted
@@ -442,9 +450,26 @@ class PagedKVCache:
         return None
 
     def _export_doc(self, path: List[PageNode]) -> Optional[Dict[str, Any]]:
+        # batch the D2H for every device-resident page in the path:
+        # one gather + one transfer instead of two dispatches and a
+        # copy per page — this is the whole cost of a checkpoint or
+        # migration export, so per-page round trips dominate it
+        fetched: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        dev = [(i, nd.page) for i, nd in enumerate(path)
+               if nd.page is not None]
+        if dev:
+            raw = np.asarray([p for _, p in dev], np.int32)
+            # pad to the next power of two (repeating valid ids) so the
+            # jitted gather compiles once per bucket, not once per path
+            # length — growing sessions change length every pass
+            cap = 1 << max(0, int(raw.size) - 1).bit_length()
+            ks, vs = jax.device_get(_gather_pages(
+                self.kpool, self.vpool, np.resize(raw, cap)))
+            for (i, _), k, v in zip(dev, ks[:raw.size], vs[:raw.size]):
+                fetched[i] = (np.asarray(k), np.asarray(v))
         entries = []
-        for nd in path:
-            kv = self._node_payload(nd)
+        for i, nd in enumerate(path):
+            kv = fetched.get(i) or nd.host_kv
             if kv is None:
                 return None  # a content-less link breaks the chain
             entries.append({"key": [int(x) for x in nd.key],

@@ -58,7 +58,7 @@ import weakref
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -542,10 +542,18 @@ class LMEngine:
         # evicted session its migration warmth.
         self._session_paths: "OrderedDict[str, np.ndarray]" = OrderedDict()
         self._frozen_sessions: set = set()
+        # path snapshots taken AT freeze time: export_session ships the
+        # snapshot, so a retire landing between freeze and export can
+        # no longer move the exported path under the migrator's feet
+        self._frozen_paths: Dict[str, np.ndarray] = {}
         # sessions whose migration was absorbed (resume_session): their
         # NEXT prefill re-derives state the fleet failed to ship, and
         # the diag critical path bills it as re_prefill, not compute
         self._reprefill_sessions: set = set()
+        # sessions a crash-restore spliced a checkpoint into
+        # (adopt_restored_session): their next prefill rides the
+        # imported pages and diag bills it as restore, not re_prefill
+        self._restored_sessions: set = set()
         # decode_steps/slot_steps/wasted_slot_steps account the CHUNK
         # path only (bench waste_frac reads them; its serving lane runs
         # chunk mode); speculative iterations are accounted separately
@@ -918,25 +926,92 @@ class LMEngine:
         so nothing in progress is torn."""
         s = str(session)
         self._frozen_sessions.add(s)
-        return s in self._session_paths
+        path = self._session_paths.get(s)
+        if path is not None and s not in self._frozen_paths:
+            # snapshot the path AT freeze time: retires replace (never
+            # mutate) the recorded array, so holding this reference
+            # pins exactly the state the freeze observed — the export
+            # below ships it even if a slot retires mid-migration.
+            # Re-freezing an already-frozen session keeps the ORIGINAL
+            # snapshot (export_session freezes again before exporting;
+            # it must not trade the pinned state for a racing retire's)
+            self._frozen_paths[s] = path
+        return s in self._frozen_paths
 
     def resume_session(self, session: str) -> None:
         """Lift a migration freeze (the absorb path when the page
         shipment failed and this backend must keep serving)."""
-        self._frozen_sessions.discard(str(session))
-        self._reprefill_sessions.add(str(session))
+        s = str(session)
+        self._frozen_sessions.discard(s)
+        self._frozen_paths.pop(s, None)
+        self._reprefill_sessions.add(s)
 
     def export_session(self, session: str) -> Optional[Dict[str, Any]]:
         """Freeze ``session`` and export the KV pages covering its last
         committed token path (``kv_cache.export_pages`` — the same doc
         the disagg prefill→decode hand-off ships). None when the engine
         runs contiguous, the session is unknown, or its pages were
-        already evicted — the migration target then re-prefills."""
-        path = self._session_paths.get(str(session))
-        self.freeze_session(session)
+        already evicted — the migration target then re-prefills.
+
+        Freeze happens FIRST: a ``submit()`` racing this export gets
+        the clean frozen-session error and fails over to the re-pinned
+        target, and the exported doc covers the freeze-time path
+        snapshot — never a half-updated one."""
+        s = str(session)
+        self.freeze_session(s)
+        path = self._frozen_paths.get(s)
         if self._kv is None or path is None:
             return None
         return self._kv.export_pages(path)
+
+    # -- crash checkpoint/restore (fleet/checkpoint.py) -------------------- #
+
+    def session_watermarks(self) -> Dict[str, int]:
+        """Committed token-path length per live session — the natural
+        monotone checkpoint sequence number. Empty when no session has
+        retired a turn yet."""
+        return {s: int(p.size) for s, p in self._session_paths.items()}
+
+    def checkpoint_session(
+            self, session: str) -> Optional[Tuple[np.ndarray, Dict[str, Any]]]:
+        """Read-only checkpoint snapshot: ``(token_path, pages_doc)``
+        for the session's last committed turn, or None when the session
+        is unknown, the engine runs contiguous, or the path's pages
+        were already evicted. Unlike :meth:`export_session` this does
+        NOT freeze — the session keeps serving; ``export_pages`` walks
+        the radix tree read-only, so the daemon only ever sees a
+        self-consistent (possibly one-turn-stale) path."""
+        path = self._session_paths.get(str(session))
+        if path is None or self._kv is None:
+            return None
+        doc = self._kv.export_pages(path)
+        if doc is None:
+            return None
+        return path, doc
+
+    def adopt_restored_session(self, session: str, path: Any, *,
+                               restored: bool = True) -> None:
+        """Crash-restore adoption: record ``path`` as the session's
+        committed token path (so the very next export/checkpoint works)
+        and tag its next prefill for the diag critical path —
+        ``restore`` when a fresh checkpoint's pages were spliced (the
+        prefill rides the radix hit), ``re_prefill`` when the
+        stale/corrupt/missing fallback recomputes from scratch."""
+        s = str(session)
+        if path is not None:
+            seq = np.asarray(path, np.int32).reshape(-1)
+            self._session_paths[s] = seq
+            self._session_paths.move_to_end(s)
+            while len(self._session_paths) > SESSION_PATHS_LIMIT:
+                self._session_paths.popitem(last=False)
+        self._frozen_sessions.discard(s)
+        self._frozen_paths.pop(s, None)
+        if restored:
+            self._restored_sessions.add(s)
+            self._reprefill_sessions.discard(s)
+        else:
+            self._reprefill_sessions.add(s)
+            self._restored_sessions.discard(s)
 
     def enqueue_kv_import(self, doc: Dict[str, Any]) -> None:
         """Queue a wire-received page doc for splicing (any thread);
@@ -1026,6 +1101,13 @@ class LMEngine:
                     "serving.prefill", parent=req.span.context,
                     attrs={"bucket": tb, "slot": slot})
                 if req.session is not None \
+                        and req.session in self._restored_sessions:
+                    # first prefill after a checkpoint splice — it
+                    # rides the imported radix pages; diag bills it as
+                    # restore (cheap) rather than re_prefill (full)
+                    self._restored_sessions.discard(req.session)
+                    pspan.set_attribute("restore", True)
+                elif req.session is not None \
                         and req.session in self._reprefill_sessions:
                     # post-absorb recompute, not fresh work — the diag
                     # critical path bills this span as re_prefill

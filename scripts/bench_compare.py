@@ -89,6 +89,13 @@ LANES: Dict[str, int] = {
     # — streams surviving a scale-in is the tentpole claim)
     "fleet_migration_seconds": -1,
     "fleet_halved_goodput_ratio": +1,
+    # crash restore (fleet/checkpoint.py): restoring a killed worker's
+    # sessions must stay fast (re-pin + checkpoint_send + page splice,
+    # end to end) and warm (post-restore prompt tokens served from the
+    # restored prefix pages — a re-prefill fallback scores ~0 here)
+    "fleet_restore_seconds": -1,
+    "fleet_restore_warm_ratio": +1,
+    "fleet_checkpoint_overhead_ratio": +1,
     # incident diagnostics (obs/diag/): freezing a full debug bundle
     # must stay cheap enough to fire from a burn alert in production,
     # and the critical-path sweep must keep attributing root-span time
@@ -102,6 +109,15 @@ LANES: Dict[str, int] = {
     # distribution shift must breach both drift windows quickly
     "quality_overhead_ratio": +1,
     "quality_drift_detect_seconds": -1,
+}
+
+#: absolute floors, gated on the FRESH run independently of the
+#: baseline — a drifting baseline must never grandfather a breach.
+#: fleet_checkpoint_overhead_ratio is the checkpoint daemon's
+#: acceptance gate: serving throughput with a checkpoint pass per
+#: request holds >= 95% of the uncheckpointed rate.
+FLOORS: Dict[str, float] = {
+    "fleet_checkpoint_overhead_ratio": 0.95,
 }
 
 #: current lane name -> names it may carry in OLDER baselines
@@ -208,6 +224,9 @@ def main(argv=None) -> int:
     lane_names = [ln.strip() for ln in args.lanes.split(",") if ln.strip()] \
         if args.lanes else list(LANES)
     regressions, ok, skipped = compare(fresh, base, args.threshold, lane_names)
+    floor_breaches = [(name, FLOORS[name], fresh[name])
+                      for name in sorted(FLOORS)
+                      if name in fresh and fresh[name] < FLOORS[name]]
 
     print(f"baseline: {baseline}")
     for name, b, f, d in ok:
@@ -220,9 +239,11 @@ def main(argv=None) -> int:
     for name, b, f, d in regressions:
         print(f"  REGRESSED {name}: {b:g} -> {f:g} ({d * 100:+.1f}%, "
               f"threshold {args.threshold * 100:.0f}%)")
-    if regressions:
-        print(f"bench_compare: {len(regressions)} lane(s) regressed",
-              file=sys.stderr)
+    for name, fl, f in floor_breaches:
+        print(f"  FLOOR     {name}: {f:g} below absolute floor {fl:g}")
+    if regressions or floor_breaches:
+        print(f"bench_compare: {len(regressions)} lane(s) regressed, "
+              f"{len(floor_breaches)} floor breach(es)", file=sys.stderr)
         return 1
     print(f"bench_compare: {len(ok)} lane(s) within threshold, "
           f"{len(skipped)} skipped")

@@ -981,6 +981,79 @@ def check_fleet(root: Path = SOURCE_ROOT):
     return problems
 
 
+#: fleet/checkpoint.py's vocabulary: the crash-checkpoint metric
+#: families, the fleet.checkpoint_*/restore_* event subfamilies, and
+#: the CHECKPOINT_HOOK push-doc tap
+CHECKPOINT_METRIC_PREFIXES = ("nnstpu_fleet_checkpoint_",
+                              "nnstpu_fleet_restore_",
+                              "nnstpu_fleet_restored_")
+CHECKPOINT_EVENT_PREFIXES = ("checkpoint_", "restore_")
+#: module-level assignment to the checkpoint watermark hook; matches
+#: ``CHECKPOINT_HOOK = ...`` and ``_obsfleet.CHECKPOINT_HOOK = ...``
+_CKPT_HOOK_ASSIGN_RE = re.compile(
+    r"^[ \t]*(?:\w+[ \t]*\.[ \t]*)*CHECKPOINT_HOOK[ \t]*=[^=]",
+    re.MULTILINE)
+#: the hook's None default lives on the push-doc schema owner,
+#: obs/fleet.py — the one assignment allowed outside fleet/
+CKPT_HOOK_HOME = ("obs", "fleet.py")
+
+
+def check_checkpoint(root: Path = SOURCE_ROOT):
+    """Crash-checkpoint naming/placement lint (check_fleet's sibling).
+
+    * the ``nnstpu_fleet_checkpoint_*`` / ``nnstpu_fleet_restore_*`` /
+      ``nnstpu_fleet_restored_*`` metric families are registered only
+      under nnstreamer_tpu/fleet/ — snapshot and restore accounting
+      lives with the daemon and restorer, not scattered across the
+      serving wire that merely carries the blobs.
+    * ``fleet.checkpoint_*`` / ``fleet.restore_*`` events are emitted
+      only from nnstreamer_tpu/fleet/ — the scale_*/migrate_* rule's
+      sibling: one audit trail per subsystem owner.
+    * ``CHECKPOINT_HOOK`` is assigned only inside nnstreamer_tpu/
+      fleet/ (the daemon's install_hook()/uninstall_hook()), plus the
+      ``= None`` default on obs/fleet.py where the hook lives —
+      everything else reads it behind one None check, so push docs
+      stay zero-overhead when no daemon runs.
+    """
+    problems = []
+    for path, lineno, _mtype, name in iter_registrations(root):
+        if name.startswith(CHECKPOINT_METRIC_PREFIXES) \
+                and not _is_fleet_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: {name!r} uses the fleet "
+                f"checkpoint/restore metric family outside "
+                f"nnstreamer_tpu/fleet/ — snapshot accounting lives "
+                f"with the checkpoint daemon")
+    for path, lineno, name in iter_event_sites(root):
+        m = _EVENT_NAME_RE.match(name)
+        if m is None:
+            continue
+        if m.group("layer") == FLEET_LAYER \
+                and m.group("event").startswith(CHECKPOINT_EVENT_PREFIXES) \
+                and not _is_fleet_pkg(path):
+            problems.append(
+                f"{_where(path, lineno)}: event {name!r} uses the fleet "
+                f"checkpoint_*/restore_* subfamily outside "
+                f"nnstreamer_tpu/fleet/ — the daemon and restorer own "
+                f"the crash audit trail")
+    for path in sorted(root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _CKPT_HOOK_ASSIGN_RE.finditer(text):
+            if _is_fleet_pkg(path):
+                continue
+            line = text[m.start():].splitlines()[0]
+            if tuple(path.parts[-2:]) == CKPT_HOOK_HOME \
+                    and line.split("=", 1)[1].strip() == "None":
+                continue  # the hook's None default on its home module
+            lineno = text.count("\n", 0, m.start()) + 1
+            problems.append(
+                f"{_where(path, lineno)}: CHECKPOINT_HOOK assigned "
+                f"outside nnstreamer_tpu/fleet/ — consumers read the "
+                f"hook behind one None check; only the daemon's "
+                f"install_hook()/uninstall_hook() write it")
+    return problems
+
+
 #: the ``diag`` metric/span/event layer is owned by the incident-
 #: diagnostics package (obs/diag/): synthetic queue-wait/batch-run
 #: spans, trigger/bundle events, and any diag series are emitted

@@ -173,3 +173,14 @@ class FleetRule(_NamingRule):
                    "AUTOSCALE_HOOK is assigned only by "
                    "fleet.enable()/disable()")
     checks = (_compat.check_fleet,)
+
+
+@register_rule
+class CheckpointRule(_NamingRule):
+    id = "naming/checkpoint"
+    description = ("nnstpu_fleet_checkpoint_*/restore_*/restored_* "
+                   "metrics and the fleet.checkpoint_*/restore_* event "
+                   "subfamilies live in fleet/; CHECKPOINT_HOOK is "
+                   "assigned only by the checkpoint daemon's "
+                   "install_hook()/uninstall_hook()")
+    checks = (_compat.check_checkpoint,)

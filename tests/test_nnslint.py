@@ -1231,6 +1231,113 @@ class TestFleetPlacement:
 
 
 # --------------------------------------------------------------------------- #
+# checkpoint placement (naming/checkpoint via naming_compat.check_checkpoint)
+# --------------------------------------------------------------------------- #
+
+class TestCheckpointPlacement:
+    """check_checkpoint ownership: nnstpu_fleet_checkpoint_*/restore_*/
+    restored_* metrics and the fleet.checkpoint_*/restore_* event
+    subfamilies live in nnstreamer_tpu/fleet/; CHECKPOINT_HOOK is
+    written only by the daemon's install/uninstall — except its None
+    default on obs/fleet.py, where the hook lives."""
+
+    _tree = staticmethod(TestSchedPlacement._tree)
+
+    def test_checkpoint_metric_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/disagg.py": """
+            def setup(reg):
+                reg.counter("nnstpu_fleet_checkpoint_bytes_total", "h",
+                            ())
+            """})
+        problems = naming_compat.check_checkpoint(root)
+        assert len(problems) == 1
+        assert "snapshot accounting lives with the checkpoint daemon" \
+            in problems[0]
+
+    def test_restore_event_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"query/router.py": """
+            def warn(events):
+                events.record("fleet.restore_done", "i", msg="x")
+            """})
+        problems = naming_compat.check_checkpoint(root)
+        assert len(problems) == 1
+        assert "the daemon and restorer own the crash audit trail" \
+            in problems[0]
+
+    def test_hook_assignment_outside_package_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"serving/disagg.py": """
+            from ..obs import fleet as _obsfleet
+
+            def hijack(fn):
+                _obsfleet.CHECKPOINT_HOOK = fn
+            """})
+        problems = naming_compat.check_checkpoint(root)
+        assert len(problems) == 1
+        assert "CHECKPOINT_HOOK assigned outside" in problems[0]
+
+    def test_hook_none_default_on_home_module_allowed(self, tmp_path):
+        # obs/fleet.py hosts the hook: its `= None` default is the one
+        # assignment tolerated outside fleet/ — anything else there
+        # (or any non-None value) still fires
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            CHECKPOINT_HOOK = None
+            """})
+        assert naming_compat.check_checkpoint(root) == []
+
+    def test_hook_non_none_on_home_module_fires(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {"obs/fleet.py": """
+            CHECKPOINT_HOOK = print
+            """})
+        problems = naming_compat.check_checkpoint(root)
+        assert len(problems) == 1
+        assert "CHECKPOINT_HOOK assigned outside" in problems[0]
+
+    def test_clean_twin_silent(self, tmp_path):
+        from scripts.nnslint import naming_compat
+
+        root = self._tree(tmp_path, {
+            "obs/fleet.py": """
+                CHECKPOINT_HOOK = None
+                """,
+            "fleet/checkpoint.py": """
+                from ..obs import fleet as _obsfleet
+
+                def setup(reg, events):
+                    reg.counter(
+                        "nnstpu_fleet_restored_sessions_total", "h",
+                        ("outcome",))
+                    events.record("fleet.checkpoint_write", "i",
+                                  msg="x")
+                    events.record("fleet.restore_done", "i", msg="x")
+
+                def install_hook(fn):
+                    _obsfleet.CHECKPOINT_HOOK = fn
+                """,
+            "serving/disagg.py": """
+                def push(_obsfleet):
+                    hook = _obsfleet.CHECKPOINT_HOOK
+                    return hook() if hook is not None else {}
+                """,
+        })
+        assert naming_compat.check_checkpoint(root) == []
+
+    def test_repo_is_clean(self):
+        from scripts.nnslint import naming_compat
+
+        assert naming_compat.check_checkpoint() == []
+
+
+# --------------------------------------------------------------------------- #
 # diag placement (naming/diag via naming_compat.check_diag)
 # --------------------------------------------------------------------------- #
 
